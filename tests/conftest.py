@@ -1,0 +1,23 @@
+"""Test session config: force an 8-device virtual CPU mesh.
+
+The reference has no multi-device tests at all (SURVEY.md §4); under JAX we can
+exercise real sharding/collective paths on a host-platform mesh without TPUs.
+Must run before jax initializes its backends, hence env vars at import time.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("TRLX_TPU_NO_TQDM", "1")
+# Persistent compile cache: repeated test runs skip XLA compilation.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+# The environment's TPU-tunnel boot shim (sitecustomize) force-selects its
+# backend via jax.config, which overrides JAX_PLATFORMS and would make every
+# first jax op block on a remote handshake. Tests are CPU-only: undo it
+# before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

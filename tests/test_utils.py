@@ -1,0 +1,96 @@
+"""Utils tests (shape of the reference's ``tests/test_utils.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from trlx_tpu import utils
+from trlx_tpu.utils import stats
+
+
+def test_significant():
+    assert utils.significant(3.14159) == 3.1
+    assert utils.significant(0.000123456, 2) == 0.00012
+    assert utils.significant(0) == 0
+    assert utils.significant("str") == "str"
+
+
+@pytest.mark.parametrize("name", ["adam", "adamw", "sgd", "lion", "adafactor"])
+def test_optimizer_getters(name):
+    opt = utils.get_optimizer(name, {"lr": 1e-3})
+    params = {"w": jnp.ones((4, 4))}
+    state = opt.init(params)
+    grads = {"w": jnp.ones((4, 4))}
+    updates, _ = opt.update(grads, state, params)
+    assert updates["w"].shape == (4, 4)
+
+
+def test_optimizer_betas_translation():
+    opt = utils.get_optimizer("adamw", {"lr": 1e-3, "betas": (0.9, 0.95), "eps": 1e-8})
+    params = {"w": jnp.ones(3)}
+    opt.init(params)  # should not raise
+
+
+def test_optimizer_mask_freezes():
+    opt = utils.get_optimizer(
+        "sgd", {"lr": 1.0}, mask={"frozen": False, "live": True}
+    )
+    params = {"frozen": jnp.ones(2), "live": jnp.ones(2)}
+    state = opt.init(params)
+    grads = {"frozen": jnp.ones(2), "live": jnp.ones(2)}
+    updates, _ = opt.update(grads, state, params)
+    assert np.allclose(updates["frozen"], 0.0)
+    assert not np.allclose(updates["live"], 0.0)
+
+
+@pytest.mark.parametrize("name", ["cosine_annealing", "linear", "constant", "warmup_cosine"])
+def test_scheduler_getters(name):
+    sched = utils.get_scheduler(name, {"lr": 1e-3})
+    val = sched(0)
+    assert np.isfinite(float(val))
+
+
+def test_cosine_annealing_matches_torch_semantics():
+    sched = utils.get_scheduler("cosine_annealing", {"lr": 1.0, "T_max": 100, "eta_min": 0.1})
+    assert float(sched(0)) == pytest.approx(1.0)
+    assert float(sched(100)) == pytest.approx(0.1)
+    assert float(sched(50)) == pytest.approx(0.55)
+
+
+def test_running_moments_matches_numpy():
+    rm = stats.RunningMoments()
+    chunks = [np.random.RandomState(i).randn(64) * (i + 1) for i in range(4)]
+    for chunk in chunks:
+        rm.update(chunk)
+    all_x = np.concatenate(chunks)
+    assert rm.mean == pytest.approx(all_x.mean(), rel=1e-6)
+    assert rm.std == pytest.approx(all_x.std(ddof=1), rel=1e-4)
+
+
+def test_whiten_masked():
+    x = jnp.array([[1.0, 2.0, 3.0, 99.0], [4.0, 5.0, 6.0, 99.0]])
+    mask = jnp.array([[1.0, 1.0, 1.0, 0.0], [1.0, 1.0, 1.0, 0.0]])
+    w = stats.whiten(x, mask)
+    valid = np.asarray(w)[np.asarray(mask) > 0]
+    assert abs(valid.mean()) < 1e-5
+    assert valid.std() == pytest.approx(1.0, rel=1e-2)
+
+
+def test_logprobs_of_labels():
+    logits = jnp.array([[[0.0, 10.0], [10.0, 0.0]]])
+    labels = jnp.array([[1, 0]])
+    lp = stats.logprobs_of_labels(logits, labels)
+    assert lp.shape == (1, 2)
+    assert float(lp[0, 0]) > -1e-3  # near log(1)
+
+
+def test_flatten_dict():
+    assert utils.flatten_dict({"a": {"b": 1, "c": {"d": 2}}}) == {"a/b": 1, "a/c/d": 2}
+
+
+def test_clock():
+    clock = utils.Clock()
+    clock.tick(10)
+    assert clock.get_stat(1000) > 0

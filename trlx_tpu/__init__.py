@@ -1,0 +1,14 @@
+"""trlx_tpu: a TPU-native (JAX/XLA/pjit/Pallas) RLHF fine-tuning framework.
+
+Provides the capabilities of trlx (reference: ``trlx/trlx.py``) — online PPO
+against a user reward function, offline ILQL from reward-labeled samples, and
+SFT — re-designed TPU-first: Flax models sharded over a ``(data, fsdp, model)``
+mesh, jitted KV-cached rollout generation with on-device KL-to-reference, and
+fused pure-function losses inside a pjit'd train step.
+"""
+
+__version__ = "0.1.0"
+
+from trlx_tpu.trlx import train  # noqa: F401
+
+__all__ = ["train", "__version__"]

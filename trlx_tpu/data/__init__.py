@@ -1,0 +1,42 @@
+"""Data types and config system.
+
+Reference: ``trlx/data/__init__.py`` (GeneralElement/RLElement/BatchElement)
+and ``trlx/data/accelerate_base_datatypes.py`` (PromptBatch).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Iterable, List
+
+import numpy as np
+
+
+@dataclass
+class GeneralElement:
+    """General element for any pipeline."""
+
+    pass
+
+
+@dataclass
+class RLElement(GeneralElement):
+    """A state/action pair."""
+
+    state: Any = None
+    action: Any = None
+
+
+@dataclass
+class PromptElement(GeneralElement):
+    """A tokenized prompt."""
+
+    text: str = ""
+    tokens: np.ndarray = None
+
+
+@dataclass
+class PromptBatch:
+    """A batch of tokenized prompts."""
+
+    text: List[str] = None
+    tokens: np.ndarray = None  # [B, T]
+    attention_mask: np.ndarray = None  # [B, T]

@@ -1,0 +1,76 @@
+"""ILQL batch datatypes.
+
+Reference: ``trlx/data/ilql_types.py``. The reference stores ragged
+``actions_ixs``/``states_ixs`` index lists; here every element is padded to
+fixed [B, T]/[B, A]/[B, S] blocks with ``dones`` doubling as the validity
+mask, so batches trace into static-shape XLA programs.
+"""
+
+from dataclasses import dataclass, fields
+from typing import NamedTuple
+
+import jax
+import numpy as np
+
+
+def flatten_dataclass(cls: type):
+    """dataclass/NamedTuple instance → tuple of fields (for PP transport)."""
+    cls_fields = [f.name for f in fields(cls)] if hasattr(cls, "__dataclass_fields__") else list(cls._fields)
+
+    def flatten(x) -> tuple:
+        return tuple(getattr(x, f) for f in cls_fields)
+
+    return flatten
+
+
+def unflatten_dataclass(cls: type):
+    """tuple of fields → dataclass/NamedTuple instance."""
+
+    def unflatten(x: tuple):
+        return cls(*x)
+
+    return unflatten
+
+
+@dataclass
+class ILQLElement:
+    """One offline experience (host side, ragged numpy)."""
+
+    input_ids: np.ndarray  # [T]
+    attention_mask: np.ndarray  # [T]
+    rewards: np.ndarray  # [A]
+    states_ixs: np.ndarray  # [S]
+    actions_ixs: np.ndarray  # [A]
+    dones: np.ndarray  # [S]
+
+
+class ILQLBatch(NamedTuple):
+    """Fixed-shape ILQL training batch (device side)."""
+
+    input_ids: jax.Array  # [B, T]
+    attention_mask: jax.Array  # [B, T]
+    rewards: jax.Array  # [B, A]
+    states_ixs: jax.Array  # [B, S] (S = A + 1)
+    actions_ixs: jax.Array  # [B, A]
+    dones: jax.Array  # [B, S]
+
+
+@dataclass
+class ILQLSeq2SeqElement:
+    input_ids: np.ndarray
+    attention_mask: np.ndarray
+    decoder_input_ids: np.ndarray
+    rewards: np.ndarray
+    states_ixs: np.ndarray
+    actions_ixs: np.ndarray
+    dones: np.ndarray
+
+
+class ILQLSeq2SeqBatch(NamedTuple):
+    input_ids: jax.Array
+    attention_mask: jax.Array
+    decoder_input_ids: jax.Array
+    rewards: jax.Array
+    states_ixs: jax.Array
+    actions_ixs: jax.Array
+    dones: jax.Array

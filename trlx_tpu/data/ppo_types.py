@@ -1,0 +1,47 @@
+"""PPO batch datatypes.
+
+Reference: ``trlx/data/ppo_types.py``. Host-side elements are numpy (ragged,
+per-sample); device batches are fixed-shape jax arrays with masks — the
+TPU redesign of the reference's ragged tensors (static shapes for jit).
+"""
+
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import jax
+import numpy as np
+
+
+@dataclass
+class PPORLElement:
+    """One collected experience (host side, ragged numpy).
+
+    :param query_tensor: prompt token ids [Q]
+    :param response_tensor: sampled response ids [R]
+    :param logprobs: behavior-policy logprobs per response token [R]
+    :param values: value predictions per response token [R]
+    :param rewards: per-token rewards (KL penalty + score at end) [R]
+    """
+
+    query_tensor: np.ndarray
+    response_tensor: np.ndarray
+    logprobs: np.ndarray
+    values: np.ndarray
+    rewards: np.ndarray
+
+
+class PPORLBatch(NamedTuple):
+    """A fixed-shape batch of experiences (device side).
+
+    query_tensors are left-padded, response_tensors right-padded, matching the
+    reference collator (``trlx/pipeline/ppo_pipeline.py:43-71``); masks carry
+    the ragged structure.
+    """
+
+    query_tensors: jax.Array  # [B, Q] int32, left-padded
+    response_tensors: jax.Array  # [B, R] int32, right-padded
+    logprobs: jax.Array  # [B, R] float32
+    values: jax.Array  # [B, R] float32
+    rewards: jax.Array  # [B, R] float32
+    query_mask: jax.Array  # [B, Q] 1 on real prompt tokens
+    response_mask: jax.Array  # [B, R] 1 on real response tokens

@@ -1,0 +1,2 @@
+"""Model layer: transformer backbones, heads, hydra reference branches, and
+the method configs that carry the loss math (PPO/ILQL/SFT)."""

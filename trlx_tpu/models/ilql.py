@@ -1,0 +1,145 @@
+"""ILQL method: config + loss (twin-Q TD, expectile V, CQL, AWAC) — pure JAX.
+
+Behavioral parity target: ``ILQLConfig.loss`` (``trlx/models/modeling_ilql.py:60-132``)
+and the helpers ``topk_mask:28`` / ``batched_index_select:35``. The heads
+themselves live in ``trlx_tpu/models/heads.py``; the advantage-reshaped
+sampler in ``trlx_tpu/ops/sampling.py``.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.ilql_types import ILQLBatch, ILQLSeq2SeqBatch  # noqa: F401
+from trlx_tpu.data.method_configs import MethodConfig, register_method
+from trlx_tpu.utils import flatten_dict
+from trlx_tpu.utils.stats import get_tensor_stats
+
+
+def topk_mask(xs: jax.Array, k: int) -> jax.Array:
+    """Set all but the top-k entries of the last axis to -inf."""
+    if k >= xs.shape[-1]:
+        return xs
+    mintop = jax.lax.top_k(xs, k)[0][..., -1:]
+    return jnp.where(xs < mintop, -jnp.inf, xs)
+
+
+def batched_index_select(x: jax.Array, idxs: jax.Array, axis: int = 1) -> jax.Array:
+    """Gather rows at ``idxs`` along ``axis``: [B, T, H], [B, I] → [B, I, H]."""
+    return jnp.take_along_axis(x, jnp.expand_dims(idxs, -1), axis=axis)
+
+
+@dataclass
+@register_method("ILQLConfig")
+class ILQLConfig(MethodConfig):
+    """ILQL hyperparameters (field-compatible with the reference's
+    ``ILQLConfig``, ``trlx/models/modeling_ilql.py:47-57``).
+
+    :param tau: expectile for the V loss
+    :param gamma: discount
+    :param cql_scale: weight of the conservative (CQL) regularizer
+    :param awac_scale: weight of the AWAC-weighted CE term
+    :param alpha: Polyak rate for target-Q sync
+    :param beta: advantage scaling in the AWAC weight exp(β(Q−V))
+    :param steps_for_target_q_sync: opt steps between target-Q Polyak syncs
+    :param two_qs: use twin Q heads (min for targets)
+    :param gen_kwargs: sampling kwargs (incl. inference-time ``beta``)
+    """
+
+    name: str = "ILQLConfig"
+    tau: float = 0.7
+    gamma: float = 0.99
+    cql_scale: float = 0.1
+    awac_scale: float = 1.0
+    alpha: float = 0.001
+    beta: float = 0.0
+    steps_for_target_q_sync: int = 5
+    two_qs: bool = True
+    gen_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def loss(
+        self,
+        logits: jax.Array,  # [B, A, V] logits at action positions
+        qs: Tuple[jax.Array, ...],  # each [B, A, V]
+        target_qs: Tuple[jax.Array, ...],  # each [B, A, V]
+        vs: jax.Array,  # [B, S, 1] values at state positions
+        actions: jax.Array,  # [B, A] action token ids
+        rewards: jax.Array,  # [B, A]
+        dones: jax.Array,  # [B, S]
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """ILQL objective.
+
+        L = Σ_i (Q_i − (r + γ·V'))² (expectile-free TD on each Q head)
+          + expectile_τ(minQ' − V)
+          + cql_scale · Σ_i CE(q_i, a)
+          + awac_scale · exp(β(minQ' − V)) · CE(logits, a)
+        masked by ``dones[:, :-1]`` (non-terminal steps), mean over
+        non-terminal count. Matches ``modeling_ilql.py:60-132``.
+        """
+        logits = logits.astype(jnp.float32)
+        vs = vs.astype(jnp.float32)
+        terminal_mask = dones[:, :-1].astype(jnp.float32)  # [B, A]
+        n_nonterminal = jnp.maximum(terminal_mask.sum(), 1.0)
+        bsize, nactions, dsize = logits.shape
+
+        actions_exp = actions[..., None]  # [B, A, 1]
+        Q = [
+            jnp.take_along_axis(q.astype(jnp.float32), actions_exp, axis=-1)[..., 0]
+            for q in qs
+        ]
+        targetQs = [
+            jax.lax.stop_gradient(
+                jnp.take_along_axis(q.astype(jnp.float32), actions_exp, axis=-1)[..., 0]
+            )
+            for q in target_qs
+        ]
+        targetQ = targetQs[0]
+        for tq in targetQs[1:]:
+            targetQ = jnp.minimum(targetQ, tq)
+
+        V = vs[:, :-1, 0]  # [B, A] value of current states
+        Vnext = vs[:, 1:, 0] * dones[:, 1:].astype(vs.dtype)
+        Q_target = rewards + self.gamma * jax.lax.stop_gradient(Vnext)
+
+        loss_qs = [
+            jnp.sum(jnp.square(Qi - Q_target) * terminal_mask) / n_nonterminal
+            for Qi in Q
+        ]
+        loss_q = sum(loss_qs)
+
+        # expectile loss on V towards min target-Q
+        diff = targetQ - V
+        weight = jnp.where(diff >= 0, self.tau, 1.0 - self.tau)
+        loss_v = jnp.sum(weight * jnp.square(diff) * terminal_mask) / n_nonterminal
+
+        def ce(logit_like):  # [B, A, V] vs actions [B, A] → [B, A]
+            logp = jax.nn.log_softmax(logit_like.astype(jnp.float32), axis=-1)
+            return -jnp.take_along_axis(logp, actions_exp, axis=-1)[..., 0]
+
+        loss_cql = sum(
+            jnp.sum(ce(q) * terminal_mask) / n_nonterminal for q in qs
+        )
+
+        awac_weight = jax.lax.stop_gradient(jnp.exp(self.beta * (targetQ - V)))
+        loss_awac = jnp.sum(ce(logits) * awac_weight * terminal_mask) / n_nonterminal
+
+        loss = loss_q + loss_v + self.cql_scale * loss_cql + self.awac_scale * loss_awac
+
+        stats = dict(
+            losses=dict(
+                loss=loss,
+                loss_q=loss_q,
+                loss_v=loss_v,
+                loss_cql=loss_cql,
+                loss_awac=loss_awac,
+            ),
+            values=get_tensor_stats(V, terminal_mask, n_nonterminal),
+            qvalues={
+                str(ix): get_tensor_stats(Q[ix], terminal_mask, n_nonterminal)
+                for ix in range(len(Q))
+            },
+            awac_weight=get_tensor_stats(awac_weight, terminal_mask, n_nonterminal),
+        )
+        return loss, flatten_dict(stats)

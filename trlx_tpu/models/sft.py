@@ -1,0 +1,41 @@
+"""SFT method config + loss.
+
+Reference: ``SFTConfig`` and the cross-entropy loss with -100 label masking in
+``trlx/trainer/accelerate_sft_trainer.py:16-75``.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.method_configs import MethodConfig, register_method
+
+IGNORE_INDEX = -100
+
+
+@dataclass
+@register_method("SFTConfig")
+class SFTConfig(MethodConfig):
+    """Supervised fine-tuning: plain next-token CE, optionally loss-masked to
+    output segments of a dialogue (labels == -100 are ignored)."""
+
+    name: str = "SFTConfig"
+    gen_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def loss(
+        self,
+        logits: jax.Array,  # [B, T, V]
+        labels: jax.Array,  # [B, T]; IGNORE_INDEX positions excluded
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        # standard causal shift: logits at t predict labels at t+1
+        shift_logits = logits[:, :-1].astype(jnp.float32)
+        shift_labels = labels[:, 1:]
+        mask = (shift_labels != IGNORE_INDEX).astype(jnp.float32)
+        safe_labels = jnp.where(shift_labels == IGNORE_INDEX, 0, shift_labels)
+        logp = jax.nn.log_softmax(shift_logits, axis=-1)
+        token_nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        n = jnp.maximum(mask.sum(), 1.0)
+        loss = jnp.sum(token_nll * mask) / n
+        return loss, {"losses/loss": loss, "losses/ppl": jnp.exp(loss)}

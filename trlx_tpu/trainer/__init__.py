@@ -1,0 +1,86 @@
+"""Trainer registry + abstract trainer contract.
+
+Reference: ``trlx/trainer/__init__.py:9-103``. The registry keys are this
+framework's trainer names (``PPOTrainer``/``ILQLTrainer``/``SFTTrainer``); the
+reference's ``Accelerate*``/``NeMo*`` names are accepted as aliases so
+existing trlx configs load unchanged.
+"""
+
+import sys
+from abc import abstractmethod
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from trlx_tpu.data.configs import TRLConfig
+
+_TRAINERS: Dict[str, type] = {}
+
+# reference trainer names → this framework's equivalents
+_TRAINER_ALIASES = {
+    "accelerateppotrainer": "ppotrainer",
+    "accelerateilqltrainer": "ilqltrainer",
+    "acceleratesfttrainer": "sfttrainer",
+    "nemoilqltrainer": "ilqltrainer",
+    "nemosfttrainer": "sfttrainer",
+    "nemoppotrainer": "ppotrainer",
+}
+
+
+def register_trainer(name: Any = None) -> Callable:
+    """Decorator registering a trainer class by name."""
+
+    def register_cls(cls, registered_name: str):
+        _TRAINERS[registered_name.lower()] = cls
+        setattr(sys.modules[__name__], registered_name, cls)
+        return cls
+
+    if isinstance(name, type):
+        return register_cls(name, name.__name__)
+
+    def wrap(cls):
+        return register_cls(cls, name if isinstance(name, str) else cls.__name__)
+
+    return wrap
+
+
+def get_trainer(name: str) -> type:
+    resolved = _TRAINER_ALIASES.get(name.lower(), name.lower())
+    if resolved in _TRAINERS:
+        return _TRAINERS[resolved]
+    raise ValueError(f"Unknown trainer '{name}'. Registered: {sorted(_TRAINERS)}")
+
+
+class BaseRLTrainer:
+    """Abstract trainer contract (reference ``BaseRLTrainer``,
+    ``trlx/trainer/__init__.py:34-103``)."""
+
+    def __init__(
+        self,
+        config: TRLConfig,
+        reward_fn: Optional[Callable] = None,
+        metric_fn: Optional[Callable] = None,
+        stop_sequences: Optional[List[str]] = None,
+        **kwargs,
+    ):
+        self.config = config
+        self.reward_fn = reward_fn
+        self.metric_fn = metric_fn
+        self.stop_sequences = stop_sequences or []
+
+    @abstractmethod
+    def learn(self):
+        """Train the model and yield final stats."""
+        ...
+
+    @abstractmethod
+    def save(self, directory: Optional[str] = None, **kwargs):
+        """Checkpoint full training state (params, opt state, step)."""
+        ...
+
+    @abstractmethod
+    def load(self, directory: Optional[str] = None, **kwargs):
+        """Restore training state from a checkpoint."""
+        ...
+
+    def save_pretrained(self, directory: Optional[str] = None, **kwargs):
+        """Export model weights in an interoperable (HF-style) layout."""
+        raise NotImplementedError

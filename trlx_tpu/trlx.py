@@ -1,0 +1,129 @@
+"""Public API: the single ``train()`` entry point.
+
+Contract-compatible with the reference dispatcher (``trlx/trlx.py:15-123``):
+a ``reward_fn`` selects online RL (PPO), ``samples`` + ``rewards`` selects
+offline RL (ILQL), ``samples`` alone selects SFT. The user callback contracts
+are preserved exactly:
+
+- ``reward_fn(samples, prompts, outputs) -> List[float]``
+- ``metric_fn(samples, prompts, outputs) -> Dict[str, List[float]]``
+"""
+
+import warnings
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import (
+    default_ilql_config,
+    default_ppo_config,
+    default_sft_config,
+)
+from trlx_tpu.utils import set_seed
+
+
+def train(  # noqa: C901
+    model_path: Optional[str] = None,
+    reward_fn: Optional[Callable[[List[str], List[str], List[str]], List[float]]] = None,
+    dataset: Optional[Iterable[Tuple[str, float]]] = None,
+    samples: Optional[List[str]] = None,
+    rewards: Optional[List[float]] = None,
+    prompts: Optional[List[str]] = None,
+    eval_prompts: Optional[List[str]] = None,
+    metric_fn: Optional[Callable[[List[str], List[str], List[str]], Dict[str, List[float]]]] = None,
+    config: Optional[TRLConfig] = None,
+    stop_sequences: Optional[List[str]] = None,
+):
+    """Dispatch online RL, offline RL, or supervised fine-tuning.
+
+    Args:
+        model_path: HF checkpoint path, local directory, or ``builtin:*`` spec.
+        reward_fn: rates batches of generated samples; called on host with
+            ``(samples, prompts, outputs)``, returns per-sample rewards.
+        dataset: deprecated; use ``samples`` and ``rewards``.
+        samples: offline samples — strings, or interleaved
+            ``(prompt_0, output_0, prompt_1, output_1, ...)`` lists.
+        rewards: per-sample scalar rewards for offline (ILQL) training.
+        prompts: prompts for online rollouts.
+        eval_prompts: prompts for periodic validation.
+        metric_fn: computes named per-sample statistics at eval.
+        config: a :class:`TRLConfig`; a method-appropriate default is used
+            (with a warning) when omitted.
+        stop_sequences: strings at which generations are trimmed.
+    """
+    # Import for registration side effects (trainers/pipelines register here).
+    import importlib
+
+    for module in (
+        "trlx_tpu.pipeline.offline_pipeline",
+        "trlx_tpu.trainer.ppo",
+        "trlx_tpu.trainer.ilql",
+        "trlx_tpu.trainer.sft",
+    ):
+        importlib.import_module(module)
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+
+    if config is None:
+        warnings.warn(
+            "Passing the `config` argument implicitly is deprecated; adapt one "
+            "from `trlx_tpu/data/default_configs.py` instead"
+        )
+        if reward_fn:
+            config = default_ppo_config()
+        elif rewards:
+            config = default_ilql_config()
+        else:
+            config = default_sft_config()
+
+    set_seed(config.train.seed)
+
+    if dataset:
+        warnings.warn("the `dataset` argument is deprecated, split it into `samples` and `rewards`")
+        samples, rewards = dataset
+
+    if model_path:
+        config.model.model_path = model_path
+
+    trainer = get_trainer(config.train.trainer)(
+        config=config,
+        reward_fn=reward_fn,
+        metric_fn=metric_fn,
+        stop_sequences=stop_sequences or [],
+        **config.train.trainer_kwargs,
+    )
+
+    batch_size = config.train.batch_size
+    max_prompt_length = config.train.seq_length - config.method.gen_kwargs["max_new_tokens"]
+
+    if reward_fn:
+        # Online RL: build the prompt pipeline and collect initial experience.
+        prompts = prompts or [trainer.tokenizer.bos_token] * batch_size
+        if eval_prompts is None:
+            eval_prompts = prompts[:batch_size]
+
+        pipeline = get_pipeline(config.train.pipeline)(
+            prompts, max_prompt_length, trainer.tokenizer
+        )
+        trainer.add_prompt_pipeline(pipeline)
+        trainer.make_experience(config.method.num_rollouts)
+    elif samples:
+        if rewards is not None and len(samples) != len(rewards):
+            raise ValueError(
+                f"Number of samples {len(samples)} should match the number of rewards {len(rewards)}"
+            )
+        if eval_prompts is None:
+            eval_prompts = [trainer.tokenizer.bos_token] * batch_size
+        if rewards is not None:
+            trainer.make_experience(samples, rewards, config.train.seq_length)
+        else:
+            trainer.make_experience(samples, config.train.seq_length)
+    else:
+        raise ValueError("Either `samples` or `reward_fn` should be given for training")
+
+    eval_pipeline = get_pipeline(config.train.pipeline)(
+        eval_prompts, max_prompt_length, trainer.tokenizer
+    )
+    trainer.add_eval_pipeline(eval_pipeline)
+
+    trainer.learn()
+    return trainer

@@ -1,0 +1,236 @@
+"""General utilities: seeding, timing, pytree helpers, optax factories.
+
+Functional parity targets in the reference: ``trlx/utils/__init__.py``
+(``set_seed:39``, optimizer/scheduler getters ``:78-141``, ``Clock:144``,
+``tree_map:185``, ``significant:26``, ``filter_non_scalars:206``,
+``infinite_dataloader:235``). Optimizers/schedulers map onto optax instead of
+torch.optim; seeding returns a ``jax.random.PRNGKey`` rather than mutating
+global state.
+"""
+
+import math
+import random
+import subprocess
+import time
+from enum import Enum
+from numbers import Number
+from typing import Any, Dict, Iterable, Iterator, Mapping, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def significant(x: Any, ndigits: int = 2) -> Any:
+    """Cut the number to its ``ndigits`` most significant figures."""
+    if not isinstance(x, Number) or x == 0 or not math.isfinite(x):
+        return x
+    return round(x, ndigits - 1 - int(math.floor(math.log10(abs(x)))))
+
+
+def set_seed(seed: int, process_offset: bool = True) -> jax.Array:
+    """Seed host-side RNGs and return a root PRNG key.
+
+    The reference offsets the seed by the process rank
+    (``trlx/utils/__init__.py:39-47``) so data orders differ per replica; the
+    same offset is applied to the host-side RNGs here. The returned JAX key is
+    *not* offset — under a global mesh all processes must fold identical keys
+    into the same compiled program.
+    """
+    offset = jax.process_index() if process_offset else 0
+    random.seed(seed + offset)
+    np.random.seed(seed + offset)
+    return jax.random.PRNGKey(seed)
+
+
+class Clock:
+    """Tracks wall time per processed sample (reference ``Clock:144-182``)."""
+
+    def __init__(self):
+        self.start = time.time()
+        self.total_time = 0.0
+        self.total_samples = 0
+
+    def tick(self, samples: int = 0) -> float:
+        """Returns seconds since last tick; accumulates sample throughput."""
+        end = time.time()
+        delta = end - self.start
+        self.start = end
+        if samples != 0:
+            self.total_time += delta
+            self.total_samples += samples
+        return delta
+
+    def get_stat(self, n_samp: int = 1000, reset: bool = False) -> float:
+        """Seconds per ``n_samp`` samples."""
+        stat = self.total_time * n_samp / max(self.total_samples, 1)
+        if reset:
+            self.total_time = 0.0
+            self.total_samples = 0
+        return stat
+
+
+def filter_non_scalars(xs: Mapping[str, Any]) -> Dict[str, Any]:
+    """Keep only scalar-convertible entries of a flat stats dict."""
+    ys = {}
+    for k, v in xs.items():
+        try:
+            ys[k] = float(v)
+        except (TypeError, ValueError):
+            continue
+    return ys
+
+
+def flatten_dict(d: Mapping, parent_key: str = "", sep: str = "/") -> Dict[str, Any]:
+    """Flatten a nested mapping into ``a/b/c`` keys."""
+    items = []
+    for k, v in d.items():
+        key = parent_key + sep + str(k) if parent_key else str(k)
+        if isinstance(v, Mapping):
+            items.extend(flatten_dict(v, key, sep).items())
+        else:
+            items.append((key, v))
+    return dict(items)
+
+
+def to_host(tree: Any) -> Any:
+    """Device→host: fetch a pytree of jax arrays as numpy (scalars as floats)."""
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if hasattr(x, "shape") else x, jax.device_get(tree)
+    )
+
+
+def get_git_tag() -> Tuple[str, str]:
+    """Current (branch, commit-hash) of the working directory, if a repo."""
+    try:
+        output = subprocess.check_output(
+            "git log --format='%h/%as' -n1".split(), stderr=subprocess.DEVNULL
+        )
+        branch = (
+            subprocess.check_output(
+                "git rev-parse --abbrev-ref HEAD".split(), stderr=subprocess.DEVNULL
+            )
+            .decode()
+            .strip()
+        )
+        return branch, output.decode().strip().replace("'", "")
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return "unknown", "unknown"
+
+
+def infinite_loader(loader: Iterable) -> Iterator:
+    """Cycle a dataloader forever (reference ``infinite_dataloader:235``)."""
+    while True:
+        yield from loader
+
+
+# ---------------------------------------------------------------------------
+# Optimizer / scheduler factories (optax)
+# ---------------------------------------------------------------------------
+
+
+class OptimizerName(str, Enum):
+    ADAM = "adam"
+    ADAMW = "adamw"
+    ADAFACTOR = "adafactor"
+    LION = "lion"
+    SGD = "sgd"
+    # accepted for config compatibility with the reference's bnb option;
+    # maps to plain adamw (there is no 8-bit optimizer state on TPU yet)
+    ADAMW_8BIT_BNB = "adamw_8bit_bnb"
+
+
+class SchedulerName(str, Enum):
+    COSINE_ANNEALING = "cosine_annealing"
+    LINEAR = "linear"
+    CONSTANT = "constant"
+    WARMUP_COSINE = "warmup_cosine"
+
+
+def get_scheduler(name: str, kwargs: Dict[str, Any]) -> optax.Schedule:
+    """Build an optax schedule from a config name + kwargs.
+
+    ``cosine_annealing(T_max, eta_min)`` follows torch semantics used by the
+    reference configs; ``lr``/``init_value`` is the peak LR (taken from the
+    optimizer kwargs by the caller when absent here).
+    """
+    name = SchedulerName(name.lower())
+    kwargs = dict(kwargs)
+    lr = kwargs.pop("lr", None)
+    if name == SchedulerName.COSINE_ANNEALING:
+        t_max = int(kwargs.pop("T_max", 10_000))
+        eta_min = float(kwargs.pop("eta_min", 0.0))
+        if lr is None:
+            lr = eta_min
+        # torch CosineAnnealingLR: lr(t) = eta_min + (lr-eta_min)*(1+cos(pi t/T))/2
+        return lambda step: eta_min + (lr - eta_min) * 0.5 * (
+            1 + jnp.cos(jnp.pi * jnp.minimum(step, t_max) / t_max)
+        )
+    if name == SchedulerName.LINEAR:
+        if lr is None:
+            lr = kwargs.pop("init_value", None)
+        return optax.linear_schedule(
+            init_value=lr if lr is not None else kwargs.pop("start", 1e-4),
+            end_value=kwargs.pop("end_value", kwargs.pop("end", 0.0)),
+            transition_steps=int(kwargs.pop("total_steps", kwargs.pop("transition_steps", 10_000))),
+        )
+    if name == SchedulerName.CONSTANT:
+        if lr is None:
+            lr = kwargs.pop("init_value", None)
+        return optax.constant_schedule(lr if lr is not None else 1e-4)
+    if name == SchedulerName.WARMUP_COSINE:
+        # `init_value` here is the warmup *start* LR, distinct from the peak
+        # (`lr`/`peak_value`) — do not conflate the two.
+        peak = kwargs.pop("peak_value", lr)
+        return optax.warmup_cosine_decay_schedule(
+            init_value=kwargs.pop("init_value", 0.0),
+            peak_value=peak if peak is not None else 1e-4,
+            warmup_steps=int(kwargs.pop("warmup_steps", 100)),
+            decay_steps=int(kwargs.pop("decay_steps", 10_000)),
+            end_value=kwargs.pop("end_value", 0.0),
+        )
+    raise ValueError(f"Unknown scheduler {name}")
+
+
+def get_optimizer(
+    name: str,
+    kwargs: Dict[str, Any],
+    schedule: optax.Schedule = None,
+    mask: Any = None,
+) -> optax.GradientTransformation:
+    """Build an optax optimizer from a config name + kwargs.
+
+    ``mask`` (a pytree of bools matching params) freezes parameters the way
+    the reference does with ``requires_grad_`` (``trlx/utils/modeling.py:34-66``)
+    — masked-out leaves get ``optax.set_to_zero``.
+    """
+    name = OptimizerName(name.lower())
+    kwargs = dict(kwargs)
+    lr = kwargs.pop("lr", 1e-4)
+    learning_rate = schedule if schedule is not None else lr
+    betas = kwargs.pop("betas", None)
+    if betas is not None:
+        kwargs.setdefault("b1", betas[0])
+        kwargs.setdefault("b2", betas[1])
+
+    if name in (OptimizerName.ADAMW, OptimizerName.ADAMW_8BIT_BNB):
+        opt = optax.adamw(learning_rate, **kwargs)
+    elif name == OptimizerName.ADAM:
+        kwargs.pop("weight_decay", None)
+        opt = optax.adam(learning_rate, **kwargs)
+    elif name == OptimizerName.ADAFACTOR:
+        opt = optax.adafactor(learning_rate, **kwargs)
+    elif name == OptimizerName.LION:
+        opt = optax.lion(learning_rate, **kwargs)
+    elif name == OptimizerName.SGD:
+        opt = optax.sgd(learning_rate, **kwargs)
+    else:
+        raise ValueError(f"Unknown optimizer {name}")
+
+    if mask is not None:
+        opt = optax.multi_transform(
+            {True: opt, False: optax.set_to_zero()},
+            jax.tree_util.tree_map(bool, mask),
+        )
+    return opt

@@ -1,0 +1,94 @@
+"""Statistics helpers: masked moments, whitening, running moments, logprobs.
+
+Reference equivalents: ``trlx/utils/modeling.py`` — ``get_global_statistics:190``,
+``whiten:205``, ``logprobs_of_labels:218``, ``get_tensor_stats:243``,
+``RunningMoments:256``. The reference's explicit ``dist.all_reduce`` cross-rank
+reductions disappear here: under a global mesh the arrays are already global,
+so a plain ``jnp.mean`` *is* the distributed mean. ``RunningMoments`` runs
+host-side on the reward stream (the one inherently-host part of the pipeline).
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def masked_mean(xs: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+    if mask is None:
+        return jnp.mean(xs)
+    mask = mask.astype(xs.dtype)
+    return jnp.sum(xs * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def masked_var(xs: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+    mean = masked_mean(xs, mask)
+    return masked_mean(jnp.square(xs - mean), mask)
+
+
+def whiten(
+    xs: jax.Array, mask: Optional[jax.Array] = None, shift_mean: bool = True
+) -> jax.Array:
+    """Normalize to zero mean / unit variance (masked, globally under pjit)."""
+    mean = masked_mean(xs, mask)
+    var = masked_var(xs, mask)
+    whitened = (xs - mean) * jax.lax.rsqrt(var + 1e-8)
+    if not shift_mean:
+        whitened = whitened + mean
+    return whitened
+
+
+def logprobs_of_labels(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Log-probabilities of ``labels`` under ``logits``: [B, T, V],[B, T]→[B, T].
+
+    Matches reference semantics (``trlx/utils/modeling.py:218-226``): caller is
+    responsible for the one-position shift between logits and labels.
+    """
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logprobs, labels[..., None], axis=-1)[..., 0]
+
+
+def get_tensor_stats(xs: jax.Array, mask: jax.Array, n: jax.Array) -> dict:
+    """Mean/min/max/std of a masked tensor, as a flat dict of scalars."""
+    mean = jnp.sum(xs * mask) / n
+    minimum = jnp.min(jnp.where(mask > 0, xs, jnp.inf))
+    maximum = jnp.max(jnp.where(mask > 0, xs, -jnp.inf))
+    std = jnp.sqrt(jnp.sum(jnp.square(xs - mean) * mask) / jnp.maximum(n, 1.0))
+    return dict(mean=mean, min=minimum, max=maximum, std=std)
+
+
+class RunningMoments:
+    """Streaming mean/std over reward batches (Chan et al. parallel variance).
+
+    Host-side numpy; in multi-host runs pass the *globally gathered* rewards
+    (every host must fold identical statistics into the compiled program).
+    Reference: ``trlx/utils/modeling.py:256-288``.
+    """
+
+    def __init__(self):
+        self.mean = 0.0
+        self.std = 1.0
+        self.var = 1.0
+        self.count = 1e-24
+
+    def update(self, xs: np.ndarray) -> Tuple[float, float]:
+        """Fold a batch in; returns (batch_mean, batch_std-with-Bessel)."""
+        xs = np.asarray(xs, dtype=np.float64).reshape(-1)
+        xs_count = xs.size
+        xs_mean = float(xs.mean())
+        xs_var = float(xs.var())
+
+        delta = xs_mean - self.mean
+        tot_count = self.count + xs_count
+
+        new_sum = xs_var * xs_count
+        old_sum = self.var * self.count + delta**2 * self.count * xs_count / tot_count
+        tot_sum = old_sum + new_sum
+
+        self.mean += delta * xs_count / tot_count
+        self.var = tot_sum / tot_count
+        self.std = float(np.sqrt(self.var * tot_count / max(tot_count - 1, 1)))
+        self.count = tot_count
+
+        return xs_mean, float(np.sqrt(xs_var * xs_count / max(xs_count - 1, 1)))
