@@ -1,0 +1,198 @@
+"""Data-layer tests (shape of the reference's ``tests/test_pipelines.py``:
+property-based checks of dialogue tokenization + collation)."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from trlx_tpu.data.ppo_types import PPORLElement
+from trlx_tpu.data.tokenizer import ByteTokenizer, CharTokenizer, from_config
+from trlx_tpu.data.configs import TokenizerConfig
+from trlx_tpu.models.sft import IGNORE_INDEX
+from trlx_tpu.pipeline.offline_pipeline import (
+    DialogStore,
+    PromptPipeline,
+    pad_rows,
+    round_up,
+    tokenize_dialogue,
+)
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+
+TEXT = st.text(
+    alphabet=st.characters(codec="utf-8", exclude_characters=["<"]), min_size=0, max_size=40
+)
+
+
+@given(TEXT)
+@settings(max_examples=50, deadline=None)
+def test_byte_tokenizer_roundtrip(text):
+    tok = ByteTokenizer()
+    assert tok.decode(tok.encode(text)) == text
+
+
+def test_byte_tokenizer_specials():
+    tok = ByteTokenizer()
+    ids = tok.encode(f"hi{tok.eos_token}")
+    assert ids[-1] == tok.eos_token_id
+    assert tok.decode(ids) == "hi"
+    assert tok.decode(ids, skip_special_tokens=False).endswith(tok.eos_token)
+
+
+def test_char_tokenizer():
+    tok = CharTokenizer("abcd")
+    assert tok.encode("abba") == [0, 1, 1, 0]
+    assert tok.decode([3, 2]) == "dc"
+    with pytest.raises(ValueError):
+        tok.encode("xyz")
+
+
+def test_from_config_builtin():
+    assert isinstance(from_config(TokenizerConfig("builtin:bytes")), ByteTokenizer)
+    tok = from_config(TokenizerConfig("builtin:chars:xyz"))
+    assert isinstance(tok, CharTokenizer) and tok.vocab_size == 6
+
+
+@given(TEXT.filter(bool))
+@settings(max_examples=25, deadline=None)
+def test_tokenize_dialogue_single_string(text):
+    tok = ByteTokenizer()
+    msgs = tokenize_dialogue(text, tok, max_length=1024)
+    # bos prompt turn + output turn ending in eos
+    assert msgs[0].is_output is False
+    assert msgs[-1].is_output is True
+    assert msgs[-1].tokens[-1] == tok.eos_token_id
+    flat = [t for m in msgs if m.is_output for t in m.tokens]
+    assert tok.decode(flat) == text
+
+
+@given(st.integers(min_value=2, max_value=30))
+@settings(max_examples=25, deadline=None)
+def test_tokenize_dialogue_truncation_right(max_length):
+    tok = ByteTokenizer(truncation_side="right")
+    tok.truncation_side = "right"
+    msgs = tokenize_dialogue(["user: " + "a" * 30, "bot: " + "b" * 30], tok, max_length)
+    total = sum(len(m.tokens) for m in msgs)
+    assert total <= max_length
+    # right truncation keeps the beginning
+    first = msgs[0]
+    assert first.tokens[0] == tok.encode("u")[0]
+
+
+@given(st.integers(min_value=2, max_value=30))
+@settings(max_examples=25, deadline=None)
+def test_tokenize_dialogue_truncation_left(max_length):
+    tok = ByteTokenizer(truncation_side="left")
+    msgs = tokenize_dialogue(["user: " + "a" * 30, "bot: " + "b" * 30], tok, max_length)
+    total = sum(len(m.tokens) for m in msgs)
+    assert total <= max_length
+    # left truncation keeps the end (eos)
+    assert msgs[-1].tokens[-1] == tok.eos_token_id
+
+
+def test_tokenize_dialogue_multiturn_and_odd_raises():
+    tok = ByteTokenizer()
+    msgs = tokenize_dialogue(["q1", "a1", "q2", "a2"], tok, max_length=100)
+    assert [m.is_output for m in msgs] == [False, True, False, True]
+    with pytest.raises(ValueError):
+        tokenize_dialogue(["only", "two", "three"], tok, max_length=100)
+
+
+def test_dialog_store_masks_prompt_tokens():
+    tok = ByteTokenizer()
+    dialogs = [tokenize_dialogue(["ab", "cd"], tok, max_length=64)]
+    store = DialogStore(dialogs, tok)
+    loader = store.create_loader(batch_size=1, pad_multiple=8)
+    batch = next(iter(loader))
+    labels, ids = batch["labels"][0], batch["input_ids"][0]
+    n_prompt = 2
+    assert (labels[:n_prompt] == IGNORE_INDEX).all()
+    # output segment labels match ids
+    out_region = (labels != IGNORE_INDEX) & (batch["attention_mask"][0] > 0)
+    assert (labels[out_region] == ids[out_region]).all()
+    assert ids.shape[0] % 8 == 0
+
+
+def test_prompt_pipeline_truncates_and_left_pads():
+    tok = ByteTokenizer()
+    pipeline = PromptPipeline(["x" * 50, "short"], max_prompt_length=10, tokenizer=tok)
+    assert len(pipeline[0]["input_ids"]) == 10
+    loader = pipeline.create_loader(batch_size=2, pad_multiple=8)
+    batch = next(iter(loader))
+    assert batch["input_ids"].shape == (2, 16)
+    # left padding: real tokens at the end
+    assert batch["attention_mask"][1][-5:].all()
+    assert (batch["input_ids"][1][:-5] == tok.pad_token_id).all()
+    assert batch["text"] == ["x" * 50, "short"]
+
+
+def test_pad_rows_bucketing():
+    assert round_up(1, 8) == 8
+    assert round_up(8, 8) == 8
+    assert round_up(9, 8) == 16
+    out, mask = pad_rows([[1, 2, 3], [1]], 0, "right", 8)
+    assert out.shape == (2, 8)
+    assert mask.sum() == 4
+    out, _ = pad_rows([[1, 2, 3]], 0, "right", 8, fixed_length=32)
+    assert out.shape == (1, 32)
+
+
+def _fake_element(q, r, seed=0):
+    rng = np.random.RandomState(seed)
+    return PPORLElement(
+        query_tensor=np.arange(q, dtype=np.int32),
+        response_tensor=np.arange(r, dtype=np.int32) + 100,
+        logprobs=rng.randn(r).astype(np.float32),
+        values=rng.randn(r).astype(np.float32),
+        rewards=rng.randn(r).astype(np.float32),
+    )
+
+
+def test_ppo_rollout_storage_collate():
+    store = PPORolloutStorage(pad_token_id=0)
+    store.push([_fake_element(3, 5), _fake_element(6, 2)])
+    loader = store.create_loader(batch_size=2, pad_multiple=8)
+    batch = next(iter(loader))
+    assert batch.query_tensors.shape == (2, 8)
+    assert batch.response_tensors.shape == (2, 8)
+    assert batch.logprobs.shape == (2, 8)
+    # queries left-padded, responses right-padded
+    assert batch.query_mask[0][-3:].all() and not batch.query_mask[0][:5].any()
+    assert batch.response_mask[0][:5].all() and not batch.response_mask[0][5:].any()
+    # clear_history empties
+    store.clear_history()
+    assert len(store) == 0
+
+
+def test_ppo_rollout_storage_export(tmp_path):
+    store = PPORolloutStorage(pad_token_id=0)
+    store.push([_fake_element(2, 3)])
+    store.export_history(str(tmp_path))
+    import glob, json
+
+    files = glob.glob(str(tmp_path / "*.json"))
+    assert len(files) == 1
+    data = json.load(open(files[0]))
+    assert data[0]["query_tensor"] == [0, 1]
+
+
+def test_ilql_collate_shapes():
+    from trlx_tpu.data.ilql_types import ILQLElement
+    from trlx_tpu.pipeline.offline_pipeline import ilql_collate
+
+    def elem(t, a):
+        return ILQLElement(
+            input_ids=np.arange(t, dtype=np.int32),
+            attention_mask=np.ones(t, dtype=np.int32),
+            rewards=np.zeros(a, dtype=np.float32),
+            states_ixs=np.arange(a + 1, dtype=np.int32),
+            actions_ixs=np.arange(a, dtype=np.int32),
+            dones=np.ones(a + 1, dtype=np.int32),
+        )
+
+    batch = ilql_collate([elem(10, 4), elem(6, 2)], pad_multiple=8)
+    assert batch.input_ids.shape == (2, 16)
+    assert batch.rewards.shape == (2, 8)
+    assert batch.actions_ixs.shape == (2, 8)
+    assert batch.states_ixs.shape == (2, 9)
+    assert batch.dones.shape == (2, 9)

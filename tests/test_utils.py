@@ -94,3 +94,18 @@ def test_clock():
     clock = utils.Clock()
     clock.tick(10)
     assert clock.get_stat(1000) > 0
+
+
+def test_optimizer_betas_ignored_for_non_adam():
+    opt = utils.get_optimizer("sgd", {"lr": 1e-3, "betas": (0.9, 0.95), "weight_decay": 0.01, "eps": 1e-8})
+    params = {"w": jnp.ones(3)}
+    state = opt.init(params)
+    updates, _ = opt.update({"w": jnp.ones(3)}, state, params)
+    assert np.isfinite(np.asarray(updates["w"])).all()
+
+
+def test_scheduler_default_lr_from_optimizer():
+    sched = utils.get_scheduler("cosine_annealing", {"T_max": 100, "eta_min": 1e-6}, default_lr=1e-4)
+    assert float(sched(0)) == pytest.approx(1e-4)
+    with pytest.raises(ValueError):
+        utils.get_scheduler("cosine_annealing", {"T_max": 100, "eta_min": 1e-6})

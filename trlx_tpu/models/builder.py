@@ -1,0 +1,151 @@
+"""Model assembly: ModelConfig → (flax module, params).
+
+The reference's ``get_arch`` + ``PreTrainedModelWrapper.from_pretrained``
+(``trlx/trainer/accelerate_ppo_trainer.py:120-134``,
+``trlx/models/modeling_base.py:53-141``) equivalent: resolves a model spec
+(``builtin:<family>-<size>`` or a local HF checkpoint path), builds the
+appropriate wrapper module (plain / value-head / ILQL-heads), initializes or
+imports weights, and reports which params the hydra reference branch needs.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.configs import ModelConfig, ParallelConfig
+from trlx_tpu.models.heads import CausalLMWithILQLHeads, CausalLMWithValueHead
+from trlx_tpu.models.transformer import (
+    CausalTransformer,
+    TransformerConfig,
+    config_from_spec,
+)
+
+DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+def resolve_transformer_config(
+    model_config: ModelConfig, parallel: Optional[ParallelConfig] = None
+) -> Tuple[TransformerConfig, Optional[str]]:
+    """Resolve (TransformerConfig, hf_path or None) from a ModelConfig."""
+    import dataclasses
+
+    path = model_config.model_path
+    overrides: Dict[str, Any] = dict(model_config.model_extra_kwargs or {})
+    if parallel is not None:
+        overrides.setdefault("param_dtype", DTYPES[parallel.param_dtype])
+        overrides.setdefault("dtype", DTYPES[parallel.compute_dtype])
+        overrides.setdefault("remat", parallel.remat)
+        overrides.setdefault("scan_layers", parallel.scan_layers)
+
+    if path.startswith("builtin:"):
+        return config_from_spec(path, **overrides), None
+
+    from trlx_tpu.models.hf_interop import config_from_hf
+    from transformers import AutoConfig
+
+    cfg = config_from_hf(AutoConfig.from_pretrained(path))
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg, path
+
+
+def build_causal_lm(
+    model_config: ModelConfig,
+    parallel: Optional[ParallelConfig] = None,
+    head: Optional[str] = None,  # None | "value" | "ilql"
+    two_qs: bool = True,
+    seed: int = 0,
+) -> Tuple[Any, Dict[str, Any], TransformerConfig]:
+    """Build module + params. Pretrained weights (HF torch) replace the
+    backbone subtree; heads stay freshly initialized."""
+    tcfg, hf_path = resolve_transformer_config(model_config, parallel)
+
+    if head == "value":
+        module = CausalLMWithValueHead(tcfg)
+    elif head == "ilql":
+        module = CausalLMWithILQLHeads(tcfg, two_qs=two_qs)
+    else:
+        module = CausalTransformer(tcfg)
+
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((1, 8), jnp.int32)
+    params = module.init(rng, dummy)["params"]
+
+    if head == "ilql":
+        # target-Q heads start as exact copies of the Q heads (reference
+        # deepcopies them at init, modeling_ilql.py:154) — training toward
+        # fresh random targets would be noise until many Polyak syncs.
+        from trlx_tpu.models.heads import sync_target_q_params
+
+        params = sync_target_q_params(params, alpha=1.0)
+
+    if hf_path is not None:
+        from trlx_tpu.models.hf_interop import load_pretrained
+
+        hf_params, _ = load_pretrained(hf_path)
+        backbone = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, tcfg.param_dtype), hf_params["backbone"]
+        )
+        if head is None:
+            params = backbone
+        else:
+            params = dict(params)
+            params["backbone"] = backbone
+    return module, params, tcfg
+
+
+def hydra_ref_params(params: Dict[str, Any], tcfg: TransformerConfig, num_layers_unfrozen: int) -> Dict[str, Any]:
+    """Extract the frozen reference branch: top ``num_layers_unfrozen`` blocks
+    + final norm + lm head (+ tied embedding). A small pytree snapshot taken
+    at setup — the GSPMD analogue of the reference's deepcopy'd hydra heads
+    (``modeling_ppo.py:331-391``)."""
+    backbone = params["backbone"] if "backbone" in params else params
+    keep = {}
+    start = tcfg.num_layers - num_layers_unfrozen
+    for i in range(start, tcfg.num_layers):
+        keep[f"h_{i}"] = backbone[f"h_{i}"]
+    if tcfg.final_norm:
+        keep["ln_f"] = backbone["ln_f"]
+    if tcfg.tie_word_embeddings:
+        keep["wte"] = backbone["wte"]
+    else:
+        keep["lm_head"] = backbone["lm_head"]
+    return jax.tree_util.tree_map(lambda x: x, keep)  # shallow copy
+
+
+def trainable_mask(
+    params: Dict[str, Any], tcfg: TransformerConfig, num_layers_unfrozen: int
+) -> Dict[str, Any]:
+    """Bool pytree: True for trainable leaves. ``num_layers_unfrozen == -1``
+    trains everything; otherwise only the top-k blocks, final norm, lm head,
+    and any value/Q heads train (reference ``freeze_bottom_causal_layers``,
+    ``trlx/utils/modeling.py:34-44``). Target-Q heads never train."""
+
+    def mark(tree, value: bool):
+        return jax.tree_util.tree_map(lambda _: value, tree)
+
+    mask: Dict[str, Any] = {}
+    for top_key, subtree in params.items():
+        if top_key == "backbone":
+            sub = {}
+            for name, layer_tree in subtree.items():
+                if num_layers_unfrozen < 0:
+                    trainable = True
+                elif name.startswith("h_"):
+                    # only bottom blocks freeze; embeddings/norm/head stay
+                    # trainable (reference freeze_bottom_causal_layers,
+                    # trlx/utils/modeling.py:34-44)
+                    trainable = int(name[2:]) >= tcfg.num_layers - num_layers_unfrozen
+                else:
+                    trainable = True
+                sub[name] = mark(layer_tree, trainable)
+            mask[top_key] = sub
+        elif top_key == "ilql_heads":
+            mask[top_key] = {
+                name: mark(tree, not name.startswith("target_q_head"))
+                for name, tree in subtree.items()
+            }
+        else:
+            mask[top_key] = mark(subtree, True)
+    return mask

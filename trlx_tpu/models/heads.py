@@ -1,0 +1,158 @@
+"""Value / Q heads and LM wrapper modules.
+
+Reference equivalents: ``make_head`` MLP (``trlx/utils/modeling.py:25-31``),
+``AutoModelForCausalLMWithValueHead`` (``trlx/models/modeling_ppo.py:250-328``),
+``ILQLHeads`` (``trlx/models/modeling_ilql.py:135-193``).
+
+Target-Q heads are plain parameter subtrees: "frozen" means masked out of the
+optimizer (``trlx_tpu/utils.get_optimizer(mask=...)``), and the Polyak sync is
+a jitted ``tree_map`` over two subtrees — no module surgery needed.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.transformer import (
+    CausalTransformer,
+    TransformerConfig,
+    _dense,
+    param_with_axes,
+)
+
+
+class MLPHead(nn.Module):
+    """Two-layer MLP head: Linear(E→2E) → ReLU → Linear(2E→out)."""
+
+    config: TransformerConfig
+    out_features: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        h = _dense(cfg, 2 * cfg.hidden_size, True, ("embed", "mlp_head"), "in_proj")(x)
+        h = nn.relu(h)
+        # head outputs are tiny; compute in f32 for stable values/losses
+        out = nn.Dense(
+            self.out_features,
+            use_bias=True,
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            kernel_init=param_with_axes(nn.initializers.normal(0.02), ("mlp_head", "head_out")),
+            bias_init=param_with_axes(nn.initializers.zeros, ("head_out",)),
+            name="out_proj",
+        )(h)
+        return out
+
+
+class CausalLMWithValueHead(nn.Module):
+    """Policy LM + scalar value head on the final hidden states."""
+
+    config: TransformerConfig
+
+    def setup(self):
+        self.backbone = CausalTransformer(self.config, name="backbone")
+        self.v_head = MLPHead(self.config, 1, name="v_head")
+
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        positions: Optional[jax.Array] = None,
+        cache=None,
+        cache_index=None,
+        branch_layer: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        out = self.backbone(
+            input_ids,
+            attention_mask=attention_mask,
+            positions=positions,
+            cache=cache,
+            cache_index=cache_index,
+            branch_layer=branch_layer,
+        )
+        out["value"] = self.v_head(out["hidden_states"])[..., 0]
+        return out
+
+    def forward_branch(self, hidden_states, branch_layer, attention_mask=None, positions=None):
+        return self.backbone.forward_branch(hidden_states, branch_layer, attention_mask, positions)
+
+    def init_cache(self, batch_size, max_length, dtype=None):
+        return self.backbone.init_cache(batch_size, max_length, dtype)
+
+
+class ILQLHeadsModule(nn.Module):
+    """V head + n Q heads + n frozen target-Q heads over hidden states."""
+
+    config: TransformerConfig
+    two_qs: bool = True
+
+    def setup(self):
+        n_qs = 2 if self.two_qs else 1
+        self.v_head = MLPHead(self.config, 1, name="v_head")
+        self.q_heads = [
+            MLPHead(self.config, self.config.vocab_size, name=f"q_head_{i}") for i in range(n_qs)
+        ]
+        self.target_q_heads = [
+            MLPHead(self.config, self.config.vocab_size, name=f"target_q_head_{i}")
+            for i in range(n_qs)
+        ]
+
+    def __call__(self, hs: jax.Array) -> Tuple[Tuple[jax.Array, ...], Tuple[jax.Array, ...], jax.Array]:
+        qs = tuple(q(hs) for q in self.q_heads)
+        target_qs = tuple(jax.lax.stop_gradient(q(hs)) for q in self.target_q_heads)
+        vs = self.v_head(hs)
+        return qs, target_qs, vs
+
+
+class CausalLMWithILQLHeads(nn.Module):
+    """Policy LM + ILQL heads (V, twin Q, twin target-Q)."""
+
+    config: TransformerConfig
+    two_qs: bool = True
+
+    def setup(self):
+        self.backbone = CausalTransformer(self.config, name="backbone")
+        self.ilql_heads = ILQLHeadsModule(self.config, self.two_qs, name="ilql_heads")
+
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        positions: Optional[jax.Array] = None,
+        cache=None,
+        cache_index=None,
+    ) -> Dict[str, Any]:
+        out = self.backbone(
+            input_ids, attention_mask=attention_mask, positions=positions, cache=cache, cache_index=cache_index
+        )
+        qs, target_qs, vs = self.ilql_heads(out["hidden_states"])
+        out.update(qs=qs, target_qs=target_qs, vs=vs)
+        return out
+
+    def init_cache(self, batch_size, max_length, dtype=None):
+        return self.backbone.init_cache(batch_size, max_length, dtype)
+
+
+def sync_target_q_params(params: Dict[str, Any], alpha: float) -> Dict[str, Any]:
+    """Polyak update: target ← α·q + (1−α)·target.
+
+    ``params`` is the full model param tree containing ``ilql_heads`` with
+    ``q_head_i`` / ``target_q_head_i`` subtrees (reference semantics:
+    ``modeling_ilql.py:182-193``).
+    """
+    heads = params["ilql_heads"]
+    new_heads = dict(heads)
+    for name in heads:
+        if name.startswith("q_head_"):
+            target_name = "target_" + name
+            new_heads[target_name] = jax.tree_util.tree_map(
+                lambda q, t: alpha * q + (1.0 - alpha) * t,
+                heads[name],
+                heads[target_name],
+            )
+    out = dict(params)
+    out["ilql_heads"] = new_heads
+    return out

@@ -1,0 +1,361 @@
+"""HF (torch) checkpoint ⇄ trlx_tpu param-tree interop.
+
+The reference wraps HF torch modules directly; here HF checkpoints are
+*imported* into the native Flax parameter tree (and can be exported back via
+``params_to_hf_state_dict``) — the interop equivalent of the reference's
+sharded-checkpoint head merging (``trlx/models/modeling_base.py:142-184``,
+``modeling_ppo.py:306-328``).
+
+All converters are pure numpy: torch tensors → numpy → jax on first use.
+Torch ``nn.Linear`` weights are [out, in] and transpose to Flax's [in, out];
+GPT-2's Conv1D is already [in, out].
+"""
+
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+from trlx_tpu.models.transformer import TransformerConfig
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+def torch_state_dict_to_numpy(model) -> Dict[str, np.ndarray]:
+    return {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+
+
+def _ln(sd, prefix) -> Dict[str, np.ndarray]:
+    out = {"scale": sd[f"{prefix}.weight"]}
+    if f"{prefix}.bias" in sd:
+        out["bias"] = sd[f"{prefix}.bias"]
+    return out
+
+
+def _split_headmajor_qkv(w: np.ndarray, b, num_heads: int, head_dim: int):
+    """Split a fused qkv with head-major interleave ([H, 3, D, E] rows) into
+    q/k/v [E, H*D] kernels (+ biases). Used by GPT-NeoX and BLOOM."""
+    E = w.shape[1]
+    w = w.reshape(num_heads, 3, head_dim, E)
+    outs = []
+    for j in range(3):
+        kernel = _t(w[:, j].reshape(num_heads * head_dim, E))
+        bias = None
+        if b is not None:
+            bias = b.reshape(num_heads, 3, head_dim)[:, j].reshape(-1)
+        outs.append((kernel, bias))
+    return outs
+
+
+def _proj(kernel: np.ndarray, bias=None) -> Dict[str, np.ndarray]:
+    out = {"kernel": kernel}
+    if bias is not None:
+        out["bias"] = bias
+    return out
+
+
+def convert_gpt2(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict[str, Any]:
+    p = "transformer."
+    E = cfg.hidden_size
+    backbone: Dict[str, Any] = {
+        "wte": {"embedding": sd[p + "wte.weight"]},
+        "wpe": {"embedding": sd[p + "wpe.weight"]},
+        "ln_f": _ln(sd, p + "ln_f"),
+    }
+    for i in range(cfg.num_layers):
+        lp = f"{p}h.{i}."
+        w = sd[lp + "attn.c_attn.weight"]  # Conv1D [E, 3E]
+        b = sd[lp + "attn.c_attn.bias"]
+        q_w, k_w, v_w = w[:, :E], w[:, E : 2 * E], w[:, 2 * E :]
+        q_b, k_b, v_b = b[:E], b[E : 2 * E], b[2 * E :]
+        backbone[f"h_{i}"] = {
+            "ln_attn": _ln(sd, lp + "ln_1"),
+            "ln_mlp": _ln(sd, lp + "ln_2"),
+            "attn": {
+                "q_proj": _proj(q_w, q_b),
+                "k_proj": _proj(k_w, k_b),
+                "v_proj": _proj(v_w, v_b),
+                "o_proj": _proj(sd[lp + "attn.c_proj.weight"], sd[lp + "attn.c_proj.bias"]),
+            },
+            "mlp": {
+                "up_proj": _proj(sd[lp + "mlp.c_fc.weight"], sd[lp + "mlp.c_fc.bias"]),
+                "down_proj": _proj(sd[lp + "mlp.c_proj.weight"], sd[lp + "mlp.c_proj.bias"]),
+            },
+        }
+    return {"backbone": backbone}
+
+
+def convert_llama(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict[str, Any]:
+    p = "model."
+    backbone: Dict[str, Any] = {
+        "wte": {"embedding": sd[p + "embed_tokens.weight"]},
+        "ln_f": {"scale": sd[p + "norm.weight"]},
+        "lm_head": {"kernel": _t(sd["lm_head.weight"])},
+    }
+    for i in range(cfg.num_layers):
+        lp = f"{p}layers.{i}."
+        backbone[f"h_{i}"] = {
+            "ln_attn": {"scale": sd[lp + "input_layernorm.weight"]},
+            "ln_mlp": {"scale": sd[lp + "post_attention_layernorm.weight"]},
+            "attn": {
+                "q_proj": _proj(_t(sd[lp + "self_attn.q_proj.weight"])),
+                "k_proj": _proj(_t(sd[lp + "self_attn.k_proj.weight"])),
+                "v_proj": _proj(_t(sd[lp + "self_attn.v_proj.weight"])),
+                "o_proj": _proj(_t(sd[lp + "self_attn.o_proj.weight"])),
+            },
+            "mlp": {
+                "gate_proj": _proj(_t(sd[lp + "mlp.gate_proj.weight"])),
+                "up_proj": _proj(_t(sd[lp + "mlp.up_proj.weight"])),
+                "down_proj": _proj(_t(sd[lp + "mlp.down_proj.weight"])),
+            },
+        }
+    return {"backbone": backbone}
+
+
+def convert_gptneox(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict[str, Any]:
+    p = "gpt_neox."
+    D = cfg.dims_per_head
+    backbone: Dict[str, Any] = {
+        "wte": {"embedding": sd[p + "embed_in.weight"]},
+        "ln_f": _ln(sd, p + "final_layer_norm"),
+        "lm_head": {"kernel": _t(sd["embed_out.weight"])},
+    }
+    for i in range(cfg.num_layers):
+        lp = f"{p}layers.{i}."
+        (q_w, q_b), (k_w, k_b), (v_w, v_b) = _split_headmajor_qkv(
+            sd[lp + "attention.query_key_value.weight"],
+            sd.get(lp + "attention.query_key_value.bias"),
+            cfg.num_heads,
+            D,
+        )
+        backbone[f"h_{i}"] = {
+            "ln_attn": _ln(sd, lp + "input_layernorm"),
+            "ln_mlp": _ln(sd, lp + "post_attention_layernorm"),
+            "attn": {
+                "q_proj": _proj(q_w, q_b),
+                "k_proj": _proj(k_w, k_b),
+                "v_proj": _proj(v_w, v_b),
+                "o_proj": _proj(_t(sd[lp + "attention.dense.weight"]), sd[lp + "attention.dense.bias"]),
+            },
+            "mlp": {
+                "up_proj": _proj(_t(sd[lp + "mlp.dense_h_to_4h.weight"]), sd[lp + "mlp.dense_h_to_4h.bias"]),
+                "down_proj": _proj(_t(sd[lp + "mlp.dense_4h_to_h.weight"]), sd[lp + "mlp.dense_4h_to_h.bias"]),
+            },
+        }
+    return {"backbone": backbone}
+
+
+def convert_gptj(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict[str, Any]:
+    p = "transformer."
+    backbone: Dict[str, Any] = {
+        "wte": {"embedding": sd[p + "wte.weight"]},
+        "ln_f": _ln(sd, p + "ln_f"),
+        "lm_head": {"kernel": _t(sd["lm_head.weight"]), "bias": sd["lm_head.bias"]},
+    }
+    for i in range(cfg.num_layers):
+        lp = f"{p}h.{i}."
+        backbone[f"h_{i}"] = {
+            "ln_attn": _ln(sd, lp + "ln_1"),
+            "attn": {
+                "q_proj": _proj(_t(sd[lp + "attn.q_proj.weight"])),
+                "k_proj": _proj(_t(sd[lp + "attn.k_proj.weight"])),
+                "v_proj": _proj(_t(sd[lp + "attn.v_proj.weight"])),
+                "o_proj": _proj(_t(sd[lp + "attn.out_proj.weight"])),
+            },
+            "mlp": {
+                "up_proj": _proj(_t(sd[lp + "mlp.fc_in.weight"]), sd[lp + "mlp.fc_in.bias"]),
+                "down_proj": _proj(_t(sd[lp + "mlp.fc_out.weight"]), sd[lp + "mlp.fc_out.bias"]),
+            },
+        }
+    return {"backbone": backbone}
+
+
+def convert_opt(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict[str, Any]:
+    p = "model.decoder."
+    backbone: Dict[str, Any] = {
+        "wte": {"embedding": sd[p + "embed_tokens.weight"]},
+        "wpe": {"embedding": sd[p + "embed_positions.weight"]},
+        "ln_f": _ln(sd, p + "final_layer_norm"),
+    }
+    for i in range(cfg.num_layers):
+        lp = f"{p}layers.{i}."
+        backbone[f"h_{i}"] = {
+            "ln_attn": _ln(sd, lp + "self_attn_layer_norm"),
+            "ln_mlp": _ln(sd, lp + "final_layer_norm"),
+            "attn": {
+                "q_proj": _proj(_t(sd[lp + "self_attn.q_proj.weight"]), sd[lp + "self_attn.q_proj.bias"]),
+                "k_proj": _proj(_t(sd[lp + "self_attn.k_proj.weight"]), sd[lp + "self_attn.k_proj.bias"]),
+                "v_proj": _proj(_t(sd[lp + "self_attn.v_proj.weight"]), sd[lp + "self_attn.v_proj.bias"]),
+                "o_proj": _proj(_t(sd[lp + "self_attn.out_proj.weight"]), sd[lp + "self_attn.out_proj.bias"]),
+            },
+            "mlp": {
+                "up_proj": _proj(_t(sd[lp + "fc1.weight"]), sd[lp + "fc1.bias"]),
+                "down_proj": _proj(_t(sd[lp + "fc2.weight"]), sd[lp + "fc2.bias"]),
+            },
+        }
+    return {"backbone": backbone}
+
+
+def convert_bloom(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict[str, Any]:
+    p = "transformer."
+    D = cfg.dims_per_head
+    backbone: Dict[str, Any] = {
+        "wte": {"embedding": sd[p + "word_embeddings.weight"]},
+        "emb_ln": _ln(sd, p + "word_embeddings_layernorm"),
+        "ln_f": _ln(sd, p + "ln_f"),
+    }
+    for i in range(cfg.num_layers):
+        lp = f"{p}h.{i}."
+        (q_w, q_b), (k_w, k_b), (v_w, v_b) = _split_headmajor_qkv(
+            sd[lp + "self_attention.query_key_value.weight"],
+            sd.get(lp + "self_attention.query_key_value.bias"),
+            cfg.num_heads,
+            D,
+        )
+        backbone[f"h_{i}"] = {
+            "ln_attn": _ln(sd, lp + "input_layernorm"),
+            "ln_mlp": _ln(sd, lp + "post_attention_layernorm"),
+            "attn": {
+                "q_proj": _proj(q_w, q_b),
+                "k_proj": _proj(k_w, k_b),
+                "v_proj": _proj(v_w, v_b),
+                "o_proj": _proj(
+                    _t(sd[lp + "self_attention.dense.weight"]), sd[lp + "self_attention.dense.bias"]
+                ),
+            },
+            "mlp": {
+                "up_proj": _proj(_t(sd[lp + "mlp.dense_h_to_4h.weight"]), sd[lp + "mlp.dense_h_to_4h.bias"]),
+                "down_proj": _proj(_t(sd[lp + "mlp.dense_4h_to_h.weight"]), sd[lp + "mlp.dense_4h_to_h.bias"]),
+            },
+        }
+    return {"backbone": backbone}
+
+
+CONVERTERS: Dict[str, Callable] = {
+    "gpt2": convert_gpt2,
+    "llama": convert_llama,
+    "gpt_neox": convert_gptneox,
+    "gptj": convert_gptj,
+    "opt": convert_opt,
+    "bloom": convert_bloom,
+}
+
+
+def config_from_hf(hf_config) -> TransformerConfig:
+    """Map a transformers config object to a :class:`TransformerConfig`."""
+    mt = hf_config.model_type
+    if mt == "gpt2":
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head,
+            intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
+            max_position_embeddings=hf_config.n_positions,
+            position_scheme="learned",
+            activation="gelu_new",
+            layer_norm_epsilon=hf_config.layer_norm_epsilon,
+        )
+    if mt == "llama":
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+            intermediate_size=hf_config.intermediate_size,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            position_scheme="rotary",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            norm="rmsnorm",
+            layer_norm_epsilon=hf_config.rms_norm_eps,
+            activation="silu",
+            attn_bias=False,
+            mlp_bias=False,
+            tie_word_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        )
+    if mt == "gpt_neox":
+        head_dim = hf_config.hidden_size // hf_config.num_attention_heads
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            intermediate_size=hf_config.intermediate_size,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            position_scheme="rotary",
+            rotary_dim=int(head_dim * hf_config.rotary_pct),
+            rope_theta=getattr(hf_config, "rotary_emb_base", 10000.0),
+            activation="gelu",
+            parallel_residual=bool(hf_config.use_parallel_residual),
+            shared_ln=False,
+            layer_norm_epsilon=hf_config.layer_norm_eps,
+            tie_word_embeddings=False,
+        )
+    if mt == "gptj":
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head,
+            intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
+            max_position_embeddings=hf_config.n_positions,
+            position_scheme="rotary",
+            rotary_dim=hf_config.rotary_dim,
+            activation="gelu_new",
+            parallel_residual=True,
+            shared_ln=True,
+            attn_bias=False,
+            qkv_bias=False,
+            mlp_bias=True,
+            layer_norm_epsilon=hf_config.layer_norm_epsilon,
+            tie_word_embeddings=False,
+            lm_head_bias=True,
+        )
+    if mt == "opt":
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            intermediate_size=hf_config.ffn_dim,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            position_scheme="learned",
+            pos_offset=2,
+            activation=hf_config.activation_function,
+            tie_word_embeddings=True,
+        )
+    if mt == "bloom":
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head,
+            intermediate_size=4 * hf_config.hidden_size,
+            max_position_embeddings=2048,
+            position_scheme="alibi",
+            activation="gelu",
+            embedding_layernorm=True,
+            layer_norm_epsilon=hf_config.layer_norm_epsilon,
+            tie_word_embeddings=True,
+        )
+    raise ValueError(f"Unsupported HF model type for causal import: {mt}")
+
+
+def params_from_hf(model, cfg: TransformerConfig = None) -> Tuple[Dict[str, Any], TransformerConfig]:
+    """Convert a loaded HF torch model into (params, config)."""
+    if cfg is None:
+        cfg = config_from_hf(model.config)
+    sd = torch_state_dict_to_numpy(model)
+    converter = CONVERTERS[model.config.model_type]
+    return converter(sd, cfg), cfg
+
+
+def load_pretrained(path: str) -> Tuple[Dict[str, Any], TransformerConfig]:
+    """Load an HF checkpoint from a local path into (params, config)."""
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    hf_config = AutoConfig.from_pretrained(path)
+    model = AutoModelForCausalLM.from_pretrained(path)
+    return params_from_hf(model, config_from_hf(hf_config))

@@ -199,10 +199,11 @@ def kl_penalty_rewards(
     """Per-token rewards = −β·(logπ − logπ_ref), with the scalar task score
     added at each sample's final response token.
 
-    Returns ``(rewards [B, R], (mean_sequence_kl, mean_per_token_kl))`` —
-    the first KL is the mean over samples of the summed per-token KL (what
-    the adaptive controller consumes), the second a per-token mean for stats.
-    Reference: ``accelerate_ppo_trainer.py:431-461``.
+    Returns ``(rewards [B, R], (mean_kl, mean_kl_per_sequence))``:
+    ``mean_kl`` is the per-token mean of the k3 estimator over the whole
+    [B, R] block — exactly what the reference feeds the adaptive KL
+    controller (``accelerate_ppo_trainer.py:431-461``); the per-sequence
+    mean (sum over tokens, mean over samples) is reported in stats.
     """
     mask = response_mask.astype(jnp.float32)
     log_ratio = (logprobs - ref_logprobs) * mask
@@ -210,8 +211,8 @@ def kl_penalty_rewards(
     # index of last real token per row: sum(mask)-1 (clipped for empty rows)
     ends = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
     rewards = rewards.at[jnp.arange(rewards.shape[0]), ends].add(scores)
-    # mean over samples of summed per-token KL (k1-style, matching reference)
     ratio = jnp.exp(log_ratio)
-    mean_kl_per_token = jnp.mean((ratio - 1) - log_ratio)
-    mean_kl = jnp.mean(jnp.sum(((ratio - 1) - log_ratio) * mask, axis=1))
-    return rewards * mask, (mean_kl, mean_kl_per_token)
+    k3 = (ratio - 1) - log_ratio
+    mean_kl = jnp.mean(k3)  # per-token mean (controller input)
+    mean_kl_per_seq = jnp.mean(jnp.sum(k3 * mask, axis=1))
+    return rewards * mask, (mean_kl, mean_kl_per_seq)

@@ -1,0 +1,586 @@
+"""TPU-native causal transformer backbone (Flax linen).
+
+One configurable decoder covers the reference's supported causal families —
+GPT-2, GPT-J, GPT-NeoX/Pythia, OPT, BLOOM, LLaMA (reference wraps HF models:
+``trlx/models/modeling_ppo.py:429-946``) — via architecture flags (positional
+scheme, norm type, activation, parallel-residual, biases, GQA).
+
+TPU-first design decisions:
+- every weight carries **logical axis names** (``nn.with_logical_partitioning``)
+  so one set of sharding rules (``trlx_tpu/parallel``) maps the whole model
+  onto a ``(data, fsdp, model, sequence)`` mesh — the GSPMD equivalent of
+  Megatron TP/SP in the reference's NeMo backend;
+- **explicit functional KV cache** (a pytree threaded through the decode
+  loop) instead of stateful modules, so generation is one compiled
+  ``lax.while_loop`` program;
+- static shapes everywhere: padding is handled by masks, positions are
+  computed from the mask (left-padded prompts attend correctly);
+- optional ``remat`` and ``scan_layers`` for memory/compile scaling.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def param_with_axes(init: Callable, axes: Tuple[str, ...]) -> Callable:
+    """Logical axes of each parameter are derived from its *path* by the rule
+    table in ``trlx_tpu/parallel/sharding.py`` (path-based, à la t5x), so the
+    param tree stays plain jax arrays (no flax Partitioned boxes) — plain
+    trees keep the optimizer, HF interop, and checkpoint layers trivial. The
+    ``axes`` argument documents intent at the definition site and is asserted
+    against the rule table in tests."""
+    del axes
+    return init
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Architecture description of a causal decoder-only transformer."""
+
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    intermediate_size: int
+    max_position_embeddings: int = 2048
+    num_kv_heads: Optional[int] = None  # < num_heads → grouped-query attention
+    head_dim: Optional[int] = None
+
+    position_scheme: str = "learned"  # learned | rotary | alibi
+    pos_offset: int = 0  # OPT stores positions with an offset of 2
+    rotary_dim: Optional[int] = None  # partial rotary (gptj/neox); None = full
+    rope_theta: float = 10000.0
+
+    norm: str = "layernorm"  # layernorm | rmsnorm
+    layer_norm_epsilon: float = 1e-5
+    activation: str = "gelu_new"  # gelu_new | gelu | silu | relu
+    parallel_residual: bool = False  # gptj/neox style
+    shared_ln: bool = False  # gptj: one LN feeds both attn and mlp
+    attn_bias: bool = True
+    mlp_bias: bool = True
+    qkv_bias: Optional[bool] = None  # overrides attn_bias for q/k/v if set
+    tie_word_embeddings: bool = True
+    final_norm: bool = True
+    embedding_layernorm: bool = False  # bloom has a LN after word embeddings
+    lm_head_bias: bool = False  # gptj has a bias on the lm head
+
+    # numerics / compilation
+    param_dtype: Any = jnp.float32
+    dtype: Any = jnp.bfloat16  # activation/compute dtype
+    remat: str = "none"  # none | minimal | full
+    scan_layers: bool = False
+    # attention implementation: "xla" (dot-product, XLA-fused) or "pallas"
+    # (flash attention kernel; falls back to xla off-TPU)
+    attention_impl: str = "xla"
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def dims_per_head(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    # ---- family presets (sizes per the public model cards) ----
+
+    @staticmethod
+    def gpt2(size: str = "small", **overrides) -> "TransformerConfig":
+        dims = {
+            "test": dict(vocab_size=259, hidden_size=64, num_layers=2, num_heads=4, intermediate_size=256, max_position_embeddings=128),
+            "small": dict(vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12, intermediate_size=3072, max_position_embeddings=1024),
+            "medium": dict(vocab_size=50257, hidden_size=1024, num_layers=24, num_heads=16, intermediate_size=4096, max_position_embeddings=1024),
+            "large": dict(vocab_size=50257, hidden_size=1280, num_layers=36, num_heads=20, intermediate_size=5120, max_position_embeddings=1024),
+            "xl": dict(vocab_size=50257, hidden_size=1600, num_layers=48, num_heads=25, intermediate_size=6400, max_position_embeddings=1024),
+        }[size]
+        return _make_preset(
+            dims,
+            overrides,
+            position_scheme="learned",
+            norm="layernorm",
+            activation="gelu_new",
+            tie_word_embeddings=True,
+        )
+
+    @staticmethod
+    def llama(size: str = "7b", **overrides) -> "TransformerConfig":
+        dims = {
+            "test": dict(vocab_size=259, hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2, intermediate_size=128, max_position_embeddings=128),
+            "7b": dict(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32, intermediate_size=11008, max_position_embeddings=2048),
+            "13b": dict(vocab_size=32000, hidden_size=5120, num_layers=40, num_heads=40, intermediate_size=13824, max_position_embeddings=2048),
+            "65b": dict(vocab_size=32000, hidden_size=8192, num_layers=80, num_heads=64, intermediate_size=22016, max_position_embeddings=2048),
+        }[size]
+        return _make_preset(
+            dims,
+            overrides,
+            position_scheme="rotary",
+            norm="rmsnorm",
+            layer_norm_epsilon=1e-6,
+            activation="silu",
+            attn_bias=False,
+            mlp_bias=False,
+            tie_word_embeddings=False,
+        )
+
+    @staticmethod
+    def gptj(size: str = "6b", **overrides) -> "TransformerConfig":
+        dims = {
+            "test": dict(vocab_size=259, hidden_size=64, num_layers=2, num_heads=4, intermediate_size=256, max_position_embeddings=128),
+            "6b": dict(vocab_size=50400, hidden_size=4096, num_layers=28, num_heads=16, intermediate_size=16384, max_position_embeddings=2048),
+        }[size]
+        return _make_preset(
+            dims,
+            overrides,
+            position_scheme="rotary",
+            rotary_dim=64 if size != "test" else 8,
+            norm="layernorm",
+            activation="gelu_new",
+            parallel_residual=True,
+            shared_ln=True,
+            attn_bias=False,
+            qkv_bias=False,
+            mlp_bias=True,
+            tie_word_embeddings=False,
+            lm_head_bias=True,
+        )
+
+    @staticmethod
+    def gptneox(size: str = "160m", **overrides) -> "TransformerConfig":
+        dims = {
+            "test": dict(vocab_size=259, hidden_size=64, num_layers=2, num_heads=4, intermediate_size=256, max_position_embeddings=128),
+            "160m": dict(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12, intermediate_size=3072, max_position_embeddings=2048),
+            "1.4b": dict(vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16, intermediate_size=8192, max_position_embeddings=2048),
+            "6.9b": dict(vocab_size=50432, hidden_size=4096, num_layers=32, num_heads=32, intermediate_size=16384, max_position_embeddings=2048),
+            "20b": dict(vocab_size=50432, hidden_size=6144, num_layers=44, num_heads=64, intermediate_size=24576, max_position_embeddings=2048),
+        }[size]
+        return _make_preset(
+            dims,
+            overrides,
+            position_scheme="rotary",
+            rotary_dim=(dims["hidden_size"] // dims["num_heads"]) // 4 if size != "test" else 4,
+            norm="layernorm",
+            activation="gelu",
+            parallel_residual=True,
+            shared_ln=False,
+            attn_bias=True,
+            mlp_bias=True,
+            tie_word_embeddings=False,
+        )
+
+    @staticmethod
+    def opt(size: str = "125m", **overrides) -> "TransformerConfig":
+        dims = {
+            "test": dict(vocab_size=259, hidden_size=64, num_layers=2, num_heads=4, intermediate_size=256, max_position_embeddings=128),
+            "125m": dict(vocab_size=50272, hidden_size=768, num_layers=12, num_heads=12, intermediate_size=3072, max_position_embeddings=2048),
+            "6.7b": dict(vocab_size=50272, hidden_size=4096, num_layers=32, num_heads=32, intermediate_size=16384, max_position_embeddings=2048),
+        }[size]
+        return _make_preset(
+            dims,
+            overrides,
+            position_scheme="learned",
+            pos_offset=2,
+            norm="layernorm",
+            activation="relu",
+            tie_word_embeddings=True,
+        )
+
+    @staticmethod
+    def bloom(size: str = "560m", **overrides) -> "TransformerConfig":
+        dims = {
+            "test": dict(vocab_size=259, hidden_size=64, num_layers=2, num_heads=4, intermediate_size=256, max_position_embeddings=128),
+            "560m": dict(vocab_size=250880, hidden_size=1024, num_layers=24, num_heads=16, intermediate_size=4096, max_position_embeddings=2048),
+        }[size]
+        return _make_preset(
+            dims,
+            overrides,
+            position_scheme="alibi",
+            norm="layernorm",
+            activation="gelu",
+            embedding_layernorm=True,
+            tie_word_embeddings=True,
+        )
+
+
+
+def _make_preset(dims: dict, overrides: dict, **flags) -> "TransformerConfig":
+    """Build a preset config: dims + family flags, with ``overrides`` able to
+    replace ANY field (dimension or architecture flag) without conflicts."""
+    base = {**dims, **flags}
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+def get_activation(name: str) -> Callable:
+    return {
+        "gelu_new": partial(nn.gelu, approximate=True),
+        "gelu": partial(nn.gelu, approximate=False),
+        "silu": nn.silu,
+        "relu": nn.relu,
+    }[name]
+
+
+# ---------------------------------------------------------------------------
+# Positional encodings
+# ---------------------------------------------------------------------------
+
+
+def rotary_sin_cos(positions: jax.Array, dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    """sin/cos tables for RoPE at integer ``positions`` [B, T] → [B, T, dim/2]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    freqs = positions[..., None].astype(jnp.float32) * inv_freq  # [B, T, dim/2]
+    return jnp.sin(freqs), jnp.cos(freqs)
+
+
+def apply_rotary(x: jax.Array, sin: jax.Array, cos: jax.Array, rotary_dim: int, neox_style: bool) -> jax.Array:
+    """Apply RoPE to the first ``rotary_dim`` dims of x [B, T, H, D].
+
+    ``neox_style=True`` rotates split halves (llama/neox); False rotates
+    interleaved even/odd pairs (gptj).
+    """
+    x_rot, x_pass = x[..., :rotary_dim], x[..., rotary_dim:]
+    sin = sin[:, :, None, :]  # [B, T, 1, dim/2]
+    cos = cos[:, :, None, :]
+    if neox_style:
+        half = rotary_dim // 2
+        x1, x2 = x_rot[..., :half], x_rot[..., half:]
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    else:
+        x1, x2 = x_rot[..., ::2], x_rot[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        out = jnp.stack([r1, r2], axis=-1).reshape(x_rot.shape)
+    return jnp.concatenate([out, x_pass], axis=-1).astype(x.dtype)
+
+
+def alibi_slopes(num_heads: int) -> np.ndarray:
+    """ALiBi per-head slopes (Press et al.), matching the BLOOM recipe."""
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return start * (start ** np.arange(n))
+
+    if np.log2(num_heads).is_integer():
+        return pow2_slopes(num_heads)
+    closest = 2 ** int(np.floor(np.log2(num_heads)))
+    base = pow2_slopes(closest)
+    extra = pow2_slopes(2 * closest)[0::2][: num_heads - closest]
+    return np.concatenate([base, extra])
+
+
+# ---------------------------------------------------------------------------
+# Modules
+# ---------------------------------------------------------------------------
+
+
+def Norm(config: TransformerConfig, name: str):
+    """LayerNorm/RMSNorm with params directly at ``<name>/{scale,bias}``."""
+    cls = nn.RMSNorm if config.norm == "rmsnorm" else nn.LayerNorm
+    kwargs = {}
+    if config.norm != "rmsnorm":
+        kwargs["bias_init"] = param_with_axes(nn.initializers.zeros, ("embed",))
+    return cls(
+        epsilon=config.layer_norm_epsilon,
+        dtype=config.dtype,
+        param_dtype=config.param_dtype,
+        scale_init=param_with_axes(nn.initializers.ones, ("embed",)),
+        name=name,
+        **kwargs,
+    )
+
+
+def _dense(cfg, features, use_bias, kernel_axes, name=None):
+    return nn.Dense(
+        features,
+        use_bias=use_bias,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=param_with_axes(nn.initializers.normal(0.02), kernel_axes),
+        bias_init=param_with_axes(nn.initializers.zeros, (kernel_axes[-1],)),
+        name=name,
+    )
+
+
+class Attention(nn.Module):
+    """Multi-head / grouped-query attention with RoPE/ALiBi and an explicit
+    KV cache ({"k","v"} arrays [B, S, kvH, D] written at ``cache_index``)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,  # [B, T, E]
+        attention_bias: jax.Array,  # [B, 1, T, S] additive
+        positions: jax.Array,  # [B, T]
+        cache: Optional[Dict[str, jax.Array]] = None,
+        cache_index: Optional[jax.Array] = None,
+    ):
+        cfg = self.config
+        B, T, _ = x.shape
+        H, KV, D = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
+        qkv_bias = cfg.attn_bias if cfg.qkv_bias is None else cfg.qkv_bias
+
+        q = _dense(cfg, H * D, qkv_bias, ("embed", "joined_kv"), "q_proj")(x).reshape(B, T, H, D)
+        k = _dense(cfg, KV * D, qkv_bias, ("embed", "joined_kv"), "k_proj")(x).reshape(B, T, KV, D)
+        v = _dense(cfg, KV * D, qkv_bias, ("embed", "joined_kv"), "v_proj")(x).reshape(B, T, KV, D)
+
+        if cfg.position_scheme == "rotary":
+            rdim = cfg.rotary_dim or D
+            sin, cos = rotary_sin_cos(positions, rdim, cfg.rope_theta)
+            neox = cfg.norm == "rmsnorm" or not cfg.shared_ln  # llama/neox vs gptj
+            q = apply_rotary(q, sin, cos, rdim, neox)
+            k = apply_rotary(k, sin, cos, rdim, neox)
+
+        new_cache = None
+        if cache is not None:
+            # decode: write this step's k/v into the cache at cache_index
+            k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, cache_index, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, cache_index, 0, 0))
+            k, v = k_cache, v_cache
+            new_cache = {"k": k_cache, "v": v_cache}
+
+        if KV < H:
+            reps = H // KV
+            k = jnp.repeat(k, reps, axis=2)
+            v = jnp.repeat(v, reps, axis=2)
+
+        depth = jnp.asarray(D, cfg.dtype)
+        scores = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(depth)
+        scores = scores + attention_bias.astype(scores.dtype)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, H * D)
+        out = _dense(cfg, cfg.hidden_size, cfg.attn_bias, ("joined_kv", "embed"), "o_proj")(out)
+        return out, new_cache
+
+
+class MLP(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        act = get_activation(cfg.activation)
+        if cfg.activation == "silu":  # gated (llama-style) MLP
+            gate = _dense(cfg, cfg.intermediate_size, cfg.mlp_bias, ("embed", "ffn"), "gate_proj")(x)
+            up = _dense(cfg, cfg.intermediate_size, cfg.mlp_bias, ("embed", "ffn"), "up_proj")(x)
+            h = act(gate) * up
+        else:
+            h = act(_dense(cfg, cfg.intermediate_size, cfg.mlp_bias, ("embed", "ffn"), "up_proj")(x))
+        return _dense(cfg, cfg.hidden_size, cfg.mlp_bias, ("ffn", "embed"), "down_proj")(h)
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, attention_bias, positions, cache=None, cache_index=None):
+        cfg = self.config
+        h = Norm(cfg, name="ln_attn")(x)
+        attn_out, new_cache = Attention(cfg, name="attn")(h, attention_bias, positions, cache, cache_index)
+        if cfg.parallel_residual:
+            mlp_in = h if cfg.shared_ln else Norm(cfg, name="ln_mlp")(x)
+            x = x + attn_out + MLP(cfg, name="mlp")(mlp_in)
+        else:
+            x = x + attn_out
+            h = Norm(cfg, name="ln_mlp")(x)
+            x = x + MLP(cfg, name="mlp")(h)
+        return x, new_cache
+
+
+class CausalTransformer(nn.Module):
+    """Decoder-only LM. Methods:
+
+    - ``__call__``: full forward → logits (+ final hidden, + intermediate
+      hidden at ``branch_layer`` for the hydra reference branch, + updated
+      cache during decode).
+    - ``forward_branch``: run the top layers from ``branch_layer`` on given
+      hidden states (the frozen-reference replay; reference hydra semantics,
+      ``trlx/models/modeling_ppo.py:331-427``).
+    """
+
+    config: TransformerConfig
+
+    def setup(self):
+        cfg = self.config
+        self.wte = nn.Embed(
+            cfg.vocab_size,
+            cfg.hidden_size,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            embedding_init=param_with_axes(nn.initializers.normal(0.02), ("vocab", "embed")),
+            name="wte",
+        )
+        if cfg.position_scheme == "learned":
+            self.wpe = nn.Embed(
+                cfg.max_position_embeddings + cfg.pos_offset,
+                cfg.hidden_size,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                embedding_init=param_with_axes(nn.initializers.normal(0.02), ("seq", "embed")),
+                name="wpe",
+            )
+        if cfg.embedding_layernorm:
+            self.emb_ln = Norm(cfg, name="emb_ln")
+        block = Block
+        if cfg.remat == "full":
+            block = nn.remat(Block, static_argnums=())
+        self.blocks = [block(cfg, name=f"h_{i}") for i in range(cfg.num_layers)]
+        if cfg.final_norm:
+            self.ln_f = Norm(cfg, name="ln_f")
+        if not cfg.tie_word_embeddings:
+            self.lm_head = _dense(cfg, cfg.vocab_size, cfg.lm_head_bias, ("embed", "vocab"), "lm_head")
+
+    def _logits(self, h):
+        cfg = self.config
+        if cfg.tie_word_embeddings:
+            return self.wte.attend(h)
+        return self.lm_head(h)
+
+    def _embed(self, input_ids, positions):
+        cfg = self.config
+        x = self.wte(input_ids)
+        if cfg.position_scheme == "learned":
+            x = x + self.wpe(positions + cfg.pos_offset)
+        if cfg.embedding_layernorm:
+            x = self.emb_ln(x)
+        return x
+
+    def _attention_bias(self, key_mask, query_slots, query_positions):
+        """Additive [B, 1, T, S] bias over key *slots*: slot-causal + padding
+        (+ ALiBi on true token positions).
+
+        Slots are laid out in input order (prompt slots first, generated slots
+        after), so slot-causality ``key_slot <= query_slot`` IS temporal
+        causality, for full passes (slots ≡ positions), cache prefill, and
+        single-token decode alike. ``key_mask`` [B, S] marks written, non-pad
+        slots; positions of key slots are recovered as ``cumsum(mask)-1``
+        (left-padded prompts thus attend with correct relative distances).
+        """
+        cfg = self.config
+        S = key_mask.shape[1]
+        key_slots = jnp.arange(S)[None, None, :]  # [1, 1, S]
+        visible = (key_slots <= query_slots[:, :, None]) & (key_mask[:, None, :] > 0)
+        bias = jnp.where(visible[:, None, :, :], 0.0, -1e9)
+        if cfg.position_scheme == "alibi":
+            slopes = jnp.asarray(alibi_slopes(cfg.num_heads), dtype=jnp.float32)
+            key_pos = jnp.maximum(jnp.cumsum(key_mask, axis=1) - 1, 0)  # [B, S]
+            dist = (key_pos[:, None, :] - query_positions[:, :, None]).astype(jnp.float32)
+            alibi = slopes[None, :, None, None] * dist[:, None, :, :]
+            bias = bias + jnp.where(visible[:, None, :, :], alibi, 0.0)
+        return bias
+
+    def __call__(
+        self,
+        input_ids: jax.Array,  # [B, T]
+        attention_mask: Optional[jax.Array] = None,  # [B, T] (or [B, S] in decode)
+        positions: Optional[jax.Array] = None,  # [B, T]
+        cache: Optional[List[Dict[str, jax.Array]]] = None,
+        cache_index: Optional[jax.Array] = None,
+        branch_layer: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        cfg = self.config
+        B, T = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, T), jnp.int32)
+        if cache is None:
+            # full pass: key slots are the input sequence itself
+            query_slots = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+            if positions is None:
+                positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+        else:
+            # attention_mask is the [B, S] slot mask over the whole cache;
+            # queries occupy slots [cache_index, cache_index + T)
+            offset = cache_index if cache_index is not None else 0
+            query_slots = offset + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+            if positions is None:
+                key_pos = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+                positions = jax.vmap(lambda kp, qs: kp[qs])(key_pos, query_slots)
+
+        x = self._embed(input_ids, positions)
+        bias = self._attention_bias(attention_mask, query_slots, positions)
+
+        branch_input = None
+        new_cache = [] if cache is not None else None
+        for i, block in enumerate(self.blocks):
+            if branch_layer is not None and i == len(self.blocks) - branch_layer:
+                branch_input = x
+            layer_cache = cache[i] if cache is not None else None
+            x, updated = block(x, bias, positions, layer_cache, cache_index)
+            if cache is not None:
+                new_cache.append(updated)
+
+        if cfg.final_norm:
+            h = self.ln_f(x)
+        else:
+            h = x
+        logits = self._logits(h)
+        return {
+            "logits": logits,
+            "hidden_states": h,
+            "pre_norm_hidden": x,
+            "branch_input": branch_input,
+            "cache": new_cache,
+        }
+
+    def forward_branch(
+        self,
+        hidden_states: jax.Array,  # [B, T, E] activations entering the branch
+        branch_layer: int,
+        attention_mask: Optional[jax.Array] = None,
+        positions: Optional[jax.Array] = None,
+    ) -> Dict[str, Any]:
+        """Run the top ``branch_layer`` blocks + final norm + lm head.
+
+        Applied with *frozen reference params* this replays the hydra branch
+        on trunk activations shared with the policy — the reference's
+        second-model-free KL baseline (``modeling_ppo.py:394-427``).
+        """
+        cfg = self.config
+        B, T, _ = hidden_states.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, T), jnp.int32)
+        if positions is None:
+            positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+        query_slots = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        bias = self._attention_bias(attention_mask, query_slots, positions)
+        x = hidden_states
+        for block in self.blocks[len(self.blocks) - branch_layer :]:
+            x, _ = block(x, bias, positions)
+        h = self.ln_f(x) if cfg.final_norm else x
+        return {"logits": self._logits(h), "hidden_states": h}
+
+    def init_cache(self, batch_size: int, max_length: int, dtype=None) -> List[Dict[str, jax.Array]]:
+        """Allocate an all-zeros KV cache pytree."""
+        cfg = self.config
+        dtype = dtype or cfg.dtype
+        return [
+            {
+                "k": jnp.zeros((batch_size, max_length, cfg.kv_heads, cfg.dims_per_head), dtype),
+                "v": jnp.zeros((batch_size, max_length, cfg.kv_heads, cfg.dims_per_head), dtype),
+            }
+            for _ in range(cfg.num_layers)
+        ]
+
+
+BUILTIN_SPECS = {
+    "gpt2": TransformerConfig.gpt2,
+    "llama": TransformerConfig.llama,
+    "gptj": TransformerConfig.gptj,
+    "gptneox": TransformerConfig.gptneox,
+    "pythia": TransformerConfig.gptneox,
+    "opt": TransformerConfig.opt,
+    "bloom": TransformerConfig.bloom,
+}
+
+
+def config_from_spec(spec: str, **overrides) -> TransformerConfig:
+    """Parse a ``builtin:<family>-<size>`` model spec into a config."""
+    if spec.startswith("builtin:"):
+        spec = spec.split(":", 1)[1]
+    family, _, size = spec.partition("-")
+    if family not in BUILTIN_SPECS:
+        raise ValueError(f"Unknown model family '{family}'. Known: {sorted(BUILTIN_SPECS)}")
+    return BUILTIN_SPECS[family](size or "test", **overrides)

@@ -1,0 +1,1 @@
+"""Compute ops: jitted generation, sampling transforms, attention kernels."""

@@ -1,0 +1,240 @@
+"""Jitted autoregressive generation with an explicit KV cache.
+
+The reference's dominant hot loop is HF ``generate`` (SURVEY.md §3.2); here it
+is one compiled program: a prefill forward that fills the cache for the
+(left-padded) prompt block, then a ``lax.while_loop`` decode with per-sample
+eos early-exit — static shapes, no host round-trips.
+
+The ``adjust_logits`` hook lets algorithms reshape sampling logits on device —
+ILQL's ``logπ + β(minQ − V)`` advantage reshaping plugs in here (reference:
+``trlx/models/modeling_ilql.py:280-317``).
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    """Sampling settings (HF-compatible field names, reference
+    ``method.gen_kwargs``)."""
+
+    max_new_tokens: int = 40
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    do_sample: bool = True
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+    min_new_tokens: int = 0
+
+    @staticmethod
+    def from_gen_kwargs(kwargs: Dict[str, Any], eos_token_id=None, pad_token_id=0) -> "GenerationConfig":
+        known = {f.name for f in dataclasses.fields(GenerationConfig)}
+        clean = {k: v for k, v in kwargs.items() if k in known}
+        clean.setdefault("eos_token_id", eos_token_id)
+        clean.setdefault("pad_token_id", pad_token_id)
+        # ILQL passes beta/temperature through gen_kwargs; beta is handled by
+        # the adjust_logits hook, so it is not a GenerationConfig field.
+        return GenerationConfig(**clean)
+
+
+def process_logits(
+    logits: jax.Array,  # [B, V]
+    temperature: float,
+    top_k: int,
+    top_p: float,
+) -> jax.Array:
+    """Standard temperature / top-k / top-p filtering (returns logits)."""
+    if temperature != 1.0:
+        logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumprobs = jnp.cumsum(probs, axis=-1)
+        # keep tokens until cumulative prob exceeds top_p (always keep top-1)
+        keep_sorted = cumprobs - probs < top_p
+        threshold = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return logits
+
+
+class GenerationOutput(NamedTuple):
+    sequences: jax.Array  # [B, P + N] prompt (left-padded) ‖ response
+    response_tokens: jax.Array  # [B, N] pad-filled after eos
+    response_mask: jax.Array  # [B, N] 1 on real response tokens (incl. eos)
+    response_logprobs: jax.Array  # [B, N] behavior logprobs of sampled tokens
+    response_values: jax.Array  # [B, N] value-head outputs (0 if no head)
+    prompt_mask: jax.Array  # [B, P]
+
+
+def generate(
+    apply_fn: Callable[..., Dict[str, Any]],
+    params: Any,
+    init_cache_fn: Callable[[int, int], Any],
+    input_ids: jax.Array,  # [B, P] left-padded prompts
+    attention_mask: jax.Array,  # [B, P]
+    rng: jax.Array,
+    config: GenerationConfig,
+    adjust_logits: Optional[Callable[[Dict[str, Any], jax.Array], jax.Array]] = None,
+) -> GenerationOutput:
+    """Sample ``max_new_tokens`` continuations for a batch of prompts.
+
+    ``apply_fn(params, input_ids, attention_mask, positions, cache,
+    cache_index)`` must return a dict with at least ``logits`` and ``cache``
+    (the model wrappers' ``__call__``). ``adjust_logits(step_outputs, logits)``
+    may reshape the last-token logits before sampling (ILQL).
+
+    Fully jittable; wrap in ``jax.jit``/``pjit`` with static ``config``.
+    """
+    B, P = input_ids.shape
+    N = config.max_new_tokens
+    S = P + N
+    input_ids = input_ids.astype(jnp.int32)
+
+    cache = init_cache_fn(B, S)
+    # slot mask over the full cache: prompt mask then zeros (filled as we go)
+    slot_mask = jnp.concatenate(
+        [attention_mask.astype(jnp.int32), jnp.zeros((B, N), jnp.int32)], axis=1
+    )
+
+    # ---- prefill ----
+    prefill_out = apply_fn(
+        params,
+        input_ids,
+        attention_mask=slot_mask,
+        positions=None,
+        cache=cache,
+        cache_index=jnp.asarray(0, jnp.int32),
+    )
+    cache = prefill_out["cache"]
+    last_logits = prefill_out["logits"][:, -1, :]  # [B, V]
+    prompt_len = jnp.sum(attention_mask, axis=1).astype(jnp.int32)  # [B]
+
+    def _last_step_info(out: Dict[str, Any]) -> Dict[str, Any]:
+        """Keep only last-position views of model outputs so the while_loop
+        carry has step-invariant shapes (prefill is [B,P,…], decode [B,1,…])."""
+        info = {}
+        for k, v in out.items():
+            if k in ("cache", "logits", "branch_input", "pre_norm_hidden") or v is None:
+                continue
+            info[k] = jax.tree_util.tree_map(lambda x: x[:, -1], v)
+        return info
+
+    class Carry(NamedTuple):
+        tokens: jax.Array  # [B, N]
+        logprobs: jax.Array  # [B, N]
+        values: jax.Array  # [B, N]
+        mask: jax.Array  # [B, N]
+        slot_mask: jax.Array  # [B, S]
+        cache: Any
+        logits: jax.Array  # [B, V] logits for the next sample
+        step_out: Any  # last-position views of last forward (for adjust_logits)
+        done: jax.Array  # [B]
+        step: jax.Array  # scalar
+        rng: jax.Array
+
+    def sample_step(carry: Carry) -> Carry:
+        rng, sample_rng = jax.random.split(carry.rng)
+        logits = carry.logits
+        if adjust_logits is not None:
+            logits = adjust_logits(carry.step_out, logits)
+        logits = logits.astype(jnp.float32)
+        if config.eos_token_id is not None and config.min_new_tokens > 0:
+            block_eos = carry.step < config.min_new_tokens
+            logits = jnp.where(
+                block_eos
+                & (jnp.arange(logits.shape[-1])[None, :] == config.eos_token_id),
+                -jnp.inf,
+                logits,
+            )
+        filtered = process_logits(logits, config.temperature, config.top_k, config.top_p)
+        if config.do_sample:
+            next_token = jax.random.categorical(sample_rng, filtered, axis=-1)
+        else:
+            next_token = jnp.argmax(filtered, axis=-1)
+        logprob = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1), next_token[:, None], axis=-1
+        )[:, 0]
+
+        next_token = jnp.where(carry.done, config.pad_token_id, next_token).astype(jnp.int32)
+        live = ~carry.done
+        tokens = carry.tokens.at[:, carry.step].set(next_token)
+        logprobs = carry.logprobs.at[:, carry.step].set(jnp.where(live, logprob, 0.0))
+        values = carry.values.at[:, carry.step].set(
+            jnp.where(live, carry_step_value(carry), 0.0)
+        )
+        mask = carry.mask.at[:, carry.step].set(live.astype(jnp.int32))
+
+        done = carry.done
+        if config.eos_token_id is not None:
+            done = done | (next_token == config.eos_token_id)
+
+        # write slot mask for this token (live samples only)
+        slot = P + carry.step
+        slot_mask = carry.slot_mask.at[:, slot].set(live.astype(jnp.int32))
+
+        # forward one step
+        out = apply_fn(
+            params,
+            next_token[:, None],
+            attention_mask=slot_mask,
+            positions=(prompt_len + carry.step)[:, None],
+            cache=carry.cache,
+            cache_index=slot,
+        )
+        return Carry(
+            tokens=tokens,
+            logprobs=logprobs,
+            values=values,
+            mask=mask,
+            slot_mask=slot_mask,
+            cache=out["cache"],
+            logits=out["logits"][:, -1, :],
+            step_out=_last_step_info(out),
+            done=done,
+            step=carry.step + 1,
+            rng=rng,
+        )
+
+    def carry_step_value(carry: Carry) -> jax.Array:
+        # value prediction for the *state before* sampling this token
+        if "value" in carry.step_out:
+            return carry.step_out["value"]
+        return jnp.zeros((B,), jnp.float32)
+
+    def cond(carry: Carry) -> jax.Array:
+        return (carry.step < N) & ~jnp.all(carry.done)
+
+    init = Carry(
+        tokens=jnp.full((B, N), config.pad_token_id, jnp.int32),
+        logprobs=jnp.zeros((B, N), jnp.float32),
+        values=jnp.zeros((B, N), jnp.float32),
+        mask=jnp.zeros((B, N), jnp.int32),
+        slot_mask=slot_mask,
+        cache=cache,
+        logits=last_logits,
+        step_out=_last_step_info(prefill_out),
+        done=jnp.zeros((B,), bool),
+        step=jnp.asarray(0, jnp.int32),
+        rng=rng,
+    )
+    final = jax.lax.while_loop(cond, sample_step, init)
+
+    sequences = jnp.concatenate([input_ids, final.tokens], axis=1)
+    return GenerationOutput(
+        sequences=sequences,
+        response_tokens=final.tokens,
+        response_mask=final.mask,
+        response_logprobs=final.logprobs,
+        response_values=final.values,
+        prompt_mask=attention_mask.astype(jnp.int32),
+    )

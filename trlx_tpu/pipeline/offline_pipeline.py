@@ -1,0 +1,320 @@
+"""Offline data pipelines: dialogue tokenization, prompt pipeline, SFT dialog
+store, ILQL rollout storage.
+
+Behavioral parity targets: ``trlx/pipeline/offline_pipeline.py`` —
+``tokenize_dialogue:28`` (left/right truncation over interleaved
+prompt/output turns), ``DialogStore:72`` (-100 loss masking of non-output
+tokens), ``PromptPipeline:101``, ``ILQLRolloutStorage:143``.
+
+TPU redesign: all collators pad to **bucketed lengths** (next multiple of
+``pad_multiple``) instead of ragged per-batch maxima, so the jitted train/
+rollout steps see a small, finite set of shapes (recompilation control —
+SURVEY.md §7 "hard parts").
+"""
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from trlx_tpu.data.ilql_types import (
+    ILQLBatch,
+    ILQLElement,
+    ILQLSeq2SeqBatch,
+    ILQLSeq2SeqElement,
+)
+from trlx_tpu.data.tokenizer import Tokenizer
+from trlx_tpu.models.sft import IGNORE_INDEX
+from trlx_tpu.pipeline import (
+    BasePipeline,
+    BaseRolloutStore,
+    BatchLoader,
+    register_datapipeline,
+)
+
+
+def round_up(n: int, multiple: int) -> int:
+    """Round ``n`` up to the next multiple (minimum one multiple)."""
+    return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+def pad_rows(
+    rows: Sequence[Sequence[int]],
+    pad_value: int,
+    side: str = "right",
+    pad_multiple: int = 8,
+    fixed_length: Optional[int] = None,
+    dtype=np.int32,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Stack ragged rows into a [B, L] array + mask, L bucketed or fixed."""
+    longest = max((len(r) for r in rows), default=1)
+    length = fixed_length if fixed_length is not None else round_up(longest, pad_multiple)
+    out = np.full((len(rows), length), pad_value, dtype=dtype)
+    mask = np.zeros((len(rows), length), dtype=np.int32)
+    for i, row in enumerate(rows):
+        row = list(row)
+        if len(row) > length:
+            # keep the side adjacent to the content: left-padding keeps the
+            # END of the row (tokens nearest the response), right-padding
+            # keeps the start
+            row = row[-length:] if side == "left" else row[:length]
+        if side == "left":
+            out[i, length - len(row) :] = row
+            mask[i, length - len(row) :] = 1
+        else:
+            out[i, : len(row)] = row
+            mask[i, : len(row)] = 1
+    return out, mask
+
+
+@dataclass
+class DialogMessage:
+    """One turn of a dialogue; ``is_output`` marks model-generated turns."""
+
+    is_output: bool
+    tokens: Tuple[int, ...]
+
+
+def tokenize_dialogue(
+    dialogue: Union[str, Iterable[str]],
+    tokenizer: Tokenizer,
+    max_length: int = 2048,
+) -> List[DialogMessage]:
+    """Tokenize an interleaved (prompt_1, output_1, prompt_2, ...) dialogue.
+
+    A bare string ``s`` is shorthand for ``(bos, s)``. The final output turn
+    gets the eos token appended if absent. The whole token budget is
+    ``max_length``; truncation removes tokens from the configured
+    ``truncation_side`` of the *flattened* dialogue while keeping turn
+    boundaries, and empty turns are dropped.
+    """
+    if isinstance(dialogue, str):
+        bos = tokenizer.bos_token or tokenizer.eos_token
+        dialogue = [bos, dialogue]
+    else:
+        dialogue = list(dialogue)
+        if len(dialogue) % 2 != 0:
+            raise ValueError(
+                "Dialogue must have an even number of phrases, alternating prompt and output"
+            )
+
+    if not dialogue[-1].endswith(tokenizer.eos_token):
+        dialogue = dialogue[:-1] + [dialogue[-1] + tokenizer.eos_token]
+
+    messages = [
+        DialogMessage(
+            is_output=(i % 2 == 1),
+            tokens=tuple(tokenizer.encode(turn, add_special_tokens=False)),
+        )
+        for i, turn in enumerate(dialogue)
+    ]
+
+    # Keep a token budget of max_length over the flattened sequence, dropping
+    # overflow from the truncation side while preserving turn order.
+    total = sum(len(m.tokens) for m in messages)
+    overflow = max(0, total - max_length)
+    if overflow:
+        if tokenizer.truncation_side == "left":
+            trimmed = []
+            for m in messages:
+                if overflow >= len(m.tokens):
+                    overflow -= len(m.tokens)
+                    trimmed.append(DialogMessage(m.is_output, ()))
+                else:
+                    trimmed.append(DialogMessage(m.is_output, m.tokens[overflow:] if overflow else m.tokens))
+                    overflow = 0
+            messages = trimmed
+        else:
+            trimmed = []
+            for m in reversed(messages):
+                if overflow >= len(m.tokens):
+                    overflow -= len(m.tokens)
+                    trimmed.append(DialogMessage(m.is_output, ()))
+                else:
+                    trimmed.append(DialogMessage(m.is_output, m.tokens[: len(m.tokens) - overflow] if overflow else m.tokens))
+                    overflow = 0
+            messages = list(reversed(trimmed))
+
+    return [m for m in messages if len(m.tokens) > 0]
+
+
+class DialogStore(BaseRolloutStore):
+    """SFT store: flattened dialogs with labels masked (``IGNORE_INDEX``) on
+    non-output tokens."""
+
+    def __init__(self, dialogs: List[List[DialogMessage]], tokenizer: Tokenizer):
+        super().__init__()
+        self.tokenizer = tokenizer
+        self.history = []
+        for d in dialogs:
+            input_ids = np.array([t for m in d for t in m.tokens], dtype=np.int32)
+            labels = np.array(
+                [t if m.is_output else IGNORE_INDEX for m in d for t in m.tokens],
+                dtype=np.int32,
+            )
+            self.history.append({"input_ids": input_ids, "labels": labels})
+
+    def push(self, exps):
+        self.history.extend(exps)
+
+    def create_loader(
+        self,
+        batch_size: int,
+        shuffle: bool = False,
+        pad_multiple: int = 8,
+        fixed_length: Optional[int] = None,
+        seed: int = 0,
+    ) -> BatchLoader:
+        pad_id = self.tokenizer.pad_token_id
+
+        def collate(elems: List[dict]) -> dict:
+            input_ids, mask = pad_rows(
+                [e["input_ids"] for e in elems], pad_id, "right", pad_multiple, fixed_length
+            )
+            labels, _ = pad_rows(
+                [e["labels"] for e in elems], IGNORE_INDEX, "right", pad_multiple, fixed_length
+            )
+            return {"input_ids": input_ids, "attention_mask": mask, "labels": labels}
+
+        return BatchLoader(self, batch_size, collate, shuffle=shuffle, seed=seed)
+
+
+@register_datapipeline
+class PromptPipeline(BasePipeline):
+    """Tokenizes and right/left-truncates prompts to ``max_prompt_length``."""
+
+    def __init__(self, prompts: List[str], max_prompt_length: int, tokenizer: Tokenizer):
+        super().__init__()
+        self.tokenizer = tokenizer
+        out = tokenizer(
+            prompts, truncation=True, max_length=max_prompt_length, add_special_tokens=False
+        )
+        self.prompts = [
+            {"input_ids": np.asarray(ids, dtype=np.int32), "text": text}
+            for ids, text in zip(out["input_ids"], prompts)
+        ]
+
+    def __getitem__(self, ix: int):
+        return self.prompts[ix]
+
+    def __len__(self) -> int:
+        return len(self.prompts)
+
+    def create_loader(
+        self,
+        batch_size: int,
+        shuffle: bool = False,
+        pad_multiple: int = 8,
+        fixed_length: Optional[int] = None,
+        seed: int = 0,
+    ) -> BatchLoader:
+        pad_id = self.tokenizer.pad_token_id
+
+        def collate(elems: List[dict]) -> dict:
+            # left-pad prompts: generation appends to the right
+            ids, mask = pad_rows(
+                [e["input_ids"] for e in elems], pad_id, "left", pad_multiple, fixed_length
+            )
+            return {
+                "input_ids": ids,
+                "attention_mask": mask,
+                "text": [e["text"] for e in elems],
+            }
+
+        return BatchLoader(self, batch_size, collate, shuffle=shuffle, seed=seed)
+
+
+def ilql_collate(
+    elems: List[ILQLElement], pad_multiple: int = 8, fixed_length: Optional[int] = None
+) -> ILQLBatch:
+    input_ids, _ = pad_rows([e.input_ids for e in elems], 0, "right", pad_multiple, fixed_length)
+    attn, _ = pad_rows([e.attention_mask for e in elems], 0, "right", pad_multiple, fixed_length)
+    # actions/states lengths bucket to their own (smaller) maxima
+    rewards, _ = pad_rows([e.rewards for e in elems], 0.0, "right", pad_multiple, None, np.float32)
+    a_len = rewards.shape[1]
+    actions_ixs, _ = pad_rows([e.actions_ixs for e in elems], 0, "right", 1, a_len)
+    states_ixs, _ = pad_rows([e.states_ixs for e in elems], 0, "right", 1, a_len + 1)
+    dones, _ = pad_rows([e.dones for e in elems], 0, "right", 1, a_len + 1)
+    return ILQLBatch(input_ids, attn, rewards, states_ixs, actions_ixs, dones)
+
+
+class ILQLRolloutStorage(BaseRolloutStore):
+    """Rollout storage for offline ILQL training."""
+
+    def __init__(self, input_ids, attention_mask, rewards, states_ixs, actions_ixs, dones):
+        super().__init__()
+        self.history = [
+            ILQLElement(*row)
+            for row in zip(input_ids, attention_mask, rewards, states_ixs, actions_ixs, dones)
+        ]
+
+    def push(self, exps):
+        self.history.extend(exps)
+
+    def create_loader(
+        self,
+        batch_size: int,
+        shuffle: bool = True,
+        pad_multiple: int = 8,
+        fixed_length: Optional[int] = None,
+        drop_last: bool = True,
+        seed: int = 0,
+    ) -> BatchLoader:
+        return BatchLoader(
+            self,
+            batch_size,
+            lambda elems: ilql_collate(elems, pad_multiple, fixed_length),
+            shuffle=shuffle,
+            drop_last=drop_last,
+            seed=seed,
+        )
+
+
+def ilql_seq2seq_collate(
+    elems: List[ILQLSeq2SeqElement], pad_multiple: int = 8, fixed_length: Optional[int] = None
+) -> ILQLSeq2SeqBatch:
+    input_ids, _ = pad_rows([e.input_ids for e in elems], 0, "right", pad_multiple, fixed_length)
+    attn, _ = pad_rows([e.attention_mask for e in elems], 0, "right", pad_multiple, fixed_length)
+    dec_ids, _ = pad_rows([e.decoder_input_ids for e in elems], 0, "right", pad_multiple, fixed_length)
+    rewards, _ = pad_rows([e.rewards for e in elems], 0.0, "right", pad_multiple, None, np.float32)
+    a_len = rewards.shape[1]
+    actions_ixs, _ = pad_rows([e.actions_ixs for e in elems], 0, "right", 1, a_len)
+    states_ixs, _ = pad_rows([e.states_ixs for e in elems], 0, "right", 1, a_len + 1)
+    dones, _ = pad_rows([e.dones for e in elems], 0, "right", 1, a_len + 1)
+    return ILQLSeq2SeqBatch(input_ids, attn, dec_ids, rewards, states_ixs, actions_ixs, dones)
+
+
+class ILQLSeq2SeqRolloutStorage(BaseRolloutStore):
+    """Rollout storage for offline seq2seq ILQL training."""
+
+    def __init__(self, input_ids, attention_mask, decoder_input_ids, rewards, states_ixs, actions_ixs, dones):
+        super().__init__()
+        self.history = [
+            ILQLSeq2SeqElement(*row)
+            for row in zip(
+                input_ids, attention_mask, decoder_input_ids, rewards, states_ixs, actions_ixs, dones
+            )
+        ]
+
+    def push(self, exps):
+        self.history.extend(exps)
+
+    def create_loader(
+        self,
+        batch_size: int,
+        shuffle: bool = True,
+        pad_multiple: int = 8,
+        fixed_length: Optional[int] = None,
+        drop_last: bool = True,
+        seed: int = 0,
+    ) -> BatchLoader:
+        return BatchLoader(
+            self,
+            batch_size,
+            lambda elems: ilql_seq2seq_collate(elems, pad_multiple, fixed_length),
+            shuffle=shuffle,
+            drop_last=drop_last,
+            seed=seed,
+        )

@@ -1,0 +1,96 @@
+"""PPO rollout storage.
+
+Behavioral parity target: ``trlx/pipeline/ppo_pipeline.py:13-80`` — a replay
+buffer of per-sample experiences with a left-pad-queries / right-pad-responses
+collator and JSON rollout export. Collation pads to bucketed lengths (static
+shapes for the jitted train step).
+"""
+
+import json
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from trlx_tpu.data.ppo_types import PPORLBatch, PPORLElement
+from trlx_tpu.pipeline import BaseRolloutStore, BatchLoader
+from trlx_tpu.pipeline.offline_pipeline import pad_rows
+
+
+class PPORolloutStorage(BaseRolloutStore):
+    """Replay buffer of :class:`PPORLElement` used during PPO learning."""
+
+    def __init__(self, pad_token_id: int):
+        super().__init__()
+        self.pad_token_id = pad_token_id
+        self.history: List[PPORLElement] = []
+
+    def push(self, exps: List[PPORLElement]):
+        self.history += exps
+
+    def clear_history(self):
+        self.history = []
+
+    def export_history(self, location: str):
+        """Append rollouts as JSON (for algorithm-distillation datasets)."""
+        assert os.path.exists(location)
+        fpath = os.path.join(location, f"epoch-{str(time.time())}.json")
+
+        def exp_to_dict(exp: PPORLElement) -> dict:
+            return {
+                "query_tensor": np.asarray(exp.query_tensor).tolist(),
+                "response_tensor": np.asarray(exp.response_tensor).tolist(),
+                "logprobs": np.asarray(exp.logprobs).tolist(),
+                "values": np.asarray(exp.values).tolist(),
+                "rewards": np.asarray(exp.rewards).tolist(),
+            }
+
+        with open(fpath, "w") as f:
+            json.dump([exp_to_dict(exp) for exp in self.history], f)
+
+    def collate(
+        self,
+        elems: List[PPORLElement],
+        pad_multiple: int = 8,
+        query_length: Optional[int] = None,
+        response_length: Optional[int] = None,
+    ) -> PPORLBatch:
+        queries, query_mask = pad_rows(
+            [e.query_tensor for e in elems], self.pad_token_id, "left", pad_multiple, query_length
+        )
+        responses, response_mask = pad_rows(
+            [e.response_tensor for e in elems], self.pad_token_id, "right", pad_multiple, response_length
+        )
+        r_len = responses.shape[1]
+        logprobs, _ = pad_rows([e.logprobs for e in elems], 0.0, "right", 1, r_len, np.float32)
+        values, _ = pad_rows([e.values for e in elems], 0.0, "right", 1, r_len, np.float32)
+        rewards, _ = pad_rows([e.rewards for e in elems], 0.0, "right", 1, r_len, np.float32)
+        return PPORLBatch(
+            query_tensors=queries,
+            response_tensors=responses,
+            logprobs=logprobs,
+            values=values,
+            rewards=rewards,
+            query_mask=query_mask,
+            response_mask=response_mask,
+        )
+
+    def create_loader(
+        self,
+        batch_size: int,
+        shuffle: bool = False,
+        pad_multiple: int = 8,
+        query_length: Optional[int] = None,
+        response_length: Optional[int] = None,
+        drop_last: bool = True,
+        seed: int = 0,
+    ) -> BatchLoader:
+        return BatchLoader(
+            self,
+            batch_size,
+            lambda elems: self.collate(elems, pad_multiple, query_length, response_length),
+            shuffle=shuffle,
+            drop_last=drop_last,
+            seed=seed,
+        )

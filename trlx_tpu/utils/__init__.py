@@ -148,21 +148,29 @@ class SchedulerName(str, Enum):
     WARMUP_COSINE = "warmup_cosine"
 
 
-def get_scheduler(name: str, kwargs: Dict[str, Any]) -> optax.Schedule:
+def get_scheduler(
+    name: str, kwargs: Dict[str, Any], default_lr: float = None
+) -> optax.Schedule:
     """Build an optax schedule from a config name + kwargs.
 
     ``cosine_annealing(T_max, eta_min)`` follows torch semantics used by the
-    reference configs; ``lr``/``init_value`` is the peak LR (taken from the
-    optimizer kwargs by the caller when absent here).
+    reference configs. The base/peak LR comes from scheduler ``lr`` or from
+    ``default_lr`` (trainers pass the optimizer's lr, matching torch's
+    CosineAnnealingLR which reads the base LR off the optimizer).
     """
     name = SchedulerName(name.lower())
     kwargs = dict(kwargs)
     lr = kwargs.pop("lr", None)
+    if lr is None:
+        lr = default_lr
     if name == SchedulerName.COSINE_ANNEALING:
         t_max = int(kwargs.pop("T_max", 10_000))
         eta_min = float(kwargs.pop("eta_min", 0.0))
         if lr is None:
-            lr = eta_min
+            raise ValueError(
+                "cosine_annealing needs a base LR: put `lr` in scheduler kwargs "
+                "or pass default_lr (the optimizer's lr)"
+            )
         # torch CosineAnnealingLR: lr(t) = eta_min + (lr-eta_min)*(1+cos(pi t/T))/2
         return lambda step: eta_min + (lr - eta_min) * 0.5 * (
             1 + jnp.cos(jnp.pi * jnp.minimum(step, t_max) / t_max)
@@ -210,7 +218,14 @@ def get_optimizer(
     lr = kwargs.pop("lr", 1e-4)
     learning_rate = schedule if schedule is not None else lr
     betas = kwargs.pop("betas", None)
-    if betas is not None:
+    # betas → b1/b2 only for optimizers that take them; others ignore betas
+    # (configs often keep betas when switching the optimizer name)
+    if betas is not None and name in (
+        OptimizerName.ADAM,
+        OptimizerName.ADAMW,
+        OptimizerName.ADAMW_8BIT_BNB,
+        OptimizerName.LION,
+    ):
         kwargs.setdefault("b1", betas[0])
         kwargs.setdefault("b2", betas[1])
 
@@ -220,11 +235,17 @@ def get_optimizer(
         kwargs.pop("weight_decay", None)
         opt = optax.adam(learning_rate, **kwargs)
     elif name == OptimizerName.ADAFACTOR:
+        kwargs.pop("eps", None)
         opt = optax.adafactor(learning_rate, **kwargs)
     elif name == OptimizerName.LION:
+        kwargs.pop("eps", None)
         opt = optax.lion(learning_rate, **kwargs)
     elif name == OptimizerName.SGD:
+        kwargs.pop("eps", None)
+        wd = kwargs.pop("weight_decay", 0.0)
         opt = optax.sgd(learning_rate, **kwargs)
+        if wd:
+            opt = optax.chain(optax.add_decayed_weights(wd), opt)
     else:
         raise ValueError(f"Unknown optimizer {name}")
 
