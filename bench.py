@@ -1,0 +1,115 @@
+"""Benchmark: PPO experience+train throughput, ppo_sentiments-shaped.
+
+Measures end-to-end PPO samples/sec on the BASELINE.json north-star task
+shape: GPT-2-small (124M, real dims, random init — no network), prompts of 64
+tokens, 40 new tokens per rollout (the reference ppo_sentiments gen_kwargs,
+``trlx/data/default_configs.py:54``), chunk 128, 4 PPO epochs per batch of
+128. One timed unit = collect 128 rollouts (jitted KV-cache decode + scoring
+fwd + hydra-ref fwd + KL) and run the 4×1 optimization steps — the same
+work AcceleratePPOTrainer does per epoch (SURVEY.md §3.2-3.3).
+
+Baseline: single-A100 trlx ppo_sentiments ≈ 40 samples/s (estimate from the
+reference's W&B `trlx-references` runs: ~1k rollouts+updates in ~25 min);
+``vs_baseline`` = samples_per_sec / 40.0 (target ≥3.0 per BASELINE.json).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+A100_BASELINE_SAMPLES_PER_SEC = 40.0
+
+
+def main():
+    import jax
+
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.ppo  # noqa: F401
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401
+
+    n_dev = jax.device_count()
+    chunk = int(os.environ.get("BENCH_CHUNK", 128))
+    # byte-level prompts, 64 tokens each; bucketing keeps one compiled shape
+    prompt_tokens = 64
+    max_new = 40
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            seq_length=prompt_tokens + max_new,
+            batch_size=chunk,
+            total_steps=1_000_000,
+            eval_interval=1_000_000,
+            checkpoint_interval=1_000_000,
+            epochs=1,
+            checkpoint_dir="/tmp/trlx_tpu_bench",
+            tracker=None,
+        ),
+        model=dict(model_path="builtin:gpt2-small", num_layers_unfrozen=2),
+        parallel=dict(data=-1, fsdp=1, model=1),
+        method=dict(
+            num_rollouts=chunk,
+            chunk_size=chunk,
+            ppo_epochs=4,
+            gen_kwargs=dict(
+                max_new_tokens=max_new, top_k=0, top_p=1.0, do_sample=True
+            ),
+        ),
+    )
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        return [float(sum(c in "aeiou" for c in o)) for o in outputs]
+
+    trainer = get_trainer(config.train.trainer)(
+        config=config, reward_fn=reward_fn, metric_fn=None, stop_sequences=[]
+    )
+
+    rng = np.random.RandomState(0)
+    prompts = ["".join(chr(97 + c) for c in rng.randint(0, 26, prompt_tokens)) for _ in range(512)]
+    pipeline = get_pipeline(config.train.pipeline)(prompts, prompt_tokens, trainer.tokenizer)
+    trainer.add_prompt_pipeline(pipeline)
+
+    def one_cycle():
+        trainer.store.clear_history()
+        trainer.make_experience(chunk)
+        loader = trainer.store.create_loader(
+            config.train.batch_size,
+            shuffle=True,
+            query_length=prompt_tokens,
+            response_length=max_new,
+        )
+        for batch in loader:
+            for _ in range(config.method.ppo_epochs):
+                stats = trainer.train_step(batch)
+        jax.block_until_ready(trainer.state.params)
+        return stats
+
+    one_cycle()  # warmup: compiles decode, score, train programs
+    n_cycles = int(os.environ.get("BENCH_CYCLES", 3))
+    t0 = time.time()
+    for _ in range(n_cycles):
+        stats = one_cycle()
+    dt = time.time() - t0
+
+    samples_per_sec = n_cycles * chunk / dt
+    per_chip = samples_per_sec / max(n_dev, 1)
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_sentiments-shaped e2e throughput (gpt2-small, 64+40 tok)",
+                "value": round(samples_per_sec, 3),
+                "unit": "samples/sec",
+                "vs_baseline": round(samples_per_sec / A100_BASELINE_SAMPLES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

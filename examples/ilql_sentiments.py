@@ -1,0 +1,67 @@
+"""ILQL sentiment steering from offline data (capability parity:
+``/root/reference/examples/ilql_sentiments.py`` — GPT-2 trained on
+reward-labeled IMDB reviews, no environment interaction)."""
+
+import os
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ilql_config
+
+from sentiment_util import get_positive_sentiment_fn, load_imdb_texts, review_prompts
+
+
+def resolve_model():
+    path = os.environ.get("MODEL_PATH")
+    if path:
+        return path, path
+    try:
+        from transformers import AutoConfig
+
+        AutoConfig.from_pretrained("gpt2")
+        return "gpt2", "gpt2"
+    except Exception:
+        return "builtin:gpt2-small", "builtin:bytes"
+
+
+def main(hparams=None):
+    model_path, tokenizer_path = resolve_model()
+    sentiment = get_positive_sentiment_fn()
+
+    config = default_ilql_config().evolve(
+        train=dict(
+            seq_length=128,
+            batch_size=32,
+            total_steps=5000,
+            eval_interval=100,
+            checkpoint_interval=5000,
+            checkpoint_dir="ckpts/ilql_sentiments",
+        ),
+        model=dict(model_path=model_path),
+        tokenizer=dict(tokenizer_path=tokenizer_path),
+        method=dict(gen_kwargs=dict(max_new_tokens=40, top_k=20, beta=4.0, temperature=1.0)),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    texts, _labels = load_imdb_texts(1024, seed=0)
+    rewards = sentiment(texts)
+
+    def metric_fn(samples, prompts, outputs, **kwargs):
+        return {"sentiment": sentiment(samples)}
+
+    return trlx.train(
+        samples=texts,
+        rewards=rewards,
+        eval_prompts=review_prompts(64, seed=1),
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

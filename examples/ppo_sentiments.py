@@ -1,0 +1,73 @@
+"""PPO sentiment steering (capability parity:
+``/root/reference/examples/ppo_sentiments.py`` — GPT-2 fine-tuned with PPO to
+continue movie-review prompts positively, reward = P(positive) from a
+sentiment classifier).
+
+Model/tokenizer resolve in order: ``$MODEL_PATH`` (an HF checkpoint
+directory), else the hub ``lvwerra/gpt2-imdb``, else an offline random-init
+GPT-2-small + byte tokenizer (wiring identical; reward fidelity lower).
+"""
+
+import os
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ppo_config
+
+from sentiment_util import get_positive_sentiment_fn, review_prompts
+
+
+def resolve_model():
+    path = os.environ.get("MODEL_PATH")
+    if path:
+        return path, path
+    try:
+        from transformers import AutoConfig
+
+        AutoConfig.from_pretrained("lvwerra/gpt2-imdb")
+        return "lvwerra/gpt2-imdb", "lvwerra/gpt2-imdb"
+    except Exception:
+        return "builtin:gpt2-small", "builtin:bytes"
+
+
+def main(hparams=None):
+    model_path, tokenizer_path = resolve_model()
+    sentiment = get_positive_sentiment_fn()
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            seq_length=128,
+            batch_size=32,
+            total_steps=10000,
+            eval_interval=100,
+            checkpoint_interval=10000,
+            checkpoint_dir="ckpts/ppo_sentiments",
+        ),
+        model=dict(model_path=model_path, num_layers_unfrozen=2),
+        tokenizer=dict(tokenizer_path=tokenizer_path),
+        method=dict(
+            num_rollouts=128,
+            chunk_size=128,
+            gen_kwargs=dict(max_new_tokens=40, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        return sentiment(outputs)
+
+    return trlx.train(
+        reward_fn=reward_fn,
+        prompts=review_prompts(256, seed=0),
+        eval_prompts=review_prompts(64, seed=1),
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

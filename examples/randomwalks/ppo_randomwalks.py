@@ -1,0 +1,69 @@
+"""PPO on the randomwalks task (capability parity:
+``/root/reference/examples/randomwalks/ppo_randomwalks.py``).
+
+A tiny decoder trained from scratch learns to emit near-shortest paths; mean
+``optimality`` climbs toward 1. Runs on CPU or a single TPU chip in minutes.
+"""
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ppo_config
+
+from randomwalks import generate_random_walks
+
+
+def main(hparams=None):
+    metric_fn, reward_fn, prompts, *_rest, alphabet = generate_random_walks(seed=1002)
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            seq_length=11,
+            batch_size=64,
+            total_steps=1000,
+            epochs=100,
+            eval_interval=20,
+            checkpoint_interval=1000,
+            checkpoint_dir="ckpts/ppo_randomwalks",
+        ),
+        model=dict(
+            model_path="builtin:gpt2-test",
+            num_layers_unfrozen=-1,
+            model_extra_kwargs=dict(
+                vocab_size=len(alphabet) + 3,
+                hidden_size=144,
+                num_layers=6,
+                num_heads=12,
+                intermediate_size=576,
+                max_position_embeddings=16,
+            ),
+        ),
+        tokenizer=dict(tokenizer_path=f"builtin:chars:{alphabet}"),
+        optimizer=dict(name="adamw", kwargs=dict(lr=3e-4, weight_decay=1e-6)),
+        scheduler=dict(name="cosine_annealing", kwargs=dict(T_max=1000, eta_min=3e-4, lr=3e-4)),
+        method=dict(
+            num_rollouts=128,
+            chunk_size=128,
+            ppo_epochs=4,
+            init_kl_coef=0.05,
+            gen_kwargs=dict(max_new_tokens=9, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    return trlx.train(
+        reward_fn=lambda samples, **kw: reward_fn(samples),
+        metric_fn=lambda samples, **kw: metric_fn(samples),
+        # repeat the 20 start nodes so rollout chunks fill one static shape
+        prompts=prompts * 32,
+        eval_prompts=prompts,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

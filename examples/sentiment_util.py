@@ -1,0 +1,101 @@
+"""Shared helpers for the sentiment examples.
+
+The reference examples (``/root/reference/examples/ppo_sentiments.py`` etc.)
+use the IMDB dataset + a distilbert sentiment classifier from the HF hub. In
+offline environments both downloads fail, so each helper falls back to a
+self-contained stand-in: a templated review corpus and a lexicon-based
+sentiment scorer. The example scripts behave identically either way — only
+reward fidelity differs.
+"""
+
+import os
+from typing import Callable, List, Tuple
+
+import numpy as np
+
+POSITIVE_WORDS = (
+    "great good wonderful excellent amazing love loved beautiful best "
+    "fantastic brilliant enjoyable masterpiece superb delightful charming "
+    "perfect stunning captivating remarkable"
+).split()
+NEGATIVE_WORDS = (
+    "bad terrible awful worst boring hate hated dull poor disappointing "
+    "mediocre horrible waste annoying mess bland lifeless tedious forgettable "
+    "unwatchable"
+).split()
+
+
+def lexicon_sentiment(texts: List[str]) -> List[float]:
+    """Crude positive-sentiment score in [0, 1]: pos / (pos + neg)."""
+    scores = []
+    for t in texts:
+        words = t.lower().split()
+        pos = sum(w.strip(".,!?") in POSITIVE_WORDS for w in words)
+        neg = sum(w.strip(".,!?") in NEGATIVE_WORDS for w in words)
+        scores.append(pos / (pos + neg) if pos + neg else 0.5)
+    return scores
+
+
+def get_positive_sentiment_fn() -> Callable[[List[str]], List[float]]:
+    """P(positive) scorer: HF distilbert-imdb when available, else lexicon."""
+    try:
+        from transformers import pipeline
+
+        clf = pipeline(
+            "sentiment-analysis",
+            model=os.environ.get("SENTIMENT_MODEL", "lvwerra/distilbert-imdb"),
+            top_k=2,
+            truncation=True,
+        )
+
+        def score(texts: List[str]) -> List[float]:
+            out = clf(texts)
+            return [
+                next(d["score"] for d in sample if d["label"] in ("POSITIVE", "LABEL_1"))
+                for sample in out
+            ]
+
+        score(["ok"])  # force download/initialization now
+        return score
+    except Exception:
+        return lexicon_sentiment
+
+
+_TEMPLATES_POS = [
+    "This movie was {} and I loved every minute of it.",
+    "An absolutely {} film, the best I have seen this year.",
+    "The acting was {} and the story kept me captivated.",
+]
+_TEMPLATES_NEG = [
+    "This movie was {} and I hated every minute of it.",
+    "An absolutely {} film, the worst I have seen this year.",
+    "The acting was {} and the story was a boring mess.",
+]
+
+
+def load_imdb_texts(n: int = 512, seed: int = 0) -> Tuple[List[str], List[int]]:
+    """(texts, labels). IMDB via ``datasets`` when available, else templated
+    synthetic reviews."""
+    try:
+        from datasets import load_dataset
+
+        ds = load_dataset("imdb", split="train").shuffle(seed=seed).select(range(n))
+        return list(ds["text"]), list(ds["label"])
+    except Exception:
+        rng = np.random.RandomState(seed)
+        texts, labels = [], []
+        for _ in range(n):
+            if rng.rand() < 0.5:
+                t = rng.choice(_TEMPLATES_POS).format(rng.choice(POSITIVE_WORDS))
+                labels.append(1)
+            else:
+                t = rng.choice(_TEMPLATES_NEG).format(rng.choice(NEGATIVE_WORDS))
+                labels.append(0)
+            texts.append(t)
+        return texts, labels
+
+
+def review_prompts(n: int = 128, seed: int = 0, prompt_words: int = 4) -> List[str]:
+    """Short review openings used as rollout prompts."""
+    texts, _ = load_imdb_texts(n, seed)
+    return [" ".join(t.split()[:prompt_words]) for t in texts]

@@ -1,0 +1,66 @@
+"""SFT on positive reviews only (capability parity:
+``/root/reference/examples/sft_sentiments.py`` — supervised fine-tuning of
+GPT-2 on the positive half of IMDB)."""
+
+import os
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_sft_config
+
+from sentiment_util import get_positive_sentiment_fn, load_imdb_texts, review_prompts
+
+
+def resolve_model():
+    path = os.environ.get("MODEL_PATH")
+    if path:
+        return path, path
+    try:
+        from transformers import AutoConfig
+
+        AutoConfig.from_pretrained("gpt2")
+        return "gpt2", "gpt2"
+    except Exception:
+        return "builtin:gpt2-small", "builtin:bytes"
+
+
+def main(hparams=None):
+    model_path, tokenizer_path = resolve_model()
+    sentiment = get_positive_sentiment_fn()
+
+    config = default_sft_config().evolve(
+        train=dict(
+            seq_length=128,
+            batch_size=32,
+            total_steps=2000,
+            eval_interval=200,
+            checkpoint_interval=2000,
+            checkpoint_dir="ckpts/sft_sentiments",
+        ),
+        model=dict(model_path=model_path),
+        tokenizer=dict(tokenizer_path=tokenizer_path),
+        method=dict(gen_kwargs=dict(max_new_tokens=40, top_k=0, top_p=1.0, do_sample=True)),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    texts, labels = load_imdb_texts(1024, seed=0)
+    positive = [t for t, l in zip(texts, labels) if l == 1]
+
+    def metric_fn(samples, prompts, outputs, **kwargs):
+        return {"sentiment": sentiment(samples)}
+
+    return trlx.train(
+        samples=positive,
+        eval_prompts=review_prompts(64, seed=1),
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

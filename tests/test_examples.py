@@ -1,0 +1,76 @@
+"""Example-script smoke tests (few-step runs of the CPU-scale tasks)."""
+
+import sys
+import os
+
+import numpy as np
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+sys.path.insert(0, os.path.abspath(EXAMPLES))
+sys.path.insert(0, os.path.abspath(os.path.join(EXAMPLES, "randomwalks")))
+
+
+def test_randomwalks_task_properties():
+    from randomwalks import generate_random_walks
+
+    metric_fn, reward_fn, prompts, walks, rewards, alphabet = generate_random_walks(
+        n_nodes=12, n_walks=50, seed=3
+    )
+    assert len(prompts) == 11
+    assert len(walks) == 50 and len(rewards) == 50
+    # rewards bounded and some walks reach the goal in a connected graph
+    assert all(0.0 <= r <= 1.0 for r in rewards)
+    assert any(r > 0 for r in rewards)
+    # metric of an optimal walk is higher than that of an invalid one
+    good = max(zip(rewards, walks))[1]
+    assert metric_fn([good])["optimality"][0] > metric_fn(["zz"])["optimality"][0]
+
+
+def test_ppo_randomwalks_smoke(tmp_path):
+    import ppo_randomwalks
+
+    trainer = ppo_randomwalks.main(
+        {
+            "train.total_steps": 2,
+            "train.epochs": 1,
+            "train.eval_interval": 2,
+            "train.batch_size": 16,
+            "train.checkpoint_dir": str(tmp_path / "ckpt"),
+            "method.num_rollouts": 16,
+            "method.chunk_size": 16,
+            "method.ppo_epochs": 1,
+        }
+    )
+    assert trainer.iter_count >= 1
+
+
+def test_ilql_randomwalks_smoke(tmp_path):
+    import ilql_randomwalks
+
+    trainer = ilql_randomwalks.main(
+        {
+            "train.total_steps": 2,
+            "train.epochs": 1,
+            "train.eval_interval": 2,
+            "train.batch_size": 16,
+            "train.checkpoint_dir": str(tmp_path / "ckpt"),
+        }
+    )
+    assert trainer.iter_count >= 1
+
+
+def test_sentiment_lexicon():
+    from sentiment_util import lexicon_sentiment, load_imdb_texts
+
+    scores = lexicon_sentiment(
+        ["a wonderful excellent movie", "a terrible boring mess", "neutral text"]
+    )
+    assert scores[0] > 0.9 and scores[1] < 0.1 and scores[2] == 0.5
+
+    texts, labels = load_imdb_texts(32, seed=0)
+    assert len(texts) == 32 and set(labels) <= {0, 1}
+    # templated positives score above negatives under the lexicon
+    pos = np.mean([s for s, l in zip(lexicon_sentiment(texts), labels) if l == 1])
+    neg = np.mean([s for s, l in zip(lexicon_sentiment(texts), labels) if l == 0])
+    assert pos > neg

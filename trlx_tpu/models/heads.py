@@ -101,9 +101,15 @@ class ILQLHeadsModule(nn.Module):
         ]
 
     def __call__(self, hs: jax.Array) -> Tuple[Tuple[jax.Array, ...], Tuple[jax.Array, ...], jax.Array]:
-        qs = tuple(q(hs) for q in self.q_heads)
-        target_qs = tuple(jax.lax.stop_gradient(q(hs)) for q in self.target_q_heads)
-        vs = self.v_head(hs)
+        return self.heads_on(hs, hs)
+
+    def heads_on(self, hs_actions: jax.Array, hs_states: jax.Array):
+        """Q/target-Q heads on action positions, V head on state positions."""
+        qs = tuple(q(hs_actions) for q in self.q_heads)
+        target_qs = tuple(
+            jax.lax.stop_gradient(q(hs_actions)) for q in self.target_q_heads
+        )
+        vs = self.v_head(hs_states)
         return qs, target_qs, vs
 
 
@@ -134,6 +140,25 @@ class CausalLMWithILQLHeads(nn.Module):
 
     def init_cache(self, batch_size, max_length, dtype=None):
         return self.backbone.init_cache(batch_size, max_length, dtype)
+
+    def backbone_forward(
+        self, input_ids, attention_mask=None, positions=None, cache=None, cache_index=None
+    ):
+        """Backbone-only forward (no heads) — the training loss gathers
+        hidden states at action/state indices first and applies heads to the
+        gathered positions only (the reference's ``ILQLHeads.forward``
+        index-select, ``trlx/models/modeling_ilql.py:160-180``)."""
+        return self.backbone(
+            input_ids,
+            attention_mask=attention_mask,
+            positions=positions,
+            cache=cache,
+            cache_index=cache_index,
+        )
+
+    def heads_on(self, hs_actions, hs_states):
+        """Apply Q/target-Q heads at action positions, V head at states."""
+        return self.ilql_heads.heads_on(hs_actions, hs_states)
 
 
 def sync_target_q_params(params: Dict[str, Any], alpha: float) -> Dict[str, Any]:

@@ -554,15 +554,21 @@ class CausalTransformer(nn.Module):
 
     def init_cache(self, batch_size: int, max_length: int, dtype=None) -> List[Dict[str, jax.Array]]:
         """Allocate an all-zeros KV cache pytree."""
-        cfg = self.config
-        dtype = dtype or cfg.dtype
-        return [
-            {
-                "k": jnp.zeros((batch_size, max_length, cfg.kv_heads, cfg.dims_per_head), dtype),
-                "v": jnp.zeros((batch_size, max_length, cfg.kv_heads, cfg.dims_per_head), dtype),
-            }
-            for _ in range(cfg.num_layers)
-        ]
+        return make_kv_cache(self.config, batch_size, max_length, dtype)
+
+
+def make_kv_cache(
+    cfg: TransformerConfig, batch_size: int, max_length: int, dtype=None
+) -> List[Dict[str, jax.Array]]:
+    """All-zeros KV cache pytree for ``cfg`` (usable outside module ``apply``)."""
+    dtype = dtype or cfg.dtype
+    return [
+        {
+            "k": jnp.zeros((batch_size, max_length, cfg.kv_heads, cfg.dims_per_head), dtype),
+            "v": jnp.zeros((batch_size, max_length, cfg.kv_heads, cfg.dims_per_head), dtype),
+        }
+        for _ in range(cfg.num_layers)
+    ]
 
 
 BUILTIN_SPECS = {

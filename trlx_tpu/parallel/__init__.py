@@ -1,0 +1,29 @@
+"""Parallelism: device mesh + GSPMD sharding rules.
+
+The TPU-native replacement for the reference's *three* distributed stacks
+(Accelerate/DeepSpeed ZeRO, ``configs/accelerate/*.yaml``; NeMo Megatron
+TP/PP/SP, ``trlx/models/modeling_nemo_ilql.py``; raw torch.distributed/NCCL
+calls, ``trlx/utils/modeling.py:190-202``): one logical program over a
+``jax.sharding.Mesh`` with axes ``(data, fsdp, model, sequence)``. XLA inserts
+the collectives (all-gather / reduce-scatter / psum) over ICI/DCN — no
+hand-written communication.
+"""
+
+from trlx_tpu.parallel.mesh import make_mesh, mesh_shape_from_config
+from trlx_tpu.parallel.sharding import (
+    batch_spec,
+    param_shardings,
+    param_spec_for_path,
+    shard_batch,
+    shard_params,
+)
+
+__all__ = [
+    "make_mesh",
+    "mesh_shape_from_config",
+    "param_shardings",
+    "param_spec_for_path",
+    "batch_spec",
+    "shard_batch",
+    "shard_params",
+]

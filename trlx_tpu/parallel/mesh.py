@@ -1,0 +1,93 @@
+"""Device mesh construction.
+
+Axes:
+
+- ``data``     — pure data parallelism (the reference's DDP replicas,
+  ``configs/accelerate/ddp.yaml``). Across slices/hosts this axis rides DCN.
+- ``fsdp``     — parameter/optimizer-state sharding (the reference's DeepSpeed
+  ZeRO-2/3, ``configs/accelerate/zero*.yaml``). Also acts as a data axis for
+  the batch: FSDP = DP + sharded state.
+- ``model``    — tensor parallelism (the reference's Megatron TP,
+  ``configs/nemo_configs/megatron_20b.yaml:53``).
+- ``sequence`` — context parallelism for long sequences (ring attention);
+  beyond the reference, which only has Megatron SP inside TP.
+
+The mesh is the single source of truth for every compiled program: train
+steps, rollout decode, and eval all run under the same mesh so arrays never
+leave the device fabric between phases.
+"""
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from trlx_tpu.data.configs import ParallelConfig
+
+MESH_AXES = ("data", "fsdp", "model", "sequence")
+
+
+def mesh_shape_from_config(
+    parallel: ParallelConfig, device_count: Optional[int] = None
+) -> Tuple[int, int, int, int]:
+    """Resolve the 4-axis mesh shape; a single ``-1`` axis is inferred."""
+    n = device_count if device_count is not None else jax.device_count()
+    sizes = [parallel.data, parallel.fsdp, parallel.model, parallel.sequence]
+    if sizes.count(-1) > 1:
+        raise ValueError(f"At most one mesh axis may be -1, got {sizes}")
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if n % known != 0:
+            raise ValueError(
+                f"Device count {n} not divisible by fixed axes product {known}"
+            )
+        sizes[sizes.index(-1)] = n // known
+    if math.prod(sizes) != n:
+        raise ValueError(
+            f"Mesh {dict(zip(MESH_AXES, sizes))} needs {math.prod(sizes)} devices, "
+            f"have {n}"
+        )
+    return tuple(sizes)
+
+
+def make_mesh(
+    parallel: Optional[ParallelConfig] = None, devices=None
+) -> Mesh:
+    """Build the global ``Mesh`` from a :class:`ParallelConfig`.
+
+    Multi-host TPU: with ``dcn_data_parallelism > 1`` the data axis is laid
+    out hierarchically (slow DCN links only carry the pure-DP axis; fsdp/
+    model/sequence collectives stay on ICI within a slice) via
+    ``mesh_utils.create_hybrid_device_mesh``. Single-slice: a contiguous
+    ``create_device_mesh`` keeps the model axis on adjacent chips, which is
+    the layout the Megatron TP pattern expects of NVLink in the reference.
+    """
+    parallel = parallel or ParallelConfig()
+    devices = devices if devices is not None else jax.devices()
+    shape = mesh_shape_from_config(parallel, len(devices))
+
+    if parallel.dcn_data_parallelism > 1:
+        from jax.experimental import mesh_utils
+
+        dcn = parallel.dcn_data_parallelism
+        if shape[0] % dcn != 0:
+            raise ValueError(
+                f"data axis {shape[0]} not divisible by dcn_data_parallelism {dcn}"
+            )
+        ici_shape = (shape[0] // dcn,) + shape[1:]
+        dcn_shape = (dcn, 1, 1, 1)
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices
+        )
+        return Mesh(device_array, MESH_AXES)
+
+    try:
+        from jax.experimental import mesh_utils
+
+        device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError):
+        # CPU/host platforms have no topology info; plain reshape
+        device_array = np.asarray(devices).reshape(shape)
+    return Mesh(device_array, MESH_AXES)

@@ -1,0 +1,518 @@
+"""Shared TPU trainer: model/optimizer setup, jitted train step, generation,
+eval loop, checkpointing, trackers.
+
+Behavioral parity target: ``AccelerateRLTrainer``
+(``trlx/trainer/accelerate_base_trainer.py:39-574``) — same control flow
+(epochs → batches → n updates per batch, interval checkpoints, best-reward
+checkpoint, eval with optional gen-kwarg sweep, stop-sequence trimming), but
+the torch/Accelerate machinery is replaced by the TPU-native stack: one
+global ``Mesh``, GSPMD-sharded params, a jitted ``value_and_grad`` step with
+donated train state, and jitted KV-cache generation (``trlx_tpu/ops/sampling``).
+
+The reference's per-rank device dance (``pad_across_processes``/``gather``/
+``scatter``, ``accelerate_ppo_trainer.py:292-327``) does not exist here:
+arrays are globally sharded, so "gather to rank 0" is just ``jax.device_get``
+at the host boundary (reward/metric fns), and per-rank scatter is
+``shard_batch`` placement.
+"""
+
+import json
+import os
+from abc import abstractmethod
+from time import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.tokenizer import from_config as tokenizer_from_config
+from trlx_tpu.models.builder import build_causal_lm, trainable_mask
+from trlx_tpu.models.transformer import make_kv_cache
+from trlx_tpu.ops.sampling import GenerationConfig, GenerationOutput, generate
+from trlx_tpu.parallel import make_mesh, shard_batch, shard_params
+from trlx_tpu.pipeline import BasePipeline
+from trlx_tpu.trainer import BaseRLTrainer
+from trlx_tpu.utils import (
+    Clock,
+    filter_non_scalars,
+    get_optimizer,
+    get_scheduler,
+    significant,
+    to_host,
+)
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.checkpoint import (
+    read_extra,
+    restore_state,
+    save_pretrained,
+    save_state,
+)
+from trlx_tpu.utils.trackers import make_tracker
+
+logger = logging.get_logger(__name__)
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Functional train state threaded through the jitted step."""
+
+    params: Any
+    opt_state: Any
+    step: jax.Array  # scalar int32
+    rng: jax.Array
+
+
+class TPUBaseTrainer(BaseRLTrainer):
+    """Shared learn-loop trainer over a global device mesh.
+
+    Subclasses define:
+
+    - ``model_head``: ``None`` | ``"value"`` | ``"ilql"`` — which wrapper to
+      build;
+    - ``loss_fn(params, batch, rng) -> (loss, stats)``: a *pure* function of
+      the param tree and a dict-of-arrays batch (closed over configs/module);
+    - ``prepare_learning()``: set ``train_dataloader``, ``eval_dataloader``,
+      ``n_updates_per_batch``, ``total_steps``;
+    - optionally ``post_backward_callback`` / ``post_epoch_callback`` and
+      ``adjust_logits_fn`` (on-device sampling-logit reshaping, e.g. ILQL).
+    """
+
+    model_head: Optional[str] = None
+
+    def __init__(
+        self,
+        config: TRLConfig,
+        reward_fn: Optional[Callable] = None,
+        metric_fn: Optional[Callable] = None,
+        stop_sequences: Optional[List[str]] = None,
+        **kwargs,
+    ):
+        super().__init__(config, reward_fn, metric_fn, stop_sequences, **kwargs)
+        self.mesh = make_mesh(config.parallel)
+        self.tokenizer = tokenizer_from_config(config.tokenizer)
+
+        two_qs = bool(getattr(config.method, "two_qs", True))
+        self.module, params, self.tcfg = build_causal_lm(
+            config.model,
+            config.parallel,
+            head=self.model_head,
+            two_qs=two_qs,
+            seed=config.train.seed,
+        )
+        params = shard_params(params, self.mesh)
+
+        self.param_mask = trainable_mask(
+            params, self.tcfg, config.model.num_layers_unfrozen
+        )
+        default_lr = config.optimizer.kwargs.get("lr")
+        self.schedule = get_scheduler(
+            config.scheduler.name, dict(config.scheduler.kwargs), default_lr=default_lr
+        )
+        self.optimizer = get_optimizer(
+            config.optimizer.name,
+            dict(config.optimizer.kwargs),
+            schedule=self.schedule,
+            mask=self.param_mask,
+        )
+        opt_state = jax.jit(self.optimizer.init)(params)
+        rng = jax.random.PRNGKey(config.train.seed)
+        rollout_rng, state_rng = jax.random.split(rng)
+        self.state = TrainState(
+            params=params,
+            opt_state=opt_state,
+            step=jnp.zeros((), jnp.int32),
+            rng=state_rng,
+        )
+        self._rollout_rng = rollout_rng
+
+        # generation settings (reference: accelerate_base_trainer.py:176-198)
+        self.generate_kwargs = dict(config.method.gen_kwargs)
+        self.generate_experience_kwargs = (
+            dict(config.method.gen_experience_kwargs)
+            if getattr(config.method, "gen_experience_kwargs", None)
+            else None
+        )
+        self._generate_fns: Dict[Any, Callable] = {}
+        self._train_step_fn: Optional[Callable] = None
+
+        self.tracker = make_tracker(config)
+        self.eval_pipeline: Optional[BasePipeline] = None
+        self.iter_count = 0
+        self.nth_evaluation = 0
+
+    # ------------------------------------------------------------------
+    # subclass contract
+    # ------------------------------------------------------------------
+
+    @abstractmethod
+    def loss_fn(
+        self, params: Any, batch: Dict[str, jax.Array], rng: jax.Array
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        ...
+
+    @abstractmethod
+    def prepare_learning(self) -> None:
+        ...
+
+    def post_backward_callback(self) -> None:
+        pass
+
+    def post_epoch_callback(self) -> None:
+        pass
+
+    def adjust_logits_fn(self, extra_kwargs: Dict[str, Any]) -> Optional[Callable]:
+        """On-device hook reshaping last-token logits during sampling.
+
+        ``extra_kwargs`` are the gen kwargs not consumed by
+        :class:`GenerationConfig` (e.g. ILQL's ``beta``) — resolved per
+        ``generate`` call, so kwarg overrides and eval sweeps reach the hook.
+        """
+        return None
+
+    def add_eval_pipeline(self, eval_pipeline: BasePipeline) -> None:
+        self.eval_pipeline = eval_pipeline
+
+    # ------------------------------------------------------------------
+    # train step
+    # ------------------------------------------------------------------
+
+    def _build_train_step(self) -> Callable:
+        optimizer = self.optimizer
+        schedule = self.schedule
+
+        def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
+            rng, step_rng = jax.random.split(state.rng)
+            (loss, stats), grads = jax.value_and_grad(self.loss_fn, has_aux=True)(
+                state.params, batch, step_rng
+            )
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            stats = dict(stats)
+            stats["learning_rate"] = (
+                schedule(state.step) if callable(schedule) else schedule
+            )
+            stats["gradients/global_norm"] = optax.global_norm(grads)
+            new_state = TrainState(
+                params=params,
+                opt_state=opt_state,
+                step=state.step + 1,
+                rng=rng,
+            )
+            return new_state, stats
+
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def train_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """One optimization step on a host batch; returns host scalar stats."""
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        if hasattr(batch, "_asdict"):  # NamedTuple batches (PPORLBatch, ILQLBatch)
+            batch = batch._asdict()
+        arrays = {k: v for k, v in batch.items() if hasattr(v, "ndim")}
+        arrays = shard_batch(arrays, self.mesh)
+        self.state, stats = self._train_step_fn(self.state, arrays)
+        return stats
+
+    # ------------------------------------------------------------------
+    # generation
+    # ------------------------------------------------------------------
+
+    def _apply_fn(self):
+        module = self.module
+
+        def apply_fn(params, input_ids, **kw):
+            return module.apply({"params": params}, input_ids, **kw)
+
+        return apply_fn
+
+    def _get_generate_fn(
+        self, gen_config: GenerationConfig, extra_kwargs: Tuple[Tuple[str, Any], ...] = ()
+    ) -> Callable:
+        key = (gen_config, extra_kwargs)
+        if key not in self._generate_fns:
+            apply_fn = self._apply_fn()
+            tcfg = self.tcfg
+            adjust = self.adjust_logits_fn(dict(extra_kwargs))
+
+            def fn(params, input_ids, attention_mask, rng):
+                return generate(
+                    apply_fn,
+                    params,
+                    lambda B, S: make_kv_cache(tcfg, B, S),
+                    input_ids,
+                    attention_mask,
+                    rng,
+                    gen_config,
+                    adjust_logits=adjust,
+                )
+
+            self._generate_fns[key] = jax.jit(fn)
+        return self._generate_fns[key]
+
+    def generate(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: Optional[np.ndarray] = None,
+        eval_mode: bool = False,
+        **kwargs,
+    ) -> GenerationOutput:
+        """Sample continuations for a left-padded prompt batch.
+
+        Rollout generation uses ``gen_experience_kwargs`` when configured
+        (reference ``generate`` vs ``generate_eval``,
+        ``accelerate_base_trainer.py:228-253``).
+        """
+        base = (
+            self.generate_kwargs
+            if eval_mode or self.generate_experience_kwargs is None
+            else self.generate_experience_kwargs
+        )
+        gen_kwargs = dict(base)
+        gen_kwargs.update(kwargs)
+        gen_config = GenerationConfig.from_gen_kwargs(
+            gen_kwargs,
+            eos_token_id=self.tokenizer.eos_token_id,
+            pad_token_id=self.tokenizer.pad_token_id,
+        )
+        import dataclasses as _dc
+
+        known = {f.name for f in _dc.fields(GenerationConfig)}
+        extra_kwargs = tuple(
+            sorted(
+                (k, tuple(v) if isinstance(v, list) else v)
+                for k, v in gen_kwargs.items()
+                if k not in known
+            )
+        )
+        input_ids = np.asarray(input_ids, np.int32)
+        if attention_mask is None:
+            attention_mask = (input_ids != self.tokenizer.pad_token_id).astype(np.int32)
+        self._rollout_rng, rng = jax.random.split(self._rollout_rng)
+        fn = self._get_generate_fn(gen_config, extra_kwargs)
+        batch = shard_batch(
+            {"input_ids": input_ids, "attention_mask": np.asarray(attention_mask, np.int32)},
+            self.mesh,
+        )
+        return fn(self.state.params, batch["input_ids"], batch["attention_mask"], rng)
+
+    def generate_eval(self, input_ids, attention_mask=None, **kwargs) -> GenerationOutput:
+        return self.generate(input_ids, attention_mask, eval_mode=True, **kwargs)
+
+    # ------------------------------------------------------------------
+    # decoding
+    # ------------------------------------------------------------------
+
+    def decode(
+        self,
+        prompt_ids: np.ndarray,  # [B, P] left-padded
+        response_ids: np.ndarray,  # [B, N] right-padded
+        append_eos_token: bool = False,
+    ) -> Tuple[List[str], List[str], List[str]]:
+        """Token batches → (samples, prompts, outputs) strings, trimming
+        outputs at the first stop sequence and optionally re-appending eos
+        (reference ``decode``, ``accelerate_base_trainer.py:200-226``)."""
+        str_samples, str_prompts, str_outputs = [], [], []
+        for prompt_row, response_row in zip(np.asarray(prompt_ids), np.asarray(response_ids)):
+            str_prompt = self.tokenizer.decode(prompt_row.tolist(), skip_special_tokens=True)
+            str_output = self.tokenizer.decode(response_row.tolist(), skip_special_tokens=True)
+            if self.stop_sequences:
+                for stop in self.stop_sequences:
+                    result = str_output.split(stop)[0]
+                    str_output = result
+            if append_eos_token:
+                str_output += self.tokenizer.eos_token
+            str_prompts.append(str_prompt)
+            str_outputs.append(str_output)
+            str_samples.append(str_prompt + str_output)
+        return str_samples, str_prompts, str_outputs
+
+    # ------------------------------------------------------------------
+    # evaluation
+    # ------------------------------------------------------------------
+
+    def evaluate(self) -> Dict[str, Any]:  # noqa: C901
+        """Generate on eval prompts; score with reward/metric fns.
+
+        Supports a single list-valued gen kwarg swept across generations
+        (reference ``accelerate_base_trainer.py:286-428``).
+        """
+        logger.info("Evaluating model")
+        stats: Dict[str, Any] = {}
+        table_rows: List[List[Any]] = []
+
+        sweep_key, sweep_values = None, [None]
+        for k, v in self.generate_kwargs.items():
+            if isinstance(v, list):
+                sweep_key, sweep_values = k, v
+                break
+
+        eval_batch_size = self.config.train.eval_batch_size or self.config.train.batch_size
+        loader = self.eval_pipeline.create_loader(eval_batch_size)
+
+        for sweep_value in sweep_values:
+            gen_overrides = {sweep_key: sweep_value} if sweep_key else {}
+            all_prompts: List[str] = []
+            all_outputs: List[str] = []
+            all_samples: List[str] = []
+            gen_time = time()
+            for batch in loader:
+                out = self.generate_eval(
+                    batch["input_ids"], batch["attention_mask"], **gen_overrides
+                )
+                prompt_ids = np.asarray(out.sequences)[:, : batch["input_ids"].shape[1]]
+                response_ids = to_host(out.response_tokens)
+                samples, prompts, outputs = self.decode(prompt_ids, response_ids)
+                all_samples += samples
+                all_prompts += prompts
+                all_outputs += outputs
+            stats["time/generate"] = time() - gen_time
+
+            suffix = f"@{sweep_key}={sweep_value}" if sweep_key else ""
+            if self.reward_fn:
+                rewards = np.asarray(
+                    self.reward_fn(
+                        samples=all_samples, prompts=all_prompts, outputs=all_outputs
+                    ),
+                    dtype=np.float64,
+                )
+                stats[f"reward/mean{suffix}"] = float(rewards.mean())
+                stats[f"reward/std{suffix}"] = float(rewards.std())
+            else:
+                rewards = [None] * len(all_samples)
+            if self.metric_fn:
+                metric_time = time()
+                metrics = self.metric_fn(
+                    samples=all_samples, prompts=all_prompts, outputs=all_outputs
+                )
+                stats["time/metric"] = time() - metric_time
+                for name, values in metrics.items():
+                    arr = np.asarray(values, dtype=np.float64)
+                    stats[f"metrics/{name}{suffix}"] = (
+                        float(arr.mean()) if arr.size else 0.0
+                    )
+
+            for i in range(min(len(all_prompts), 8)):
+                row = [all_prompts[i], all_outputs[i]]
+                if self.reward_fn:
+                    row.append(significant(float(rewards[i])))
+                if sweep_key:
+                    row.append(sweep_value)
+                table_rows.append(row)
+
+        if jax.process_index() == 0 and table_rows:
+            lines = ["prompt | output" + (" | reward" if self.reward_fn else "")]
+            for row in table_rows[:8]:
+                lines.append(" | ".join(str(c)[:80].replace("\n", "⏎") for c in row))
+            logger.info("Eval samples:\n" + "\n".join(lines))
+
+        self.nth_evaluation += 1
+        return stats
+
+    # ------------------------------------------------------------------
+    # the learn loop
+    # ------------------------------------------------------------------
+
+    def learn(self) -> Dict[str, Any]:  # noqa: C901
+        """Epochs → batches → n updates per batch, with interval checkpoints,
+        interval eval, and best-reward checkpointing (reference
+        ``accelerate_base_trainer.py:433-553``)."""
+        logger.info("Starting training")
+        self.prepare_learning()
+
+        results = self.evaluate()
+        self.tracker.log(results, step=self.iter_count)
+        best_reward = -float("inf")
+        clock = Clock()
+
+        tbar = logging.tqdm(
+            initial=self.iter_count,
+            total=self.total_steps,
+            disable=jax.process_index() != 0,
+            position=0,
+            leave=True,
+        )
+
+        for _ in range(self.config.train.epochs):
+            for batch in self.train_dataloader:
+                for _ in range(self.n_updates_per_batch):
+                    forward_time = time()
+                    device_stats = self.train_step(batch)
+                    stats = filter_non_scalars(to_host(device_stats))
+                    forward_time = time() - forward_time
+                    stats["time/step"] = forward_time
+                    batch_size = next(
+                        v.shape[0] for v in batch.values() if hasattr(v, "shape")
+                    ) if isinstance(batch, dict) else self.config.train.batch_size
+                    clock.tick(batch_size)
+                    stats["time/per_1k_samples"] = clock.get_stat(1000)
+                    self.iter_count += 1
+
+                    if self.iter_count % self.config.train.checkpoint_interval == 0:
+                        subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
+                        self.save(os.path.join(self.config.train.checkpoint_dir, subfolder))
+
+                    if self.iter_count % self.config.train.eval_interval == 0:
+                        results = self.evaluate()
+                        stats.update(results)
+                        if self.config.train.save_best:
+                            reward = stats.get(
+                                "reward/mean", stats.get("metrics/reward", -float("inf"))
+                            )
+                            if reward > best_reward:
+                                best_reward = reward
+                                best_path = os.path.join(
+                                    self.config.train.checkpoint_dir, "best_checkpoint"
+                                )
+                                logger.info(f"Saving best state so far into {best_path}")
+                                self.save(best_path)
+
+                    desc = " | ".join(
+                        f"{k}: {significant(v)}"
+                        for k, v in stats.items()
+                        if k.startswith("losses/")
+                    )
+                    tbar.set_description(f"[{desc}]")
+                    tbar.update()
+
+                    if self.iter_count >= self.total_steps:
+                        results = self.evaluate()
+                        stats.update(results)
+                        self.tracker.log(stats, step=self.iter_count)
+                        subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
+                        self.save(os.path.join(self.config.train.checkpoint_dir, subfolder))
+                        tbar.close()
+                        return results
+
+                    self.tracker.log(stats, step=self.iter_count)
+
+                self.post_backward_callback()
+            self.post_epoch_callback()
+        tbar.close()
+        return results
+
+    # ------------------------------------------------------------------
+    # checkpointing
+    # ------------------------------------------------------------------
+
+    def save(self, directory: Optional[str] = None, **kwargs) -> None:
+        """Checkpoint full training state (params, opt state, step, RNG)."""
+        directory = directory or self.config.train.checkpoint_dir
+        save_state(directory, self.state, extra={"iter_count": self.iter_count})
+
+    def load(self, directory: Optional[str] = None, **kwargs) -> None:
+        directory = directory or self.config.train.checkpoint_dir
+        self.state = restore_state(directory, self.state)
+        self.iter_count = int(read_extra(directory).get("iter_count", 0))
+
+    def save_pretrained(self, directory: Optional[str] = None, **kwargs) -> None:
+        directory = directory or f"{self.config.train.checkpoint_dir}/hf_model"
+        save_pretrained(
+            directory,
+            self.state.params,
+            self.tcfg,
+            tokenizer_path=self.config.tokenizer.tokenizer_path,
+        )

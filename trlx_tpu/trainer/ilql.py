@@ -1,0 +1,223 @@
+"""ILQL trainer: offline RL from reward-labeled samples.
+
+Behavioral parity target: ``AccelerateILQLTrainer`` + module-level
+``make_experience`` (``trlx/trainer/accelerate_ilql_trainer.py:30-250``):
+
+- ``make_experience`` tokenizes dialogues, builds per-token action/state
+  indices (actions at output-token positions − 1, matching the causal shift),
+  normalizes returns across the dataset, and puts the scalar return on the
+  final action token;
+- the loss runs the backbone once, gathers hidden states at action/state
+  positions, applies V/Q/target-Q heads on the *gathered* positions only
+  (the reference's ``ILQLHeads.forward`` index-select,
+  ``trlx/models/modeling_ilql.py:160-180``), and feeds ``ILQLConfig.loss``;
+- target-Q heads Polyak-sync every ``steps_for_target_q_sync`` optimizer
+  steps (``:136-138``);
+- generation reshapes sampling logits on device to
+  ``log π + β·(min target-Q − V)`` with top-k masking, via the
+  ``adjust_logits`` hook of the jitted sampler (reference custom ``generate``,
+  ``modeling_ilql.py:246-317``).
+"""
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.tokenizer import Tokenizer
+from trlx_tpu.models.heads import sync_target_q_params
+from trlx_tpu.models.ilql import ILQLConfig, batched_index_select
+from trlx_tpu.pipeline.offline_pipeline import (
+    ILQLRolloutStorage,
+    tokenize_dialogue,
+)
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base import TPUBaseTrainer
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.stats import logprobs_of_labels  # noqa: F401 (parity surface)
+
+logger = logging.get_logger(__name__)
+
+
+def make_experience(
+    samples: List[Union[str, List[str]]],
+    rewards: List[float],
+    tokenizer: Optional[Tokenizer] = None,
+    max_length: int = 2048,
+    verbose: bool = True,
+) -> ILQLRolloutStorage:
+    """Tokenize samples and shape rewards into an :class:`ILQLRolloutStorage`
+    (reference ``accelerate_ilql_trainer.py:30-99``)."""
+    if verbose:
+        logger.info("Collecting rollouts")
+    if tokenizer is not None:
+        samples = [tokenize_dialogue(s, tokenizer, max_length) for s in samples]
+
+    all_input_ids = []
+    all_actions_ixs = []
+    all_states_ixs = []
+    all_dones = []
+    for sample in samples:
+        length = 0
+        all_input_ids.append(
+            np.array([t for m in sample for t in m.tokens], dtype=np.int32)
+        )
+        actions_ixs = []
+        for dm in sample:
+            if dm.is_output:
+                # actions index into the *shifted* sequence: the action chosen
+                # at state t is the token emitted at position t+1
+                actions_ixs.append(
+                    np.arange(length - 1, length + len(dm.tokens) - 1, dtype=np.int32)
+                )
+            length += len(dm.tokens)
+        ixs = np.concatenate(actions_ixs) if actions_ixs else np.zeros(0, np.int32)
+        states_ixs = np.concatenate([ixs, np.array([length - 1], np.int32)])
+        all_dones.append(
+            np.array([1] * (len(states_ixs) - 1) + [0], dtype=np.int32)
+        )
+        all_actions_ixs.append(ixs)
+        all_states_ixs.append(states_ixs)
+
+    sample_lengths = np.array(list(map(len, all_input_ids)))
+    output_lengths = np.array(list(map(len, all_actions_ixs)))
+    prompt_lengths = sample_lengths - output_lengths
+    if verbose:
+        logger.info(
+            "Experience string stats: "
+            f"prompt {prompt_lengths.mean():.2f} ∈ [{prompt_lengths.min()}, {prompt_lengths.max()}], "
+            f"output {output_lengths.mean():.2f} ∈ [{output_lengths.min()}, {output_lengths.max()}], "
+            f"sample {sample_lengths.mean():.2f} ∈ [{sample_lengths.min()}, {sample_lengths.max()}]"
+        )
+
+    # dataset-level return normalization; scalar return lands on the final
+    # action token (reference ``:83-89``)
+    returns = np.asarray(rewards, dtype=np.float64)
+    returns = returns - returns.mean()
+    std = returns.std()
+    if not np.isnan(std) and std > 0:
+        returns = returns / (std + np.finfo(returns.dtype).eps)
+    token_rewards = [np.zeros(len(ixs), np.float32) for ixs in all_actions_ixs]
+    for rs, ret in zip(token_rewards, returns):
+        if len(rs):
+            rs[-1] = ret
+
+    attention_mask = [np.ones(len(x), np.int32) for x in all_input_ids]
+    return ILQLRolloutStorage(
+        all_input_ids,
+        attention_mask,
+        token_rewards,
+        all_states_ixs,
+        all_actions_ixs,
+        all_dones,
+    )
+
+
+@register_trainer
+class ILQLTrainer(TPUBaseTrainer):
+    model_head = "ilql"
+
+    def __init__(self, config: TRLConfig, **kwargs):
+        super().__init__(config, **kwargs)
+        if not isinstance(config.method, ILQLConfig):
+            raise ValueError("config.method must be ILQLConfig")
+        self.ilql: ILQLConfig = config.method
+        self.store: Optional[ILQLRolloutStorage] = None
+        self._sync_fn = jax.jit(
+            partial(sync_target_q_params, alpha=self.ilql.alpha)
+        )
+
+    def make_experience(
+        self, samples, rewards, max_length: int = 2048
+    ) -> None:
+        self.store = make_experience(
+            samples, rewards, self.tokenizer, max_length=max_length
+        )
+
+    # ------------------------------------------------------------------
+    # loss
+    # ------------------------------------------------------------------
+
+    def loss_fn(
+        self, params: Any, batch: Dict[str, jax.Array], rng: jax.Array
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        module = self.module
+
+        backbone_out = module.apply(
+            {"params": params},
+            batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            method=type(module).backbone_forward,
+        )
+        hidden = backbone_out["hidden_states"]
+        logits_all = backbone_out["logits"]
+
+        hs_actions = batched_index_select(hidden, batch["actions_ixs"])
+        hs_states = batched_index_select(hidden, batch["states_ixs"])
+        qs, target_qs, vs = module.apply(
+            {"params": params},
+            hs_actions,
+            hs_states,
+            method=type(module).heads_on,
+        )
+        logits = batched_index_select(logits_all, batch["actions_ixs"])
+        # the action token itself = input_ids shifted left, at the action index
+        actions = jnp.take_along_axis(
+            batch["input_ids"][:, 1:], batch["actions_ixs"], axis=1
+        )
+        return self.ilql.loss(
+            logits=logits,
+            qs=qs,
+            target_qs=target_qs,
+            vs=vs,
+            actions=actions,
+            rewards=batch["rewards"],
+            dones=batch["dones"],
+        )
+
+    def prepare_learning(self) -> None:
+        self.train_dataloader = self.store.create_loader(
+            self.config.train.batch_size, shuffle=True, seed=self.config.train.seed
+        )
+        self.n_updates_per_batch = 1
+        self.total_steps = min(
+            self.config.train.total_steps,
+            self.config.train.epochs * len(self.train_dataloader),
+        )
+
+    def post_backward_callback(self) -> None:
+        if self.iter_count % self.ilql.steps_for_target_q_sync == 0:
+            self.state = self.state.replace(
+                params=self._sync_fn(self.state.params)
+            )
+
+    # ------------------------------------------------------------------
+    # advantage-reshaped sampling
+    # ------------------------------------------------------------------
+
+    def adjust_logits_fn(self, extra_kwargs: Dict[str, Any]) -> Optional[Callable]:
+        """On-device: logits ← log π + β(min target-Q − V); the sampler's own
+        top-k/temperature filtering then applies to the shaped logits, which
+        is order-equivalent to the reference's topk-then-temperature
+        (``modeling_ilql.py:280-317`` — top-k selection is invariant under
+        positive temperature scaling). ``beta`` resolves per generate call,
+        so overrides and eval sweeps take effect."""
+        beta = float(extra_kwargs.get("beta", 1.0))
+
+        def adjust(step_out: Dict[str, Any], logits: jax.Array) -> jax.Array:
+            target_qs = step_out["target_qs"]
+            if isinstance(target_qs, (tuple, list)) and len(target_qs) > 1:
+                q = jnp.minimum(target_qs[0], target_qs[1])
+            elif isinstance(target_qs, (tuple, list)):
+                q = target_qs[0]
+            else:
+                q = target_qs
+            v = step_out["vs"]  # [B, 1]
+            adv = q.astype(jnp.float32) - v.astype(jnp.float32)
+            pi_beta = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            return pi_beta + beta * adv
+
+        return adjust

@@ -1,0 +1,339 @@
+"""PPO trainer: rollout collection with KL penalty vs a frozen reference,
+reward scaling, GAE + clipped-objective optimization.
+
+Behavioral parity target: ``AcceleratePPOTrainer``
+(``trlx/trainer/accelerate_ppo_trainer.py:33-489``):
+
+- ``make_experience`` — jitted KV-cache generation, host reward scoring,
+  running-moments reward scaling/clipping, a scoring forward for logprobs +
+  values, a frozen-reference forward (hydra branch when
+  ``num_layers_unfrozen > 0``, else a full frozen copy), per-token KL-penalty
+  rewards with the task score on the final token;
+- ``loss`` — GAE advantages/returns then the clipped PPO objective
+  (``trlx/models/modeling_ppo.py:134-233``);
+- KL controller updated post-backward, store refilled post-epoch.
+
+TPU redesign notes: the reference's rank choreography (pad/gather to rank 0,
+reward on rank 0, scatter back, ``:292-327``) collapses to device_get →
+host reward fn → shard_batch, since arrays are globally sharded. All rollout
+math (KL penalty, masked stats) runs on device in one jitted program per
+shape bucket.
+"""
+
+from time import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.ppo_types import PPORLElement
+from trlx_tpu.models.builder import hydra_ref_params
+from trlx_tpu.models.ppo import PPOConfig, kl_penalty_rewards
+from trlx_tpu.models.transformer import CausalTransformer
+from trlx_tpu.parallel import shard_batch
+from trlx_tpu.pipeline import BasePipeline
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base import TPUBaseTrainer
+from trlx_tpu.utils import infinite_loader, logging, to_host
+from trlx_tpu.utils.stats import RunningMoments, logprobs_of_labels
+
+logger = logging.get_logger(__name__)
+
+
+@register_trainer
+class PPOTrainer(TPUBaseTrainer):
+    model_head = "value"
+
+    def __init__(self, config: TRLConfig, **kwargs):
+        super().__init__(config, **kwargs)
+        method: PPOConfig = config.method
+        if not isinstance(method, PPOConfig):
+            raise ValueError("config.method must be PPOConfig")
+        if self.reward_fn is None:
+            raise ValueError("PPO requires a reward_fn")
+
+        self.store = PPORolloutStorage(self.tokenizer.pad_token_id)
+        self.kl_ctl = method.kl_controller()
+
+        # Frozen reference for the KL penalty. With a partially-unfrozen model
+        # the reference branch shares the frozen trunk and only copies the top
+        # layers (hydra; reference ``modeling_ppo.py:331-427``); otherwise a
+        # full frozen backbone copy (``accelerate_ppo_trainer.py:71-74``).
+        # Copies are real (jnp.copy): the train step donates its input state,
+        # so the snapshot must own its buffers.
+        nlu = config.model.num_layers_unfrozen
+        self.num_layers_unfrozen = nlu
+        if nlu > 0:
+            branch = hydra_ref_params(self.state.params, self.tcfg, nlu)
+            self.ref_params = jax.tree_util.tree_map(jnp.copy, branch)
+        else:
+            self.ref_params = jax.tree_util.tree_map(
+                jnp.copy, self.state.params["backbone"]
+            )
+        self._ref_module = CausalTransformer(self.tcfg)
+
+        self.running_moments = RunningMoments()
+        self.ref_mean: Optional[float] = method.ref_mean
+        self.ref_std: Optional[float] = method.ref_std
+
+        self.prompt_iterator = None
+        self.mean_kl = 0.0
+        self._score_fns: Dict[Tuple[int, int, int], Any] = {}
+        self.make_experience_stats: Dict[str, float] = {}
+
+        if config.train.rollout_logging_dir is not None:
+            self.log_rollouts = True
+            self.setup_rollout_logging(config)
+        else:
+            self.log_rollouts = False
+
+    # ------------------------------------------------------------------
+    # rollout collection
+    # ------------------------------------------------------------------
+
+    def add_prompt_pipeline(self, pipeline: BasePipeline) -> None:
+        loader = pipeline.create_loader(
+            self.config.method.chunk_size, shuffle=True, seed=self.config.train.seed
+        )
+        self.prompt_iterator = infinite_loader(loader)
+
+    def setup_rollout_logging(self, config: TRLConfig) -> None:
+        import os
+
+        dir_name = config.train.rollout_logging_dir
+        os.makedirs(dir_name, exist_ok=True)
+        self.rollout_logging_dir = dir_name
+
+    def _get_score_fn(self, batch_shape: Tuple[int, int, int]):
+        """Jitted scoring program for a (B, P, N) shape bucket: one policy
+        forward (logits + values + trunk activations), one frozen-reference
+        forward (hydra branch replay or full copy), per-token KL-penalty
+        rewards."""
+        if batch_shape in self._score_fns:
+            return self._score_fns[batch_shape]
+
+        module = self.module
+        ref_module = self._ref_module
+        nlu = self.num_layers_unfrozen
+        B, P, N = batch_shape
+
+        def score_fn(params, ref_params, sequences, prompt_mask, response_tokens,
+                     response_mask, scores, kl_coef):
+            full_mask = jnp.concatenate([prompt_mask, response_mask], axis=1)
+            out = module.apply(
+                {"params": params},
+                sequences,
+                attention_mask=full_mask,
+                branch_layer=nlu if nlu > 0 else None,
+            )
+            # logits at t predict token t+1: response token i lives at column
+            # P+i, so its logprob/value come from position P-1+i
+            logits = out["logits"][:, P - 1 : P + N - 1, :]
+            logprobs = logprobs_of_labels(logits, response_tokens)
+            values = out["value"][:, P - 1 : P + N - 1]
+
+            if nlu > 0:
+                ref_out = module.apply(
+                    {"params": {"backbone": ref_params}},
+                    out["branch_input"],
+                    nlu,
+                    full_mask,
+                    method=type(module).forward_branch,
+                )
+            else:
+                ref_out = ref_module.apply(
+                    {"params": ref_params}, sequences, attention_mask=full_mask
+                )
+            ref_logits = ref_out["logits"][:, P - 1 : P + N - 1, :]
+            ref_logprobs = logprobs_of_labels(ref_logits, response_tokens)
+
+            rewards, (mean_kl, mean_kl_per_seq) = kl_penalty_rewards(
+                logprobs, ref_logprobs, response_mask, scores, kl_coef
+            )
+            return {
+                "logprobs": logprobs,
+                "values": values,
+                "rewards": rewards,
+                "mean_kl": mean_kl,
+                "mean_kl_per_seq": mean_kl_per_seq,
+            }
+
+        fn = jax.jit(score_fn)
+        self._score_fns[batch_shape] = fn
+        return fn
+
+    def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:  # noqa: C901
+        """Collect ``num_rollouts`` experiences into the store (reference
+        ``accelerate_ppo_trainer.py:251-489``)."""
+        logger.info("Collecting rollouts")
+        if self.prompt_iterator is None:
+            raise RuntimeError("add_prompt_pipeline must be called before make_experience")
+
+        stats: Dict[str, float] = {}
+        elements = []
+        kl_sum, kl_batches = 0.0, 0
+        exp_time = time()
+
+        while len(elements) < num_rollouts:
+            batch = next(self.prompt_iterator)
+            prompt_ids = np.asarray(batch["input_ids"], np.int32)
+            prompt_mask = np.asarray(batch["attention_mask"], np.int32)
+
+            gen_time = time()
+            gen_out = self.generate(prompt_ids, prompt_mask)
+            response_tokens = to_host(gen_out.response_tokens)
+            response_mask = to_host(gen_out.response_mask)
+            stats["time/exp_generate"] = time() - gen_time
+
+            samples, prompts, outputs = self.decode(
+                prompt_ids, response_tokens, append_eos_token=True
+            )
+
+            score_time = time()
+            scores = np.asarray(
+                self.reward_fn(samples=samples, prompts=prompts, outputs=outputs),
+                dtype=np.float32,
+            )
+            stats["time/exp_score"] = time() - score_time
+
+            # reward scaling/clipping (reference :350-366)
+            scores_mean, scores_std = self.running_moments.update(scores)
+            stats["exp_scores/mean"] = float(scores_mean)
+            stats["exp_scores/std"] = float(scores_std)
+            stats["exp_scores/running_mean"] = float(self.running_moments.mean)
+            stats["exp_scores/running_std"] = float(self.running_moments.std)
+            if self.config.method.scale_reward == "running":
+                scores /= max(self.running_moments.std, 1e-8)
+            elif self.config.method.scale_reward == "ref":
+                scores /= max(self.ref_std or 1.0, 1e-8)
+            clip = self.config.method.cliprange_reward
+            if clip:
+                scores = np.clip(scores, -clip, clip)
+
+            B, P = prompt_ids.shape
+            N = response_tokens.shape[1]
+            score_fn = self._get_score_fn((B, P, N))
+            device_batch = shard_batch(
+                {
+                    "sequences": np.asarray(to_host(gen_out.sequences), np.int32),
+                    "prompt_mask": prompt_mask,
+                    "response_tokens": response_tokens,
+                    "response_mask": response_mask,
+                    "scores": scores,
+                },
+                self.mesh,
+            )
+            out = to_host(
+                score_fn(
+                    self.state.params,
+                    self.ref_params,
+                    device_batch["sequences"],
+                    device_batch["prompt_mask"],
+                    device_batch["response_tokens"],
+                    device_batch["response_mask"],
+                    device_batch["scores"],
+                    jnp.float32(self.kl_ctl.value),
+                )
+            )
+            kl_sum += float(out["mean_kl"])
+            kl_batches += 1
+            stats["policy/sqrt_kl"] = float(np.sqrt(max(out["mean_kl"], 0.0)))
+
+            for i in range(B):
+                n_i = int(response_mask[i].sum())
+                if n_i == 0:
+                    continue
+                query = prompt_ids[i][prompt_mask[i] > 0]
+                elements.append(
+                    PPORLElement(
+                        query_tensor=query,
+                        response_tensor=response_tokens[i, :n_i],
+                        logprobs=out["logprobs"][i, :n_i],
+                        values=out["values"][i, :n_i],
+                        rewards=out["rewards"][i, :n_i],
+                    )
+                )
+
+        self.mean_kl = kl_sum / max(kl_batches, 1)
+        stats["kl_ctl_value"] = self.kl_ctl.value
+        stats["time/exp"] = time() - exp_time
+        self.make_experience_stats = stats
+        self.tracker.log(stats, step=iter_count)
+
+        self.store.push(elements[:num_rollouts] if num_rollouts else elements)
+        if self.log_rollouts:
+            self.store.export_history(location=self.rollout_logging_dir)
+
+    # ------------------------------------------------------------------
+    # optimization
+    # ------------------------------------------------------------------
+
+    def loss_fn(
+        self, params: Any, batch: Dict[str, jax.Array], rng: jax.Array
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """GAE + clipped PPO objective on a rollout minibatch (reference
+        ``accelerate_ppo_trainer.py:136-207``)."""
+        method: PPOConfig = self.config.method
+        queries = batch["query_tensors"]
+        responses = batch["response_tensors"]
+        query_mask = batch["query_mask"]
+        response_mask = batch["response_mask"].astype(jnp.float32)
+        Q = queries.shape[1]
+        R = responses.shape[1]
+
+        old_logprobs = batch["logprobs"]
+        old_values = batch["values"]
+        rewards = batch["rewards"]
+
+        advantages, returns = method.get_advantages_and_returns(
+            old_values, rewards, response_mask
+        )
+
+        input_ids = jnp.concatenate([queries, responses], axis=1)
+        attention_mask = jnp.concatenate(
+            [query_mask, batch["response_mask"]], axis=1
+        )
+        out = self.module.apply(
+            {"params": params}, input_ids, attention_mask=attention_mask
+        )
+        logits = out["logits"][:, Q - 1 : Q + R - 1, :]
+        logprobs = logprobs_of_labels(logits, responses)
+        values_pred = out["value"][:, Q - 1 : Q + R - 1]
+
+        return method.loss(
+            logprobs=logprobs,
+            values=values_pred,
+            old_logprobs=old_logprobs,
+            old_values=old_values,
+            advantages=advantages,
+            returns=returns,
+            mask=response_mask,
+        )
+
+    def prepare_learning(self) -> None:
+        self.train_dataloader = self.store.create_loader(
+            self.config.train.batch_size, shuffle=True, seed=self.config.train.seed
+        )
+        self.n_updates_per_batch = self.config.method.ppo_epochs
+        self.total_steps = min(
+            self.config.train.total_steps,
+            self.config.train.epochs
+            * self.n_updates_per_batch
+            * len(self.train_dataloader),
+        )
+
+    def post_backward_callback(self) -> None:
+        # adaptive KL coefficient folds into the next compiled rollout as a
+        # scalar argument (reference ``accelerate_ppo_trainer.py:233-234``)
+        self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)
+
+    def post_epoch_callback(self) -> None:
+        # fresh rollouts with the updated policy (reference ``:222-231``)
+        self.store.clear_history()
+        self.make_experience(self.config.method.num_rollouts, self.iter_count)
+        self.train_dataloader = self.store.create_loader(
+            self.config.train.batch_size, shuffle=True, seed=self.config.train.seed
+        )

@@ -1,0 +1,57 @@
+"""Supervised fine-tuning trainer.
+
+Behavioral parity target: ``AccelerateSFTTrainer``
+(``trlx/trainer/accelerate_sft_trainer.py:16-75``) — cross-entropy on plain
+samples or on prompt/output dialogs with non-output tokens loss-masked via
+``IGNORE_INDEX`` labels built by the pipeline
+(``trlx/pipeline/offline_pipeline.py:72-99``).
+"""
+
+from typing import Any, Dict, List, Tuple, Union
+
+import jax
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.models.sft import SFTConfig
+from trlx_tpu.pipeline.offline_pipeline import DialogStore, tokenize_dialogue
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base import TPUBaseTrainer
+
+
+@register_trainer
+class SFTTrainer(TPUBaseTrainer):
+    model_head = None
+
+    def __init__(self, config: TRLConfig, **kwargs):
+        super().__init__(config, **kwargs)
+        if not isinstance(config.method, SFTConfig):
+            raise ValueError("config.method must be SFTConfig")
+        self.store: DialogStore = None
+
+    def make_experience(
+        self, samples: List[Union[str, List[str]]], seq_length: int
+    ) -> None:
+        """Tokenize samples (strings or interleaved prompt/output lists) into
+        a loss-masked :class:`DialogStore`."""
+        dialogs = [tokenize_dialogue(s, self.tokenizer, seq_length) for s in samples]
+        self.store = DialogStore(dialogs, self.tokenizer)
+
+    def loss_fn(
+        self, params: Any, batch: Dict[str, jax.Array], rng: jax.Array
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        out = self.module.apply(
+            {"params": params},
+            batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+        )
+        return self.config.method.loss(out["logits"], batch["labels"])
+
+    def prepare_learning(self) -> None:
+        self.train_dataloader = self.store.create_loader(
+            self.config.train.batch_size, shuffle=True, seed=self.config.train.seed
+        )
+        self.n_updates_per_batch = 1
+        self.total_steps = min(
+            self.config.train.total_steps,
+            self.config.train.epochs * len(self.train_dataloader),
+        )

@@ -172,8 +172,14 @@ def get_scheduler(
                 "or pass default_lr (the optimizer's lr)"
             )
         # torch CosineAnnealingLR: lr(t) = eta_min + (lr-eta_min)*(1+cos(pi t/T))/2
+        # computed in f32 (T_max can exceed int32, e.g. the 1e12 presets)
         return lambda step: eta_min + (lr - eta_min) * 0.5 * (
-            1 + jnp.cos(jnp.pi * jnp.minimum(step, t_max) / t_max)
+            1
+            + jnp.cos(
+                jnp.pi
+                * jnp.minimum(jnp.asarray(step, jnp.float32), float(t_max))
+                / float(t_max)
+            )
         )
     if name == SchedulerName.LINEAR:
         if lr is None:

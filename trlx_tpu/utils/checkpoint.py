@@ -1,0 +1,100 @@
+"""Checkpointing: Orbax-backed sharded pytree save/restore + HF-style export.
+
+Reference equivalents: ``AccelerateRLTrainer.save/load`` delegate to
+``accelerator.save_state/load_state`` (``accelerate_base_trainer.py:274-280``)
+and ``save_pretrained`` exports an HF-format directory (``:256-272``). Here
+the full train state (params + optimizer state + step) goes through Orbax —
+sharded arrays save/restore in their mesh layout without gathering to one
+host — and ``save_pretrained`` writes a flax msgpack + config JSON.
+"""
+
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def save_state(directory: str, state: Any, extra: Optional[Dict] = None) -> None:
+    """Save a train-state pytree (+ small JSON ``extra``) to ``directory``."""
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    tree_dir = os.path.join(directory, "state")
+    if os.path.exists(tree_dir):
+        shutil.rmtree(tree_dir)
+    os.makedirs(directory, exist_ok=True)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(tree_dir, state)
+    if extra is not None:
+        with open(os.path.join(directory, "trainer_state.json"), "w") as f:
+            json.dump(extra, f)
+
+
+def restore_state(directory: str, template: Any) -> Any:
+    """Restore a pytree saved by :func:`save_state`.
+
+    ``template`` (the current in-memory state) supplies structure, dtypes,
+    and shardings, so restored arrays land directly on the mesh.
+    """
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    tree_dir = os.path.join(directory, "state")
+
+    def as_restore_type(x):
+        if isinstance(x, jax.Array) and hasattr(x, "sharding"):
+            return ocp.type_handlers.ArrayRestoreArgs(
+                sharding=x.sharding, global_shape=x.shape, dtype=x.dtype
+            )
+        return ocp.type_handlers.RestoreArgs()
+
+    restore_args = jax.tree_util.tree_map(as_restore_type, template)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        return ckptr.restore(tree_dir, item=template, restore_args=restore_args)
+
+
+def read_extra(directory: str) -> Dict:
+    path = os.path.join(directory, "trainer_state.json")
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_pretrained(
+    directory: str,
+    params: Any,
+    transformer_config,
+    tokenizer_path: Optional[str] = None,
+) -> None:
+    """Export model weights + architecture config in an interoperable layout:
+    ``flax_model.msgpack`` (full param tree, host-gathered, fp32-preserving)
+    and ``config.json`` (the TransformerConfig fields)."""
+    import dataclasses
+
+    from flax import serialization
+
+    os.makedirs(directory, exist_ok=True)
+    host_params = jax.tree_util.tree_map(lambda x: np.asarray(x), jax.device_get(params))
+    with open(os.path.join(directory, "flax_model.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(host_params))
+    cfg = {
+        k: (str(v) if k in ("param_dtype", "dtype") else v)
+        for k, v in dataclasses.asdict(transformer_config).items()
+    }
+    cfg["framework"] = "trlx_tpu"
+    if tokenizer_path is not None:
+        cfg["tokenizer_path"] = tokenizer_path
+    with open(os.path.join(directory, "config.json"), "w") as f:
+        json.dump(cfg, f, indent=2)
+
+
+def load_pretrained_params(directory: str, template: Any) -> Any:
+    """Load ``flax_model.msgpack`` into the structure of ``template``."""
+    from flax import serialization
+
+    with open(os.path.join(directory, "flax_model.msgpack"), "rb") as f:
+        return serialization.from_bytes(template, f.read())

@@ -145,3 +145,12 @@ def disable_progress_bars() -> bool:
 
 def progress_bars_disabled() -> bool:
     return os.environ.get("TRLX_TPU_NO_TQDM", "0") == "1"
+
+
+def tqdm(*args, **kwargs):
+    """Verbosity-aware progress bar (reference ``_tqdm_cls``,
+    ``trlx/utils/logging.py:305-330``); honors ``TRLX_TPU_NO_TQDM``."""
+    from tqdm import auto
+
+    kwargs["disable"] = bool(kwargs.get("disable")) or progress_bars_disabled()
+    return auto.tqdm(*args, **kwargs)

@@ -1,0 +1,163 @@
+"""Experiment trackers: JSONL (always available), TensorBoard, W&B.
+
+Reference equivalent: ``AccelerateRLTrainer.__init__`` tracker setup
+(``trlx/trainer/accelerate_base_trainer.py:69-119``) — W&B with a composed
+run name, or TensorBoard with a flattened config. Here the default is a plain
+JSONL stats stream (offline-friendly); W&B/TensorBoard attach when their
+packages exist. All trackers log only from process 0.
+"""
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+from trlx_tpu.utils import filter_non_scalars, get_git_tag, significant
+
+
+class Tracker:
+    """Null tracker: drops everything."""
+
+    def log(self, stats: Dict[str, Any], step: int) -> None:
+        pass
+
+    def finish(self) -> None:
+        pass
+
+
+class JSONLTracker(Tracker):
+    """Appends one JSON object per log call to ``<dir>/stats.jsonl``."""
+
+    def __init__(self, logging_dir: str, config_dict: Optional[Dict] = None):
+        os.makedirs(logging_dir, exist_ok=True)
+        self.path = os.path.join(logging_dir, "stats.jsonl")
+        if config_dict is not None:
+            with open(os.path.join(logging_dir, "config.json"), "w") as f:
+                json.dump(config_dict, f, indent=2, default=str)
+        self._f = open(self.path, "a")
+
+    def log(self, stats: Dict[str, Any], step: int) -> None:
+        record = {"step": step, "time": time.time()}
+        record.update(
+            {k: significant(v) for k, v in filter_non_scalars(stats).items()}
+        )
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def finish(self) -> None:
+        self._f.close()
+
+
+class TensorBoardTracker(Tracker):
+    def __init__(self, logging_dir: str, config_dict: Optional[Dict] = None):
+        from torch.utils.tensorboard import SummaryWriter
+
+        self.writer = SummaryWriter(logging_dir)
+        if config_dict is not None:
+            from trlx_tpu.utils import flatten_dict
+
+            flat = {
+                k: str(v) for k, v in flatten_dict(config_dict, sep=".").items()
+            }
+            self.writer.add_hparams(
+                {k: v for k, v in flat.items() if isinstance(v, (int, float, str))},
+                {},
+                run_name=".",
+            )
+
+    def log(self, stats: Dict[str, Any], step: int) -> None:
+        for k, v in filter_non_scalars(stats).items():
+            self.writer.add_scalar(k, v, step)
+
+    def finish(self) -> None:
+        self.writer.close()
+
+
+class WandbTracker(Tracker):
+    def __init__(
+        self,
+        project: str,
+        run_name: str,
+        entity: Optional[str] = None,
+        group: Optional[str] = None,
+        tags=None,
+        config_dict: Optional[Dict] = None,
+        logging_dir: Optional[str] = None,
+    ):
+        import wandb
+
+        self.run = wandb.init(
+            project=project,
+            name=run_name,
+            entity=entity,
+            group=group,
+            tags=tags,
+            config=config_dict,
+            dir=logging_dir,
+            mode=os.environ.get("WANDB_MODE", "online"),
+        )
+
+    def log(self, stats: Dict[str, Any], step: int) -> None:
+        self.run.log(filter_non_scalars(stats), step=step)
+
+    def finish(self) -> None:
+        self.run.finish()
+
+
+def run_name_for(config) -> str:
+    """``<model>/<n>devices:<git branch>`` — the reference composes script/
+    model/ngpus:branch (``accelerate_base_trainer.py:69-102``)."""
+    model = os.path.basename(config.model.model_path.rstrip("/")).replace(":", "-")
+    branch, _ = get_git_tag()
+    return f"{model}/{jax.device_count()}devices:{branch}"
+
+
+def make_tracker(config) -> Tracker:
+    """Build the tracker named by ``config.train.tracker``.
+
+    ``None`` → JSONL into ``logging_dir`` (or null tracker if no dir);
+    ``"wandb"`` / ``"tensorboard"`` fall back to JSONL with a warning when
+    the package is unavailable. Non-zero processes always get the null
+    tracker (single-writer, like the reference's main-process gating).
+    """
+    if jax.process_index() != 0:
+        return Tracker()
+    name = config.train.tracker
+    logging_dir = config.train.logging_dir or os.path.join(
+        config.train.checkpoint_dir, "logs"
+    )
+    config_dict = config.to_dict()
+    if name in (None, "jsonl"):
+        if name is None and config.train.logging_dir is None and config.train.checkpoint_dir is None:
+            return Tracker()
+        return JSONLTracker(logging_dir, config_dict)
+    if name == "tensorboard":
+        try:
+            return TensorBoardTracker(logging_dir, config_dict)
+        except ImportError:
+            pass
+    elif name == "wandb":
+        try:
+            return WandbTracker(
+                project=config.train.project_name,
+                run_name=run_name_for(config),
+                entity=config.train.entity_name,
+                group=config.train.group_name,
+                tags=list(config.train.tags) + ["trlx_tpu"],
+                config_dict=config_dict,
+                logging_dir=logging_dir,
+            )
+        except ImportError:
+            # real wandb failures (auth, bad entity, network) must surface;
+            # only a missing package downgrades to JSONL
+            pass
+    else:
+        raise ValueError(f"Unknown tracker '{name}' (use jsonl|tensorboard|wandb)")
+    from trlx_tpu.utils.logging import get_logger
+
+    get_logger(__name__).warning(
+        f"tracker '{name}' unavailable; falling back to JSONL at {logging_dir}"
+    )
+    return JSONLTracker(logging_dir, config_dict)
