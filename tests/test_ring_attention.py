@@ -1,0 +1,121 @@
+"""Ring attention vs the monolithic oracle on the virtual 8-device CPU mesh.
+
+The reference has no context parallelism at all (SURVEY.md §2.3: "CP / ring
+attention — absent"); this is a new first-class capability, so it gets exact
+numerics tests: forward and backward must match full-sequence attention.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from trlx_tpu.ops.flash_attention import attention_reference
+from trlx_tpu.parallel.ring_attention import ring_flash_attention
+
+
+def _mesh(n):
+    devs = np.array(jax.devices()[:n]).reshape(1, 1, 1, n)
+    return Mesh(devs, ("data", "fsdp", "model", "sequence"))
+
+
+def _mk(B=2, T=32, H=2, D=8, left_pad=0, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, H, D), jnp.float32)
+    mask = np.ones((B, T), np.float32)
+    if left_pad:
+        mask[0, :left_pad] = 0.0
+        mask[1, : left_pad + 3] = 0.0
+    return q, k, v, jnp.asarray(mask)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+@pytest.mark.parametrize("left_pad", [0, 5])
+def test_ring_forward_matches_full(n, left_pad):
+    q, k, v, mask = _mk(left_pad=left_pad)
+    mesh = _mesh(n)
+    out = jax.jit(
+        lambda q, k, v: ring_flash_attention(
+            q, k, v, mask, mesh, block_q=8, block_k=8, interpret=True
+        )
+    )(q, k, v)
+    ref, _ = attention_reference(q, k, v, mask, causal=True)
+    valid = np.asarray(mask) > 0
+    np.testing.assert_allclose(
+        np.asarray(out)[valid], np.asarray(ref)[valid], atol=3e-5, rtol=3e-5
+    )
+
+
+@pytest.mark.parametrize("n", [4])
+@pytest.mark.parametrize("left_pad", [0, 5])
+def test_ring_gradients_match_full(n, left_pad):
+    q, k, v, mask = _mk(left_pad=left_pad, seed=3)
+    mesh = _mesh(n)
+
+    def loss_ring(q, k, v):
+        out = ring_flash_attention(
+            q, k, v, mask, mesh, block_q=8, block_k=8, interpret=True
+        )
+        return jnp.sum((out * mask[..., None, None]) ** 2)
+
+    def loss_ref(q, k, v):
+        out, _ = attention_reference(q, k, v, mask, causal=True)
+        return jnp.sum((out * mask[..., None, None]) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gr), np.asarray(gf), atol=1e-4, rtol=1e-4,
+            err_msg=f"ring grad mismatch for {name}",
+        )
+
+
+def test_ring_size_one_falls_back():
+    q, k, v, mask = _mk(T=16)
+    mesh = _mesh(1)
+    out = ring_flash_attention(
+        q, k, v, mask, mesh, block_q=8, block_k=8, interpret=True
+    )
+    ref, _ = attention_reference(q, k, v, mask, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_rejects_indivisible_length():
+    q, k, v, mask = _mk(T=30)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_flash_attention(q, k, v, mask, _mesh(4), interpret=True)
+
+
+def test_model_forward_with_sequence_mesh_matches_unsharded():
+    """Full CausalTransformer forward with the global mesh's sequence axis > 1
+    routes attention through the ring and matches the unsharded xla path."""
+    import dataclasses
+
+    from trlx_tpu.models.transformer import CausalTransformer, config_from_spec
+    from trlx_tpu.parallel import set_global_mesh
+
+    cfg_x = config_from_spec("builtin:gpt2-test", dtype=jnp.float32, attention_impl="xla")
+    cfg_p = dataclasses.replace(cfg_x, attention_impl="pallas")
+    model_x, model_p = CausalTransformer(cfg_x), CausalTransformer(cfg_p)
+    B, T = 2, 16
+    ids = jax.random.randint(jax.random.PRNGKey(0), (B, T), 0, cfg_x.vocab_size)
+    mask = jnp.ones((B, T), jnp.int32).at[0, :4].set(0)
+    params = model_x.init(jax.random.PRNGKey(1), ids)["params"]
+    lx = model_x.apply({"params": params}, ids, attention_mask=mask)["logits"]
+    set_global_mesh(_mesh(4))
+    try:
+        # partial-manual shard_map requires a surrounding jit (as in trainers)
+        lp = jax.jit(
+            lambda p: model_p.apply({"params": p}, ids, attention_mask=mask)["logits"]
+        )(params)
+    finally:
+        set_global_mesh(None)
+    valid = np.asarray(mask) > 0
+    np.testing.assert_allclose(
+        np.asarray(lp, np.float32)[valid], np.asarray(lx, np.float32)[valid],
+        atol=5e-4, rtol=5e-4,
+    )

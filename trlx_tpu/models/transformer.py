@@ -38,6 +38,30 @@ def param_with_axes(init: Callable, axes: Tuple[str, ...]) -> Callable:
     return init
 
 
+def _maybe_ring_mesh(T: int):
+    """The global mesh, iff its ``sequence`` axis should carry this pass
+    (full self-attention forward; ring doesn't apply to cache decode and the
+    ALiBi ring path is not implemented — plain flash handles those, with
+    GSPMD gathering K/V if activations are sequence-sharded)."""
+    from trlx_tpu.parallel.mesh import get_global_mesh
+
+    try:
+        from jax._src.core import trace_state_clean
+    except ImportError:  # pragma: no cover - private API moved
+        def trace_state_clean():
+            return False
+
+    mesh = get_global_mesh()
+    if (
+        mesh is not None
+        and not trace_state_clean()  # eager (e.g. module.init): plain flash
+        and mesh.shape.get("sequence", 1) > 1
+        and T % mesh.shape["sequence"] == 0
+    ):
+        return mesh
+    return None
+
+
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
     """Architecture description of a causal decoder-only transformer."""
@@ -74,9 +98,14 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16  # activation/compute dtype
     remat: str = "none"  # none | minimal | full
     scan_layers: bool = False
-    # attention implementation: "xla" (dot-product, XLA-fused) or "pallas"
-    # (flash attention kernel; falls back to xla off-TPU)
-    attention_impl: str = "xla"
+    # attention implementation: "auto" (pallas flash kernel on TPU, xla
+    # elsewhere), "xla" (dot-product, XLA-fused), or "pallas" (force flash)
+    attention_impl: str = "auto"
+
+    def resolved_attention_impl(self) -> str:
+        if self.attention_impl == "auto":
+            return "pallas" if jax.default_backend() == "tpu" else "xla"
+        return self.attention_impl
 
     @property
     def kv_heads(self) -> int:
@@ -313,10 +342,11 @@ class Attention(nn.Module):
     def __call__(
         self,
         x: jax.Array,  # [B, T, E]
-        attention_bias: jax.Array,  # [B, 1, T, S] additive
+        attention_bias: Optional[jax.Array],  # [B, 1, T, S] additive (xla path)
         positions: jax.Array,  # [B, T]
         cache: Optional[Dict[str, jax.Array]] = None,
         cache_index: Optional[jax.Array] = None,
+        flash_args: Optional[Dict[str, Any]] = None,  # pallas path (see below)
     ):
         cfg = self.config
         B, T, _ = x.shape
@@ -342,16 +372,43 @@ class Attention(nn.Module):
             k, v = k_cache, v_cache
             new_cache = {"k": k_cache, "v": v_cache}
 
-        if KV < H:
-            reps = H // KV
-            k = jnp.repeat(k, reps, axis=2)
-            v = jnp.repeat(v, reps, axis=2)
+        ring_mesh = None
+        if flash_args is not None and cache is None and cfg.position_scheme != "alibi":
+            ring_mesh = _maybe_ring_mesh(T)
+        if ring_mesh is not None:
+            # sequence-parallel exact attention: K/V chunks rotate around the
+            # mesh's ``sequence`` ring (context parallelism; beyond the
+            # reference, which caps seq_length instead — SURVEY.md §5)
+            from trlx_tpu.parallel.ring_attention import ring_flash_attention
 
-        depth = jnp.asarray(D, cfg.dtype)
-        scores = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(depth)
-        scores = scores + attention_bias.astype(scores.dtype)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, H * D)
+            out = ring_flash_attention(
+                q, k, v, flash_args["key_mask"], ring_mesh
+            ).reshape(B, T, H * D)
+        elif flash_args is not None:
+            # fused flash-attention kernel; masking semantics identical to the
+            # additive-bias path (slot-causal + key validity + optional ALiBi)
+            from trlx_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(
+                q,
+                k,
+                v,
+                flash_args["key_mask"],
+                causal=True,
+                q_offset=flash_args.get("q_offset", 0),
+                q_positions=flash_args.get("q_positions"),
+                k_positions=flash_args.get("k_positions"),
+                alibi_slopes=flash_args.get("alibi_slopes"),
+            ).reshape(B, T, H * D)
+        else:
+            if KV < H:  # flash/ring kernels consume unrepeated K/V (GQA-aware)
+                k = jnp.repeat(k, H // KV, axis=2)
+                v = jnp.repeat(v, H // KV, axis=2)
+            depth = jnp.asarray(D, cfg.dtype)
+            scores = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(depth)
+            scores = scores + attention_bias.astype(scores.dtype)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, H * D)
         out = _dense(cfg, cfg.hidden_size, cfg.attn_bias, ("joined_kv", "embed"), "o_proj")(out)
         return out, new_cache
 
@@ -376,10 +433,10 @@ class Block(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, attention_bias, positions, cache=None, cache_index=None):
+    def __call__(self, x, attention_bias, positions, cache=None, cache_index=None, flash_args=None):
         cfg = self.config
         h = Norm(cfg, name="ln_attn")(x)
-        attn_out, new_cache = Attention(cfg, name="attn")(h, attention_bias, positions, cache, cache_index)
+        attn_out, new_cache = Attention(cfg, name="attn")(h, attention_bias, positions, cache, cache_index, flash_args)
         if cfg.parallel_residual:
             mlp_in = h if cfg.shared_ln else Norm(cfg, name="ln_mlp")(x)
             x = x + attn_out + MLP(cfg, name="mlp")(mlp_in)
@@ -472,6 +529,18 @@ class CausalTransformer(nn.Module):
             bias = bias + jnp.where(visible[:, None, :, :], alibi, 0.0)
         return bias
 
+    def _flash_args(self, key_mask, query_positions, q_offset=0) -> Dict[str, Any]:
+        """Inputs for the pallas flash-attention path: same masking semantics
+        as ``_attention_bias`` but resolved inside the kernel (no [B,1,T,S]
+        bias tensor is ever materialised)."""
+        cfg = self.config
+        args: Dict[str, Any] = {"key_mask": key_mask, "q_offset": q_offset}
+        if cfg.position_scheme == "alibi":
+            args["alibi_slopes"] = jnp.asarray(alibi_slopes(cfg.num_heads), jnp.float32)
+            args["q_positions"] = query_positions
+            args["k_positions"] = jnp.maximum(jnp.cumsum(key_mask, axis=1) - 1, 0)
+        return args
+
     def __call__(
         self,
         input_ids: jax.Array,  # [B, T]
@@ -500,7 +569,19 @@ class CausalTransformer(nn.Module):
                 positions = jax.vmap(lambda kp, qs: kp[qs])(key_pos, query_slots)
 
         x = self._embed(input_ids, positions)
-        bias = self._attention_bias(attention_mask, query_slots, positions)
+        use_flash = cfg.resolved_attention_impl() == "pallas" and T > 1
+        if use_flash:
+            bias = None
+            flash_args = self._flash_args(
+                attention_mask,
+                positions,
+                q_offset=(
+                    cache_index if cache is not None and cache_index is not None else 0
+                ),
+            )
+        else:
+            flash_args = None
+            bias = self._attention_bias(attention_mask, query_slots, positions)
 
         branch_input = None
         new_cache = [] if cache is not None else None
@@ -508,7 +589,7 @@ class CausalTransformer(nn.Module):
             if branch_layer is not None and i == len(self.blocks) - branch_layer:
                 branch_input = x
             layer_cache = cache[i] if cache is not None else None
-            x, updated = block(x, bias, positions, layer_cache, cache_index)
+            x, updated = block(x, bias, positions, layer_cache, cache_index, flash_args)
             if cache is not None:
                 new_cache.append(updated)
 
@@ -545,10 +626,13 @@ class CausalTransformer(nn.Module):
         if positions is None:
             positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
         query_slots = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-        bias = self._attention_bias(attention_mask, query_slots, positions)
+        if cfg.resolved_attention_impl() == "pallas" and T > 1:
+            bias, flash_args = None, self._flash_args(attention_mask, positions)
+        else:
+            bias, flash_args = self._attention_bias(attention_mask, query_slots, positions), None
         x = hidden_states
         for block in self.blocks[len(self.blocks) - branch_layer :]:
-            x, _ = block(x, bias, positions)
+            x, _ = block(x, bias, positions, flash_args=flash_args)
         h = self.ln_f(x) if cfg.final_norm else x
         return {"logits": self._logits(h), "hidden_states": h}
 

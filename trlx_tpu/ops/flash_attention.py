@@ -1,0 +1,727 @@
+"""Fused flash attention as a Pallas TPU kernel.
+
+This replaces the materialised ``[B, H, T, S]`` score tensor of the naive XLA
+path (``trlx_tpu/models/transformer.py``) for the two training-dominant passes
+identified in SURVEY.md §3 — the rollout scoring forward and the train-step
+forward/backward. (Single-token decode keeps the einsum path: its score tensor
+is ``[B, H, 1, S]`` and HBM-bound either way.) The reference gets the same op
+from CUDA fused attention inside HF transformers (SURVEY.md §2.4); here it is
+a TPU kernel with an online-softmax forward and a recomputation backward wired
+up via ``jax.custom_vjp``.
+
+Design notes:
+- Masking is *slot-causal + key-validity*, matching
+  ``CausalTransformer._attention_bias``: key slot ``s`` is visible to query
+  slot ``t`` iff ``s + k_offset <= t + q_offset`` (when causal) and
+  ``key_mask[b, s] > 0``. Offsets make the same kernel serve ring attention
+  (``trlx_tpu/parallel/ring_attention.py``), where each device holds one
+  rotating chunk of K/V with a different global slot offset.
+- ALiBi (BLOOM) is applied in-kernel from per-slot *token positions* (cumsum
+  of the mask, computed by the caller) so left-padded prompts get correct
+  relative distances.
+- The forward also emits the per-row logsumexp ``L``; ``(out, L)`` pairs
+  combine associatively, which is exactly what the ring-attention accumulator
+  needs.
+- f32 accumulation throughout; inputs may be bf16.
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+NEG_INF = -1e30
+# lane width for per-row stats (lse/delta); 8 is the f32 sublane minimum and
+# the "equal to the overall array dim" rule makes the last dim legal
+LANES = 8
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    qoff_ref,  # SMEM (1,)
+    koff_ref,  # SMEM (1,)
+    q_ref,  # (1, 1, bQ, D)
+    k_ref,  # (1, 1, Sp, D)
+    v_ref,  # (1, 1, Sp, D)
+    kmask_ref,  # (1, 1, Sp)
+    qpos_ref,  # (1, 1, bQ)
+    kpos_ref,  # (1, 1, Sp)
+    slopes_ref,  # SMEM (H,) alibi slopes
+    o_ref,  # (1, 1, bQ, D)
+    l_ref,  # (1, 1, bQ, LANES) lane-replicated logsumexp
+    *,
+    sm_scale: float,
+    causal: bool,
+    alibi: bool,
+    block_k: int,
+    seq_k: int,
+    block_q: int,
+):
+    iq = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (bQ, D)
+    qoff = qoff_ref[0]
+    koff = koff_ref[0]
+    q_slots = qoff + iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    if alibi:
+        q_pos = qpos_ref[0, 0].astype(jnp.float32).reshape(block_q, 1)
+        slope = slopes_ref[pl.program_id(1)]
+
+    n_k = seq_k // block_k
+    if causal:
+        # last k block whose first slot can be visible to any query in this
+        # q block: k_slot <= q_slot  ⇔  koff + s <= qoff + (iq+1)*bQ - 1
+        hi = jnp.clip(
+            (qoff + (iq + 1) * block_q - koff + block_k - 1) // block_k, 0, n_k
+        )
+    else:
+        hi = n_k
+
+    def body(ik, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
+        kmask = kmask_ref[0, 0, pl.ds(ik * block_k, block_k)].reshape(1, block_k)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bQ, bK)
+        k_slots = (
+            koff
+            + ik * block_k
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        )
+        visible = kmask > 0.5
+        if causal:
+            visible = visible & (k_slots <= q_slots)
+        if alibi:
+            k_pos = kpos_ref[0, 0, pl.ds(ik * block_k, block_k)].astype(
+                jnp.float32
+            ).reshape(1, block_k)
+            s = s + slope * (k_pos - q_pos)
+        s = jnp.where(visible, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        # exp(NEG_INF - m_new) underflows to 0 unless the whole row is masked
+        # (m_new == NEG_INF); the explicit `visible` factor covers that case.
+        p = jnp.exp(s - m_new) * visible.astype(jnp.float32)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc = acc * alpha + pv
+        return acc, m_new, l
+
+    d = q_ref.shape[-1]
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc, m, l))
+
+    safe_l = jnp.where(l > 0.0, l, 1.0)
+    o_ref[0, 0] = (acc / safe_l).astype(o_ref.dtype)
+    logsum = jnp.where(l > 0.0, m + jnp.log(safe_l), NEG_INF)
+    l_ref[0, 0] = jnp.broadcast_to(logsum, (block_q, LANES))
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    qoff_ref,
+    koff_ref,
+    q_ref,  # (1, 1, bQ, D)
+    k_ref,  # (1, 1, Sp, D)
+    v_ref,  # (1, 1, Sp, D)
+    kmask_ref,  # (1, 1, Sp)
+    qpos_ref,
+    kpos_ref,
+    slopes_ref,
+    lse_ref,  # (1, 1, bQ, LANES)
+    delta_ref,  # (1, 1, bQ, LANES)
+    do_ref,  # (1, 1, bQ, D)
+    dq_ref,  # (1, 1, bQ, D)
+    *,
+    sm_scale: float,
+    causal: bool,
+    alibi: bool,
+    block_k: int,
+    seq_k: int,
+    block_q: int,
+):
+    iq = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0:1]
+    delta = delta_ref[0, 0, :, 0:1]
+    qoff = qoff_ref[0]
+    koff = koff_ref[0]
+    q_slots = qoff + iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    if alibi:
+        q_pos = qpos_ref[0, 0].astype(jnp.float32).reshape(block_q, 1)
+        slope = slopes_ref[pl.program_id(1)]
+
+    n_k = seq_k // block_k
+    if causal:
+        hi = jnp.clip(
+            (qoff + (iq + 1) * block_q - koff + block_k - 1) // block_k, 0, n_k
+        )
+    else:
+        hi = n_k
+
+    def body(ik, dq):
+        k = k_ref[0, 0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
+        kmask = kmask_ref[0, 0, pl.ds(ik * block_k, block_k)].reshape(1, block_k)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        k_slots = (
+            koff
+            + ik * block_k
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        )
+        visible = kmask > 0.5
+        if causal:
+            visible = visible & (k_slots <= q_slots)
+        if alibi:
+            k_pos = kpos_ref[0, 0, pl.ds(ik * block_k, block_k)].astype(
+                jnp.float32
+            ).reshape(1, block_k)
+            s = s + slope * (k_pos - q_pos)
+        p = jnp.exp(jnp.where(visible, s, NEG_INF) - lse) * visible.astype(
+            jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dq_blk = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dq + dq_blk
+
+    d = q_ref.shape[-1]
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0, 0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    qoff_ref,
+    koff_ref,
+    q_ref,  # (1, 1, Tp, D)  full queries
+    k_ref,  # (1, 1, bK, D)
+    v_ref,  # (1, 1, bK, D)
+    kmask_ref,  # (1, 1, bK)
+    qpos_ref,  # (1, 1, Tp)
+    kpos_ref,  # (1, 1, bK)
+    slopes_ref,
+    lse_ref,  # (1, 1, Tp, LANES)
+    delta_ref,  # (1, 1, Tp, LANES)
+    do_ref,  # (1, 1, Tp, D)
+    dk_ref,  # (1, 1, bK, D)
+    dv_ref,  # (1, 1, bK, D)
+    *,
+    sm_scale: float,
+    causal: bool,
+    alibi: bool,
+    block_q: int,
+    seq_q: int,
+    block_k: int,
+):
+    ik = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)  # (bK, D)
+    v = v_ref[0, 0].astype(jnp.float32)
+    kmask = kmask_ref[0, 0].reshape(1, block_k)
+    qoff = qoff_ref[0]
+    koff = koff_ref[0]
+    k_slots = koff + ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    if alibi:
+        k_pos = kpos_ref[0, 0].astype(jnp.float32).reshape(1, block_k)
+        slope = slopes_ref[pl.program_id(1)]
+
+    n_q = seq_q // block_q
+    if causal:
+        # first q block that can see this k block: q_slot >= k_slot
+        # ⇔ qoff + t >= koff + ik*bK
+        lo = jnp.clip((koff + ik * block_k - qoff) // block_q, 0, n_q)
+    else:
+        lo = 0
+
+    def body(iq, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32) * sm_scale
+        do = do_ref[0, 0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(iq * block_q, block_q), 0:1]
+        delta = delta_ref[0, 0, pl.ds(iq * block_q, block_q), 0:1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        q_slots = qoff + iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        visible = kmask > 0.5
+        if causal:
+            visible = visible & (k_slots <= q_slots)
+        if alibi:
+            q_pos = qpos_ref[0, 0, pl.ds(iq * block_q, block_q)].astype(
+                jnp.float32
+            ).reshape(block_q, 1)
+            s = s + slope * (k_pos - q_pos)
+        p = jnp.exp(jnp.where(visible, s, NEG_INF) - lse) * visible.astype(
+            jnp.float32
+        )
+        dv_blk = jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bK, D)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dk_blk = jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bK, D)
+        return dk + dk_blk, dv + dv_blk
+
+    d = q_ref.shape[-1]
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, n_q, body, (zeros, zeros))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# host-side wrappers
+# ---------------------------------------------------------------------------
+
+
+def _pad_to(x: jax.Array, mult: int, axis: int) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _smem_spec():
+    if _HAS_PLTPU:
+        return pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.BlockSpec(memory_space=pl.ANY)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12, 13)
+)
+def _flash(
+    q,  # (B, H, T, D)
+    k,  # (B, H, S, D)
+    v,  # (B, H, S, D)
+    kmask,  # (B, 1, S) float
+    qpos,  # (B, 1, T) int32
+    kpos,  # (B, 1, S) int32
+    slopes,  # (H,) float32 (zeros when alibi disabled)
+    offsets,  # (q_offset, k_offset) int32 arrays of shape (1,)
+    sm_scale: float,
+    causal: bool,
+    alibi: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+):
+    out, _ = _flash_fwd_impl(
+        q, k, v, kmask, qpos, kpos, slopes, offsets,
+        sm_scale, causal, alibi, block_q, block_k, interpret,
+    )
+    return out
+
+
+def _flash_fwd_impl(
+    q, k, v, kmask, qpos, kpos, slopes, offsets,
+    sm_scale, causal, alibi, block_q, block_k, interpret,
+):
+    B, H, T, D = q.shape
+    KV, S = k.shape[1], k.shape[2]
+    group = H // KV  # grouped-query attention: q-head h reads kv-head h//group
+    qoff, koff = offsets
+    grid = (B, H, T // block_q)
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        alibi=alibi,
+        block_k=block_k,
+        seq_k=S,
+        block_q=block_q,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _smem_spec(),
+            _smem_spec(),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
+            _smem_spec(),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qoff, koff, q, k, v, kmask, qpos, kpos, slopes)
+    return out, lse
+
+
+def _flash_fwd_rule(
+    q, k, v, kmask, qpos, kpos, slopes, offsets,
+    sm_scale, causal, alibi, block_q, block_k, interpret,
+):
+    out, lse = _flash_fwd_impl(
+        q, k, v, kmask, qpos, kpos, slopes, offsets,
+        sm_scale, causal, alibi, block_q, block_k, interpret,
+    )
+    res = (q, k, v, kmask, qpos, kpos, slopes, offsets, out, lse)
+    return out, res
+
+
+def _bwd_dq_call(
+    qoff, koff, q, k, v, kmask, qpos, kpos, slopes, lse, delta, do,
+    sm_scale, causal, alibi, block_q, block_k, interpret,
+):
+    """dq pallas call on kernel-layout padded inputs (lse/delta lane-replicated)."""
+    B, H, T, D = q.shape
+    KV, S = k.shape[1], k.shape[2]
+    group = H // KV
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        alibi=alibi,
+        block_k=block_k,
+        seq_k=S,
+        block_q=block_q,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B, H, T // block_q),
+        in_specs=[
+            _smem_spec(),
+            _smem_spec(),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
+            _smem_spec(),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        interpret=interpret,
+    )(qoff, koff, q, k, v, kmask, qpos, kpos, slopes, lse, delta, do)
+    return dq
+
+
+def _bwd_dkv_call(
+    qoff, koff, q, k, v, kmask, qpos, kpos, slopes, lse, delta, do,
+    sm_scale, causal, alibi, block_q, block_k, interpret,
+):
+    """dk/dv pallas call on kernel-layout padded inputs.
+
+    With GQA the per-q-head partials (B, H, S, D) are summed over each kv
+    group before returning, so callers always get grads shaped like k/v.
+    """
+    B, H, T, D = q.shape
+    KV, S = k.shape[1], k.shape[2]
+    group = H // KV
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        alibi=alibi,
+        block_q=block_q,
+        seq_q=T,
+        block_k=block_k,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B, H, S // block_k),
+        in_specs=[
+            _smem_spec(),
+            _smem_spec(),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h // group, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h // group, i, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, h, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, T), lambda b, h, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, h, i: (b, 0, i)),
+            _smem_spec(),
+            pl.BlockSpec((1, 1, T, LANES), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, T, LANES), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, S, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qoff, koff, q, k, v, kmask, qpos, kpos, slopes, lse, delta, do)
+    if group > 1:
+        dk = dk.reshape(B, KV, group, S, D).sum(axis=2)
+        dv = dv.reshape(B, KV, group, S, D).sum(axis=2)
+    return dk, dv
+
+
+def _flash_bwd_rule(
+    sm_scale, causal, alibi, block_q, block_k, interpret, res, do
+):
+    q, k, v, kmask, qpos, kpos, slopes, offsets, out, lse = res
+    B, H, T, D = q.shape
+    qoff, koff = offsets
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (B, H, T)
+    delta = jnp.broadcast_to(delta[..., None], (B, H, T, LANES))
+
+    args = (qoff, koff, q, k, v, kmask, qpos, kpos, slopes, lse, delta, do)
+    opts = (sm_scale, causal, alibi, block_q, block_k, interpret)
+    dq = _bwd_dq_call(*args, *opts)
+    dk, dv = _bwd_dkv_call(*args, *opts)
+
+    zeros_like = jax.tree_util.tree_map(jnp.zeros_like, (kmask, qpos, kpos, slopes, offsets))
+    return (dq, dk, dv) + zeros_like
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_bwd_chunk(
+    q: jax.Array,  # (B, T, H, D) local queries
+    k: jax.Array,  # (B, S, H, D) visiting key chunk
+    v: jax.Array,  # (B, S, H, D)
+    key_mask: jax.Array,  # (B, S)
+    lse: jax.Array,  # (B, H, T) GLOBAL logsumexp of the full (ring) softmax
+    delta: jax.Array,  # (B, H, T) rowsum(do * out_final)
+    do: jax.Array,  # (B, T, H, D) cotangent of the final output
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    q_offset=0,
+    k_offset=0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One (q-chunk × kv-chunk) term of the flash backward, in model layout.
+
+    With the *global* ``lse``/``delta``, summing these terms over all kv
+    chunks (rotating around the ring) reproduces the exact monolithic
+    backward — this is the building block of the ring-attention VJP
+    (``trlx_tpu/parallel/ring_attention.py``).
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    if interpret:
+        block_q = min(block_q, max(T, 8))
+        block_k = min(block_k, max(S, 8))
+
+    qt = _pad_to(q.transpose(0, 2, 1, 3), block_q, 2)
+    kt = _pad_to(k.transpose(0, 2, 1, 3), block_k, 2)
+    vt = _pad_to(v.transpose(0, 2, 1, 3), block_k, 2)
+    dot = _pad_to(do.transpose(0, 2, 1, 3), block_q, 2)
+    Tp, Sp = qt.shape[2], kt.shape[2]
+    kmask = _pad_to(key_mask.astype(jnp.float32), block_k, 1).reshape(B, 1, Sp)
+    qpos = jnp.zeros((B, 1, Tp), jnp.int32)
+    kpos = jnp.zeros((B, 1, Sp), jnp.int32)
+    slopes = jnp.zeros((H,), jnp.float32)
+    # padded query rows: lse sentinel keeps p = exp(NEG_INF - NEG_INF)*0 = 0
+    lse_p = _pad_to(lse, block_q, 2)
+    lse_p = jnp.where(
+        jnp.arange(Tp)[None, None, :] < T, lse_p, NEG_INF
+    )
+    lse_p = jnp.broadcast_to(lse_p[..., None], (B, H, Tp, LANES))
+    delta_p = jnp.broadcast_to(_pad_to(delta, block_q, 2)[..., None], (B, H, Tp, LANES))
+    offsets = (
+        jnp.asarray(q_offset, jnp.int32).reshape(1),
+        jnp.asarray(k_offset, jnp.int32).reshape(1),
+    )
+
+    args = (offsets[0], offsets[1], qt, kt, vt, kmask, qpos, kpos, slopes, lse_p, delta_p, dot)
+    opts = (sm_scale, causal, False, block_q, block_k, interpret)
+    dq = _bwd_dq_call(*args, *opts)
+    dk, dv = _bwd_dkv_call(*args, *opts)
+    return (
+        dq[:, :, :T, :].transpose(0, 2, 1, 3),
+        dk[:, :, :S, :].transpose(0, 2, 1, 3),
+        dv[:, :, :S, :].transpose(0, 2, 1, 3),
+    )
+
+
+def flash_attention(
+    q: jax.Array,  # (B, T, H, D)
+    k: jax.Array,  # (B, S, H, D)
+    v: jax.Array,  # (B, S, H, D)
+    key_mask: jax.Array,  # (B, S) 1 = valid slot
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    q_offset=0,
+    k_offset=0,
+    q_positions: Optional[jax.Array] = None,  # (B, T) for alibi
+    k_positions: Optional[jax.Array] = None,  # (B, S) for alibi
+    alibi_slopes: Optional[jax.Array] = None,  # (H,)
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+    return_lse: bool = False,
+):
+    """Flash attention over ``[B, T, H, D]`` tensors (model layout).
+
+    Pads T/S up to block multiples internally; padded key slots are invisible
+    (mask 0), padded query rows produce zeros and are sliced off. With
+    ``return_lse`` the per-row logsumexp over *unpadded* rows is returned too
+    (needed by the ring-attention combiner). NOTE: the ``return_lse`` variant
+    is forward-only (no VJP is defined for the pair); ring attention defines
+    its own VJP over whole ring sweeps rather than differentiating per-chunk
+    (out, lse) pairs.
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    B, T, H, D = q.shape
+    S, KV = k.shape[1], k.shape[2]
+    if H % KV:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {KV}")
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    alibi = alibi_slopes is not None
+
+    if interpret:
+        # interpreter has no tiling constraints; small blocks keep CPU tests fast
+        block_q = min(block_q, max(T, 8))
+        block_k = min(block_k, max(S, 8))
+    # on hardware, blocks stay tile-aligned (128) and T/S are padded up to a
+    # block multiple below — Mosaic rejects sub-128 lane blocks
+
+    # [B, T, H, D] → [B, H, T, D]
+    qt = _pad_to(q.transpose(0, 2, 1, 3), block_q, 2)
+    kt = _pad_to(k.transpose(0, 2, 1, 3), block_k, 2)
+    vt = _pad_to(v.transpose(0, 2, 1, 3), block_k, 2)
+    Tp, Sp = qt.shape[2], kt.shape[2]
+
+    kmask = _pad_to(key_mask.astype(jnp.float32), block_k, 1).reshape(B, 1, Sp)
+    if q_positions is None:
+        q_positions = jnp.zeros((B, T), jnp.int32)
+    if k_positions is None:
+        k_positions = jnp.zeros((B, S), jnp.int32)
+    qpos = _pad_to(q_positions.astype(jnp.int32), block_q, 1).reshape(B, 1, Tp)
+    kpos = _pad_to(k_positions.astype(jnp.int32), block_k, 1).reshape(B, 1, Sp)
+    slopes = (
+        alibi_slopes.astype(jnp.float32).reshape(H)
+        if alibi
+        else jnp.zeros((H,), jnp.float32)
+    )
+    offsets = (
+        jnp.asarray(q_offset, jnp.int32).reshape(1),
+        jnp.asarray(k_offset, jnp.int32).reshape(1),
+    )
+
+    if return_lse:
+        out, lse = _flash_fwd_impl(
+            qt, kt, vt, kmask, qpos, kpos, slopes, offsets,
+            sm_scale, causal, alibi, block_q, block_k, interpret,
+        )
+        return (
+            out[:, :, :T, :].transpose(0, 2, 1, 3),
+            lse[:, :, :T, 0],
+        )
+    out = _flash(
+        qt, kt, vt, kmask, qpos, kpos, slopes, offsets,
+        sm_scale, causal, alibi, block_q, block_k, interpret,
+    )
+    return out[:, :, :T, :].transpose(0, 2, 1, 3)
+
+
+def attention_reference(
+    q, k, v, key_mask, *, causal=True, sm_scale=None,
+    q_offset=0, k_offset=0, q_positions=None, k_positions=None,
+    alibi_slopes=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Naive XLA attention with identical masking semantics (test oracle).
+
+    Returns (out, logsumexp), both f32-accumulated.
+    """
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum(
+        "bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * sm_scale
+    visible = key_mask[:, None, None, :] > 0.5
+    if causal:
+        q_slots = jnp.arange(T)[:, None] + jnp.asarray(q_offset)
+        k_slots = jnp.arange(S)[None, :] + jnp.asarray(k_offset)
+        visible = visible & (k_slots <= q_slots)[None, None, :, :]
+    if alibi_slopes is not None:
+        dist = (
+            k_positions[:, None, :] - q_positions[:, :, None]
+        ).astype(jnp.float32)
+        s = s + alibi_slopes.astype(jnp.float32)[None, :, None, None] * dist[:, None]
+    s = jnp.where(visible, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m) * visible.astype(jnp.float32)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    safe_l = jnp.where(l > 0, l, 1.0)
+    out = jnp.einsum("bhts,bshd->bthd", p / safe_l, v.astype(jnp.float32))
+    lse = jnp.where(l > 0, m + jnp.log(safe_l), NEG_INF)[..., 0]
+    return out, lse

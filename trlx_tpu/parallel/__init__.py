@@ -9,7 +9,12 @@ the collectives (all-gather / reduce-scatter / psum) over ICI/DCN — no
 hand-written communication.
 """
 
-from trlx_tpu.parallel.mesh import make_mesh, mesh_shape_from_config
+from trlx_tpu.parallel.mesh import (
+    get_global_mesh,
+    make_mesh,
+    mesh_shape_from_config,
+    set_global_mesh,
+)
 from trlx_tpu.parallel.sharding import (
     batch_spec,
     param_shardings,
@@ -19,6 +24,8 @@ from trlx_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "get_global_mesh",
+    "set_global_mesh",
     "make_mesh",
     "mesh_shape_from_config",
     "param_shardings",

@@ -28,6 +28,21 @@ from trlx_tpu.data.configs import ParallelConfig
 
 MESH_AXES = ("data", "fsdp", "model", "sequence")
 
+# The process-wide mesh, set by trainers at construction. Model code reads it
+# (``get_global_mesh``) to decide whether sequence-parallel ops (ring
+# attention) apply — the mesh, not per-module config, is the single source of
+# truth for parallelism.
+_GLOBAL_MESH: Optional[Mesh] = None
+
+
+def set_global_mesh(mesh: Optional[Mesh]) -> None:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    return _GLOBAL_MESH
+
 
 def mesh_shape_from_config(
     parallel: ParallelConfig, device_count: Optional[int] = None

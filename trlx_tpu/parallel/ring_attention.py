@@ -1,0 +1,189 @@
+"""Ring attention: exact causal attention over a ``sequence``-sharded mesh axis.
+
+Long-context / context parallelism is a first-class capability here, unlike the
+reference, whose only sequence story is Megatron SP (activations gathered
+before the heads, ``trlx/models/modeling_nemo_ilql.py:672-677``) with sequence
+length capped by config (SURVEY.md §5 "Long-context"). Ring attention removes
+the cap: each device holds one ``T/n`` chunk of Q/K/V, K/V chunks rotate around
+the ring via ``lax.ppermute`` over ICI, and the online-softmax accumulator
+combines per-chunk ``(out, lse)`` pairs — peak memory per device stays
+O(T/n · d) while the math is bit-for-bit the full-sequence softmax (up to f32
+rounding).
+
+Forward: n ring steps, each a flash-attention kernel call
+(``trlx_tpu/ops/flash_attention.py``) with slot offsets selecting the visiting
+chunk's global position; causal chunk-skipping happens inside the kernel (its
+k-block loop collapses to zero iterations for fully-future chunks).
+
+Backward (custom VJP): one ring sweep carrying ``(k, v, mask, dk, dv)``; each
+step computes this device's dq contribution and the visiting chunk's dk/dv
+contribution using the *global* logsumexp saved from the forward — after n
+rotations every dk/dv accumulator is back on its home device, complete. This
+mirrors the published ring-attention backward; XLA overlaps the ppermute with
+the kernels of the next step since the Python loop is unrolled.
+
+Known trade-off (TODO): with causal masking the ring is load-imbalanced
+(device 0's queries see 1 chunk, device n-1's see n) — zigzag/striped chunk
+placement would fix this; dq and dk/dv currently recompute scores in two
+kernels per step, a fused dq+dkv kernel would halve backward FLOPs.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from trlx_tpu.ops.flash_attention import (
+    NEG_INF,
+    flash_attention,
+    flash_attention_bwd_chunk,
+)
+
+
+def _combine(out_a, lse_a, out_b, lse_b):
+    """Merge two normalized partial-softmax results via their logsumexps.
+
+    out/lse shapes: [B, T, H, D] / [B, H, T]. Rows masked everywhere carry the
+    ``NEG_INF`` sentinel and zero output on both sides, which this preserves.
+    """
+    m = jnp.maximum(lse_a, lse_b)
+    w_a = jnp.where(lse_a > 0.5 * NEG_INF, jnp.exp(lse_a - m), 0.0)
+    w_b = jnp.where(lse_b > 0.5 * NEG_INF, jnp.exp(lse_b - m), 0.0)
+    denom = w_a + w_b
+    safe = jnp.where(denom > 0.0, denom, 1.0)
+    lse = jnp.where(denom > 0.0, m + jnp.log(safe), NEG_INF)
+    wa = (w_a / safe).transpose(0, 2, 1)[..., None]  # [B, T, H, 1]
+    wb = (w_b / safe).transpose(0, 2, 1)[..., None]
+    out = out_a * wa + out_b * wb
+    return out, lse
+
+
+def _make_ring_fn(axis, causal, sm_scale, block_q, block_k, interpret):
+    """Build the per-shard ring function (a custom-VJP closure)."""
+
+    @jax.custom_vjp
+    def ring(q, k, v, key_mask):
+        out, _ = _ring_fwd_impl(q, k, v, key_mask)
+        return out
+
+    def _ring_fwd_impl(q, k, v, key_mask):
+        idx = jax.lax.axis_index(axis)
+        n = jax.lax.axis_size(axis)
+        B, Tl, H, D = q.shape
+        q_off = idx * Tl
+        perm = [(j, (j + 1) % n) for j in range(n)]
+
+        out = jnp.zeros((B, Tl, H, D), jnp.float32)
+        lse = jnp.full((B, H, Tl), NEG_INF, jnp.float32)
+        kc, vc, mc = k, v, key_mask
+        for s in range(n):
+            src = (idx - s) % n
+            o_s, l_s = flash_attention(
+                q, kc, vc, mc,
+                causal=causal, sm_scale=sm_scale,
+                q_offset=q_off, k_offset=src * Tl,
+                block_q=block_q, block_k=block_k,
+                interpret=interpret, return_lse=True,
+            )
+            out, lse = _combine(out, lse, o_s.astype(jnp.float32), l_s)
+            if s != n - 1:
+                kc = jax.lax.ppermute(kc, axis, perm)
+                vc = jax.lax.ppermute(vc, axis, perm)
+                mc = jax.lax.ppermute(mc, axis, perm)
+        return out.astype(q.dtype), lse
+
+    def ring_fwd(q, k, v, key_mask):
+        out, lse = _ring_fwd_impl(q, k, v, key_mask)
+        return out, (q, k, v, key_mask, out, lse)
+
+    def ring_bwd(res, do):
+        q, k, v, key_mask, out, lse = res
+        idx = jax.lax.axis_index(axis)
+        n = jax.lax.axis_size(axis)
+        B, Tl, H, D = q.shape
+        q_off = idx * Tl
+        perm = [(j, (j + 1) % n) for j in range(n)]
+
+        delta = jnp.sum(
+            do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+        ).transpose(0, 2, 1)  # [B, H, Tl]
+
+        dq = jnp.zeros_like(q, jnp.float32)
+        kc, vc, mc = k, v, key_mask
+        dkc = jnp.zeros_like(k, jnp.float32)
+        dvc = jnp.zeros_like(v, jnp.float32)
+        for s in range(n):
+            src = (idx - s) % n
+            dq_s, dk_s, dv_s = flash_attention_bwd_chunk(
+                q, kc, vc, mc, lse, delta, do,
+                causal=causal, sm_scale=sm_scale,
+                q_offset=q_off, k_offset=src * Tl,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+            )
+            dq = dq + dq_s.astype(jnp.float32)
+            dkc = dkc + dk_s.astype(jnp.float32)
+            dvc = dvc + dv_s.astype(jnp.float32)
+            # rotate the kv chunk together with its gradient accumulator;
+            # after the full sweep each accumulator is home and complete
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            mc = jax.lax.ppermute(mc, axis, perm)
+            dkc = jax.lax.ppermute(dkc, axis, perm)
+            dvc = jax.lax.ppermute(dvc, axis, perm)
+        return (
+            dq.astype(q.dtype),
+            dkc.astype(k.dtype),
+            dvc.astype(v.dtype),
+            jnp.zeros_like(key_mask),
+        )
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring
+
+
+def ring_flash_attention(
+    q: jax.Array,  # [B, T, H, D] global (sequence-sharded or shardable)
+    k: jax.Array,  # [B, T, H, D]
+    v: jax.Array,  # [B, T, H, D]
+    key_mask: jax.Array,  # [B, T]
+    mesh: Mesh,
+    *,
+    axis: str = "sequence",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Exact attention with K/V rotating over the ``axis`` mesh ring.
+
+    T must be divisible by ``mesh.shape[axis]``. Falls back to a single flash
+    call when the axis has size 1. Differentiable (custom ring VJP). Must be
+    called under ``jit`` when the ring is active: partially-manual shard_map
+    (``axis_names={axis}``) is unsupported in eager mode.
+    """
+    n = mesh.shape[axis]
+    if n == 1:
+        return flash_attention(
+            q, k, v, key_mask,
+            causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+    T = q.shape[1]
+    if T % n:
+        raise ValueError(f"sequence length {T} not divisible by ring size {n}")
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    ring = _make_ring_fn(axis, causal, sm_scale, block_q, block_k, interpret)
+    shard = P(None, axis, None, None)
+    f = jax.shard_map(
+        ring,
+        mesh=mesh,
+        in_specs=(shard, shard, shard, P(None, axis)),
+        out_specs=shard,
+        axis_names={axis},
+        check_vma=False,
+    )
+    return f(q, k, v, key_mask)

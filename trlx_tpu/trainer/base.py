@@ -33,7 +33,7 @@ from trlx_tpu.data.tokenizer import from_config as tokenizer_from_config
 from trlx_tpu.models.builder import build_causal_lm, trainable_mask
 from trlx_tpu.models.transformer import make_kv_cache
 from trlx_tpu.ops.sampling import GenerationConfig, GenerationOutput, generate
-from trlx_tpu.parallel import make_mesh, shard_batch, shard_params
+from trlx_tpu.parallel import make_mesh, set_global_mesh, shard_batch, shard_params
 from trlx_tpu.pipeline import BasePipeline
 from trlx_tpu.trainer import BaseRLTrainer
 from trlx_tpu.utils import (
@@ -93,6 +93,9 @@ class TPUBaseTrainer(BaseRLTrainer):
     ):
         super().__init__(config, reward_fn, metric_fn, stop_sequences, **kwargs)
         self.mesh = make_mesh(config.parallel)
+        set_global_mesh(self.mesh)  # model code reads this for sequence-parallel ops
+        # NOTE: the global mesh is process-wide; entry points re-assert it so
+        # two trainers in one process don't trace against each other's mesh
         self.tokenizer = tokenizer_from_config(config.tokenizer)
 
         two_qs = bool(getattr(config.method, "two_qs", True))
@@ -208,6 +211,7 @@ class TPUBaseTrainer(BaseRLTrainer):
 
     def train_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         """One optimization step on a host batch; returns host scalar stats."""
+        set_global_mesh(self.mesh)
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         if hasattr(batch, "_asdict"):  # NamedTuple batches (PPORLBatch, ILQLBatch)
@@ -266,6 +270,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         (reference ``generate`` vs ``generate_eval``,
         ``accelerate_base_trainer.py:228-253``).
         """
+        set_global_mesh(self.mesh)
         base = (
             self.generate_kwargs
             if eval_mode or self.generate_experience_kwargs is None
@@ -340,6 +345,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         Supports a single list-valued gen kwarg swept across generations
         (reference ``accelerate_base_trainer.py:286-428``).
         """
+        set_global_mesh(self.mesh)
         logger.info("Evaluating model")
         stats: Dict[str, Any] = {}
         table_rows: List[List[Any]] = []
@@ -420,6 +426,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         """Epochs → batches → n updates per batch, with interval checkpoints,
         interval eval, and best-reward checkpointing (reference
         ``accelerate_base_trainer.py:433-553``)."""
+        set_global_mesh(self.mesh)
         logger.info("Starting training")
         self.prepare_learning()
 
