@@ -1,0 +1,73 @@
+"""Offline ILQL sentiment tuning with a seq2seq (T5) model (capability
+parity: ``/root/reference/examples/ilql_sentiments_t5.py`` — reward-labeled
+review continuations train a T5 via ILQL; eval greedily completes prompts).
+
+Resolution mirrors ``ppo_sentiments_t5.py``.
+"""
+
+import os
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ilql_config
+
+from sentiment_util import get_positive_sentiment_fn, load_imdb_texts, review_prompts
+
+
+def resolve_model():
+    path = os.environ.get("MODEL_PATH")
+    if path:
+        return path, path
+    try:
+        from transformers import AutoConfig
+
+        AutoConfig.from_pretrained("lvwerra/t5-imdb")
+        return "lvwerra/t5-imdb", "lvwerra/t5-imdb"
+    except Exception:
+        return "builtin:t5-small", "builtin:bytes"
+
+
+def main(hparams=None):
+    model_path, tokenizer_path = resolve_model()
+    sentiment = get_positive_sentiment_fn()
+
+    config = default_ilql_config().evolve(
+        train=dict(
+            seq_length=128,
+            batch_size=32,
+            total_steps=2000,
+            eval_interval=100,
+            checkpoint_interval=2000,
+            checkpoint_dir="ckpts/ilql_sentiments_t5",
+        ),
+        model=dict(model_path=model_path, model_arch_type="seq2seq"),
+        tokenizer=dict(tokenizer_path=tokenizer_path, padding_side="right"),
+        method=dict(gen_kwargs=dict(max_new_tokens=40, top_k=20, beta=1.0, temperature=1.0)),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    # offline dataset: (prompt, continuation) pairs labeled by the sentiment
+    # scorer (the reference labels IMDB reviews the same way)
+    texts, _ = load_imdb_texts(512, seed=0)
+    samples = [[t[: len(t) // 2], t[len(t) // 2 :]] for t in texts]
+    rewards = [float(r) for r in sentiment([s[1] for s in samples])]
+
+    def metric_fn(samples, prompts, outputs, **kwargs):
+        return {"sentiment": sentiment(outputs)}
+
+    return trlx.train(
+        samples=samples,
+        rewards=rewards,
+        eval_prompts=review_prompts(64, seed=1),
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

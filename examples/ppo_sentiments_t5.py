@@ -1,0 +1,76 @@
+"""PPO sentiment steering with a seq2seq (T5) model (capability parity:
+``/root/reference/examples/ppo_sentiments_t5.py`` — lvwerra/t5-imdb completes
+movie reviews; reward = P(positive) from a sentiment classifier).
+
+Model/tokenizer resolve in order: ``$MODEL_PATH`` (an HF T5 checkpoint
+directory), else the hub ``lvwerra/t5-imdb``, else an offline random-init
+t5-small + byte tokenizer (wiring identical; reward fidelity lower).
+"""
+
+import os
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ppo_config
+
+from sentiment_util import get_positive_sentiment_fn, review_prompts
+
+
+def resolve_model():
+    path = os.environ.get("MODEL_PATH")
+    if path:
+        return path, path
+    try:
+        from transformers import AutoConfig
+
+        AutoConfig.from_pretrained("lvwerra/t5-imdb")
+        return "lvwerra/t5-imdb", "lvwerra/t5-imdb"
+    except Exception:
+        return "builtin:t5-small", "builtin:bytes"
+
+
+def main(hparams=None):
+    model_path, tokenizer_path = resolve_model()
+    sentiment = get_positive_sentiment_fn()
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            seq_length=128,
+            batch_size=32,
+            total_steps=10000,
+            eval_interval=100,
+            checkpoint_interval=10000,
+            checkpoint_dir="ckpts/ppo_sentiments_t5",
+        ),
+        # the whole decoder trains; hydra branch kicks in with
+        # num_layers_unfrozen > 0 exactly as in the causal example
+        model=dict(model_path=model_path, model_arch_type="seq2seq", num_layers_unfrozen=-1),
+        tokenizer=dict(tokenizer_path=tokenizer_path, padding_side="right"),
+        method=dict(
+            num_rollouts=128,
+            chunk_size=128,
+            gen_kwargs=dict(max_new_tokens=40, top_k=0, top_p=0.95, do_sample=True),
+        ),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        return sentiment(outputs)
+
+    prompts = [p + " <extra_id_0>" for p in review_prompts(256, seed=0)]
+    eval_prompts = [p + " <extra_id_0>" for p in review_prompts(64, seed=1)]
+    return trlx.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=eval_prompts,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

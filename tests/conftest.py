@@ -10,6 +10,8 @@ import os
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("TRLX_TPU_NO_TQDM", "1")
+# zero-egress container: skip HF hub lookups (and their long retry delays)
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
 # Persistent compile cache: repeated test runs skip XLA compilation.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")

@@ -74,3 +74,50 @@ def test_sentiment_lexicon():
     pos = np.mean([s for s, l in zip(lexicon_sentiment(texts), labels) if l == 1])
     neg = np.mean([s for s, l in zip(lexicon_sentiment(texts), labels) if l == 0])
     assert pos > neg
+
+
+def test_ppo_sentiments_t5_smoke(tmp_path, monkeypatch):
+    monkeypatch.delenv("MODEL_PATH", raising=False)
+    import ppo_sentiments_t5
+
+    # shrink to a tiny offline run (builtin t5-test + byte tokenizer)
+    trainer = ppo_sentiments_t5.main(
+        {
+            "train.total_steps": 2,
+            "train.epochs": 1,
+            "train.eval_interval": 2,
+            "train.batch_size": 4,
+            "train.seq_length": 48,
+            "train.checkpoint_dir": str(tmp_path / "ckpt"),
+            "train.tracker": None,
+            "model.model_path": "builtin:t5-test",
+            "model.num_layers_unfrozen": 1,
+            "method.num_rollouts": 4,
+            "method.chunk_size": 4,
+            "method.ppo_epochs": 1,
+            "method.gen_kwargs.max_new_tokens": 5,
+            "method.gen_kwargs.top_k": 0,
+        }
+    )
+    assert trainer is not None
+
+
+def test_ilql_sentiments_t5_smoke(tmp_path, monkeypatch):
+    monkeypatch.delenv("MODEL_PATH", raising=False)
+    import ilql_sentiments_t5
+
+    trainer = ilql_sentiments_t5.main(
+        {
+            "train.total_steps": 2,
+            "train.epochs": 1,
+            "train.eval_interval": 2,
+            "train.batch_size": 4,
+            "train.seq_length": 48,
+            "train.checkpoint_dir": str(tmp_path / "ckpt"),
+            "train.tracker": None,
+            "model.model_path": "builtin:t5-test",
+            "method.gen_kwargs.max_new_tokens": 4,
+            "method.gen_kwargs.top_k": 2,
+        }
+    )
+    assert trainer is not None

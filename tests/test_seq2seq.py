@@ -1,0 +1,275 @@
+"""Seq2seq (T5) path tests, mirroring the reference's seq2seq coverage
+(``tests/test_models.py`` T5 wrapper cases + seq2seq trainer paths):
+HF logit parity for both T5 generations, cached-decode parity, hydra branch,
+freezing masks, ILQL seq2seq experience shaping, and trainer e2e smoke."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.data.configs import ModelConfig
+from trlx_tpu.models.builder import (
+    build_seq2seq_lm,
+    seq2seq_hydra_ref_params,
+    seq2seq_trainable_mask,
+)
+from trlx_tpu.models.heads import Seq2SeqLMWithValueHead
+from trlx_tpu.models.seq2seq import Seq2SeqConfig, T5Transformer
+from trlx_tpu.ops.sampling import GenerationConfig, generate_seq2seq
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def _f32(cfg):
+    return dataclasses.replace(cfg, dtype=jnp.float32)
+
+
+def _tiny_hf(variant: str):
+    import torch
+    import transformers as tf
+
+    from trlx_tpu.models.hf_interop import seq2seq_params_from_hf
+
+    torch.manual_seed(0)
+    kw = (
+        dict(feed_forward_proj="relu", tie_word_embeddings=True)
+        if variant == "t5"
+        else dict(feed_forward_proj="gated-gelu", tie_word_embeddings=False)
+    )
+    hf = tf.T5ForConditionalGeneration(
+        tf.T5Config(
+            vocab_size=97, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+            num_decoder_layers=2, num_heads=4, relative_attention_num_buckets=8,
+            relative_attention_max_distance=20, dropout_rate=0.0,
+            decoder_start_token_id=0, **kw,
+        )
+    ).eval()
+    params, cfg = seq2seq_params_from_hf(hf)
+    return hf, params, _f32(cfg)
+
+
+@pytest.mark.parametrize("variant", ["t5", "flan"])
+def test_hf_logit_parity(variant):
+    import torch
+
+    hf, params, cfg = _tiny_hf(variant)
+    model = T5Transformer(cfg)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(1, 97, (2, 10))
+    dec = rs.randint(1, 97, (2, 6))
+    mask = np.ones((2, 10), np.int64)
+    mask[0, 7:] = 0
+    with torch.no_grad():
+        hf_logits = hf(
+            input_ids=torch.tensor(ids),
+            attention_mask=torch.tensor(mask),
+            decoder_input_ids=torch.tensor(dec),
+        ).logits.numpy()
+    out = model.apply(
+        {"params": params["backbone"]},
+        jnp.asarray(ids), jnp.asarray(mask), decoder_input_ids=jnp.asarray(dec),
+    )
+    np.testing.assert_allclose(np.asarray(out["logits"]), hf_logits, atol=2e-4, rtol=2e-4)
+
+
+def test_cached_decode_matches_full_forward():
+    module, params, scfg = build_seq2seq_lm(
+        ModelConfig(
+            model_path="builtin:t5-test", model_arch_type="seq2seq",
+            model_extra_kwargs=dict(dtype=jnp.float32),
+        ),
+        head="value",
+    )
+    B, P = 2, 10
+    rs = np.random.RandomState(1)
+    ids = jnp.asarray(rs.randint(1, 250, (B, P)), jnp.int32)
+    mask = jnp.ones((B, P), jnp.int32).at[1, 7:].set(0)
+
+    def encode_fn(p, i, m, n):
+        return module.apply({"params": p}, i, m, n, method=Seq2SeqLMWithValueHead.encode_for_decode)
+
+    def decode_fn(p, d, e, m, c, ci):
+        return module.apply({"params": p}, d, e, m, c, ci, method=Seq2SeqLMWithValueHead.decode)
+
+    cfg = GenerationConfig(max_new_tokens=6, do_sample=True, eos_token_id=1, pad_token_id=0)
+    out = generate_seq2seq(
+        encode_fn, decode_fn, params, ids, mask, jax.random.PRNGKey(0), cfg
+    )
+    dec_in = jnp.concatenate(
+        [jnp.zeros((B, 1), jnp.int32), out.response_tokens[:, :-1]], axis=1
+    )
+    full = module.apply({"params": params}, ids, mask, decoder_input_ids=dec_in)
+    lp = jnp.take_along_axis(
+        jax.nn.log_softmax(full["logits"].astype(jnp.float32), -1),
+        out.response_tokens[..., None], -1,
+    )[..., 0]
+    err = np.max(np.abs(np.asarray(lp - out.response_logprobs)) * np.asarray(out.response_mask))
+    assert err < 2e-4, err
+
+
+def test_hydra_branch_matches_full_frozen():
+    """With everything frozen, the branch replay on trunk activations must
+    reproduce the full model's logits exactly (seq2seq analogue of the
+    reference hydra test, ``tests/test_models.py:108-127``)."""
+    module, params, scfg = build_seq2seq_lm(
+        ModelConfig(
+            model_path="builtin:t5-test", model_arch_type="seq2seq",
+            model_extra_kwargs=dict(dtype=jnp.float32),
+        ),
+        head="value",
+    )
+    nlu = 1
+    ref = seq2seq_hydra_ref_params(params, scfg, nlu)
+    B, P, N = 2, 8, 5
+    rs = np.random.RandomState(2)
+    ids = jnp.asarray(rs.randint(1, 250, (B, P)), jnp.int32)
+    mask = jnp.ones((B, P), jnp.int32)
+    dec = jnp.asarray(rs.randint(1, 250, (B, N)), jnp.int32)
+    out = module.apply(
+        {"params": params}, ids, mask, decoder_input_ids=dec, branch_layer=nlu
+    )
+    branch_out = module.apply(
+        {"params": {"backbone": ref}},
+        out["branch_input"], nlu, out["encoder_hidden"], mask, None,
+        method=Seq2SeqLMWithValueHead.forward_branch,
+    )
+    np.testing.assert_allclose(
+        np.asarray(branch_out["logits"]), np.asarray(out["logits"]), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_trainable_mask_freezes_reference_subset():
+    module, params, scfg = build_seq2seq_lm(
+        ModelConfig(model_path="builtin:t5-test", model_arch_type="seq2seq"),
+        head="value",
+    )
+    mask = seq2seq_trainable_mask(params, scfg, num_layers_unfrozen=1)
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path): v
+        for path, v in jax.tree_util.tree_flatten_with_path(mask)[0]
+    }
+    # encoder + embeddings + final norms frozen (reference
+    # freeze_bottom_seq2seq_layers, trlx/utils/modeling.py:47-66)
+    assert not any(v for k, v in flat.items() if "/enc_0/" in k or k.startswith("backbone/wte"))
+    assert not any(v for k, v in flat.items() if "dec_ln_f" in k or "enc_ln_f" in k)
+    # bottom decoder frozen, top decoder + value head trainable
+    assert not any(v for k, v in flat.items() if "/dec_0/" in k)
+    assert all(v for k, v in flat.items() if "/dec_1/" in k)
+    assert all(v for k, v in flat.items() if k.startswith("v_head"))
+
+
+def _seq2seq_sample_dialogue(samples):
+    from trlx_tpu.pipeline.offline_pipeline import DialogMessage
+
+    return [
+        [DialogMessage(False, tuple(p)), DialogMessage(True, tuple(o))]
+        for p, o in samples
+    ]
+
+
+def test_ilql_seq2seq_experience_shapes():
+    from trlx_tpu.trainer.ilql import make_experience_seq2seq
+
+    store = make_experience_seq2seq(
+        _seq2seq_sample_dialogue([([3, 4, 5], [6, 7, 8, 9]), ([2], [9, 8])]),
+        [1.0, 0.0],
+        tokenizer=None,
+    )
+    el = store.history[0]
+    np.testing.assert_array_equal(el.input_ids, [3, 4, 5])
+    np.testing.assert_array_equal(el.decoder_input_ids, [6, 7, 8, 9])
+    np.testing.assert_array_equal(el.actions_ixs, [0, 1, 2])
+    np.testing.assert_array_equal(el.states_ixs, [0, 1, 2, 3])
+    np.testing.assert_array_equal(el.dones, [1, 1, 1, 0])
+    # normalized return sits on the last action token, zeros elsewhere
+    assert el.rewards[-1] > 0.0 and not np.any(el.rewards[:-1])
+
+
+def test_ppo_trainer_seq2seq_e2e(tmp_path):
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.ppo  # noqa: F401
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            seq_length=32, batch_size=4, total_steps=2, eval_interval=2,
+            checkpoint_interval=100, epochs=1, checkpoint_dir=str(tmp_path), tracker=None,
+        ),
+        model=dict(model_path="builtin:t5-test", model_arch_type="seq2seq", num_layers_unfrozen=1),
+        method=dict(
+            num_rollouts=4, chunk_size=4, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=5, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    trainer = get_trainer(config.train.trainer)(
+        config=config,
+        reward_fn=lambda samples, prompts, outputs, **kw: [float(len(o)) for o in outputs],
+        metric_fn=None,
+        stop_sequences=[],
+    )
+    pipe = get_pipeline(config.train.pipeline)(
+        ["hello world", "foo bar"] * 2, 16, trainer.tokenizer
+    )
+    trainer.add_prompt_pipeline(pipe)
+    trainer.make_experience(config.method.num_rollouts)
+    loader = trainer.store.create_loader(config.train.batch_size, shuffle=True)
+    stats = trainer.train_step(next(iter(loader)))
+    assert np.isfinite(float(np.asarray(stats["losses/total_loss"])))
+
+
+def test_ilql_trainer_seq2seq_e2e(tmp_path):
+    from trlx_tpu.data.default_configs import default_ilql_config
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.ilql  # noqa: F401
+
+    config = default_ilql_config().evolve(
+        train=dict(
+            seq_length=32, batch_size=4, total_steps=2, eval_interval=2,
+            checkpoint_interval=100, epochs=1, checkpoint_dir=str(tmp_path), tracker=None,
+        ),
+        model=dict(model_path="builtin:t5-test", model_arch_type="seq2seq"),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, top_k=2, beta=1.0)),
+    )
+    trainer = get_trainer(config.train.trainer)(
+        config=config, metric_fn=None, stop_sequences=[]
+    )
+    samples = [["question one", "answer a"], ["question two", "answer bb"]] * 2
+    trainer.make_experience(samples, [0.1, 0.9, 0.2, 0.8])
+    loader = trainer.store.create_loader(4, shuffle=True)
+    stats = trainer.train_step(next(iter(loader)))
+    assert np.isfinite(float(np.asarray(stats["losses/loss"])))
+    out = trainer.generate(np.array([[5, 6, 7, 0], [8, 9, 3, 4]], np.int32))
+    assert np.asarray(out.response_tokens).shape == (2, 4)
+
+
+def test_generate_with_bare_t5_module():
+    """head=None (bare T5Transformer) generation: decode must keyword-bind
+    cache/cache_index (its signature has decoder_mask 4th positionally)."""
+    module, params, scfg = build_seq2seq_lm(
+        ModelConfig(
+            model_path="builtin:t5-test", model_arch_type="seq2seq",
+            model_extra_kwargs=dict(dtype=jnp.float32),
+        ),
+        head=None,
+    )
+    ids = jnp.asarray(np.random.RandomState(3).randint(1, 250, (2, 7)), jnp.int32)
+    mask = jnp.ones((2, 7), jnp.int32)
+
+    def encode_fn(p, i, m, n):
+        return module.apply({"params": p}, i, m, n, method=T5Transformer.encode_for_decode)
+
+    def decode_fn(p, d, e, m, c, ci):
+        return module.apply(
+            {"params": p}, d, e, m, cache=c, cache_index=ci, method=T5Transformer.decode
+        )
+
+    out = generate_seq2seq(
+        encode_fn, decode_fn, params, ids, mask, jax.random.PRNGKey(0),
+        GenerationConfig(max_new_tokens=4, do_sample=False, pad_token_id=0),
+    )
+    assert np.asarray(out.response_tokens).shape == (2, 4)

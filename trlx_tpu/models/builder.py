@@ -149,3 +149,152 @@ def trainable_mask(
         else:
             mask[top_key] = mark(subtree, True)
     return mask
+
+
+# ---------------------------------------------------------------------------
+# seq2seq (T5) assembly — reference seq2seq arch selection
+# (``trlx/trainer/accelerate_ppo_trainer.py:120-134`` picks the Seq2Seq
+# wrappers when ``config.model.model_arch_type == "seq2seq"``).
+# ---------------------------------------------------------------------------
+
+
+def resolve_seq2seq_config(
+    model_config: ModelConfig, parallel: Optional[ParallelConfig] = None
+):
+    """Resolve (Seq2SeqConfig, hf_path or None) from a ModelConfig."""
+    import dataclasses
+
+    from trlx_tpu.models.seq2seq import Seq2SeqConfig
+
+    path = model_config.model_path
+    overrides: Dict[str, Any] = dict(model_config.model_extra_kwargs or {})
+    overrides.pop("scan_layers", None)
+    if parallel is not None:
+        overrides.setdefault("param_dtype", DTYPES[parallel.param_dtype])
+        overrides.setdefault("dtype", DTYPES[parallel.compute_dtype])
+        overrides.setdefault("remat", parallel.remat)
+
+    if path.startswith("builtin:"):
+        spec = path.split(":", 1)[1]
+        family, _, size = spec.partition("-")
+        makers = {"t5": Seq2SeqConfig.t5, "flan_t5": Seq2SeqConfig.flan_t5}
+        if family not in makers:
+            raise ValueError(f"Unknown seq2seq family '{family}'. Known: {sorted(makers)}")
+        return makers[family](size or "test", **overrides), None
+
+    from transformers import AutoConfig
+
+    from trlx_tpu.models.hf_interop import seq2seq_config_from_hf
+
+    cfg = seq2seq_config_from_hf(AutoConfig.from_pretrained(path))
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg, path
+
+
+def build_seq2seq_lm(
+    model_config: ModelConfig,
+    parallel: Optional[ParallelConfig] = None,
+    head: Optional[str] = None,  # None | "value" | "ilql"
+    two_qs: bool = True,
+    seed: int = 0,
+):
+    """Build seq2seq module + params (pretrained backbone import, fresh heads)."""
+    from trlx_tpu.models.heads import Seq2SeqLMWithILQLHeads, Seq2SeqLMWithValueHead
+    from trlx_tpu.models.seq2seq import T5Transformer
+
+    scfg, hf_path = resolve_seq2seq_config(model_config, parallel)
+
+    if head == "value":
+        module = Seq2SeqLMWithValueHead(scfg)
+    elif head == "ilql":
+        module = Seq2SeqLMWithILQLHeads(scfg, two_qs=two_qs)
+    else:
+        module = T5Transformer(scfg)
+
+    rng = jax.random.PRNGKey(seed)
+    enc = jnp.zeros((1, 8), jnp.int32)
+    dec = jnp.zeros((1, 4), jnp.int32)
+    params = module.init(rng, enc, decoder_input_ids=dec)["params"]
+
+    if head == "ilql":
+        from trlx_tpu.models.heads import sync_target_q_params
+
+        params = sync_target_q_params(params, alpha=1.0)
+
+    if hf_path is not None:
+        from trlx_tpu.models.hf_interop import load_pretrained_seq2seq
+
+        hf_params, _ = load_pretrained_seq2seq(hf_path)
+        backbone = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, scfg.param_dtype), hf_params["backbone"]
+        )
+        if head is None:
+            params = backbone
+        else:
+            params = dict(params)
+            params["backbone"] = backbone
+    return module, params, scfg
+
+
+def seq2seq_hydra_ref_params(
+    params: Dict[str, Any], scfg, num_layers_unfrozen: int
+) -> Dict[str, Any]:
+    """Frozen seq2seq reference branch: top ``num_layers_unfrozen`` *decoder*
+    blocks + decoder final norm + rel-pos bias table + lm head/tied embedding
+    (reference ``T5Branch``, ``modeling_ppo.py:1113-1222``)."""
+    backbone = params["backbone"] if "backbone" in params else params
+    keep = {}
+    start = scfg.num_decoder_layers - num_layers_unfrozen
+    for i in range(start, scfg.num_decoder_layers):
+        keep[f"dec_{i}"] = backbone[f"dec_{i}"]
+    keep["dec_ln_f"] = backbone["dec_ln_f"]
+    keep["dec_rel_bias"] = backbone["dec_rel_bias"]
+    if scfg.tie_word_embeddings:
+        keep["wte"] = backbone["wte"]
+    else:
+        keep["lm_head"] = backbone["lm_head"]
+    return jax.tree_util.tree_map(lambda x: x, keep)
+
+
+def seq2seq_trainable_mask(
+    params: Dict[str, Any], scfg, num_layers_unfrozen: int
+) -> Dict[str, Any]:
+    """Bool pytree for seq2seq freezing. Mirrors the reference's
+    ``freeze_bottom_seq2seq_layers`` (``trlx/utils/modeling.py:47-66``):
+    with k>0 unfrozen, the shared embedding, the whole encoder, both final
+    norms, and all but the top-k decoder blocks freeze; the lm head and any
+    value/Q heads stay trainable. At k=0 the reference freezes everything
+    *except* the decoder blocks (``decoder.block[:-0] == []``), so the whole
+    decoder trains — mirrored here for behavioral parity."""
+
+    def mark(tree, value: bool):
+        return jax.tree_util.tree_map(lambda _: value, tree)
+
+    frozen_names = {"wte", "enc_ln_f", "dec_ln_f", "enc_rel_bias", "dec_rel_bias"}
+    mask: Dict[str, Any] = {}
+    for top_key, subtree in params.items():
+        if top_key == "backbone":
+            sub = {}
+            for name, layer_tree in subtree.items():
+                if num_layers_unfrozen < 0:
+                    trainable = True
+                elif name.startswith("enc_") or name in frozen_names:
+                    trainable = False
+                elif name.startswith("dec_") and name[4:].isdigit():
+                    trainable = (
+                        num_layers_unfrozen == 0  # reference: k=0 trains all decoder blocks
+                        or int(name[4:]) >= scfg.num_decoder_layers - num_layers_unfrozen
+                    )
+                else:
+                    trainable = True  # lm_head
+                sub[name] = mark(layer_tree, trainable)
+            mask[top_key] = sub
+        elif top_key == "ilql_heads":
+            mask[top_key] = {
+                name: mark(tree, not name.startswith("target_q_head"))
+                for name, tree in subtree.items()
+            }
+        else:
+            mask[top_key] = mark(subtree, True)
+    return mask

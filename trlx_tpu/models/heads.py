@@ -181,3 +181,116 @@ def sync_target_q_params(params: Dict[str, Any], alpha: float) -> Dict[str, Any]
     out = dict(params)
     out["ilql_heads"] = new_heads
     return out
+
+
+# ---------------------------------------------------------------------------
+# seq2seq (T5) wrappers — reference ``AutoModelForSeq2SeqLMWith(Hydra)ValueHead``
+# (``trlx/models/modeling_ppo.py:948-1110``) and
+# ``AutoModelForSeq2SeqLMWithILQLHeads`` (``modeling_ilql.py:347-488``).
+# Heads attach to *decoder* hidden states.
+# ---------------------------------------------------------------------------
+
+
+class Seq2SeqLMWithValueHead(nn.Module):
+    """T5 policy + scalar value head on decoder hidden states."""
+
+    config: Any  # Seq2SeqConfig
+
+    def setup(self):
+        from trlx_tpu.models.seq2seq import T5Transformer
+
+        self.backbone = T5Transformer(self.config, name="backbone")
+        self.v_head = MLPHead(self.config, 1, name="v_head")
+
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        decoder_input_ids: Optional[jax.Array] = None,
+        decoder_attention_mask: Optional[jax.Array] = None,
+        branch_layer: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        out = self.backbone(
+            input_ids,
+            attention_mask=attention_mask,
+            decoder_input_ids=decoder_input_ids,
+            decoder_attention_mask=decoder_attention_mask,
+            branch_layer=branch_layer,
+        )
+        out["value"] = self.v_head(out["hidden_states"])[..., 0]
+        return out
+
+    def encode_for_decode(self, input_ids, attention_mask, max_decode_len):
+        return self.backbone.encode_for_decode(input_ids, attention_mask, max_decode_len)
+
+    def decode(self, decoder_input_ids, encoder_hidden, encoder_mask, cache=None, cache_index=None):
+        out = self.backbone.decode(
+            decoder_input_ids, encoder_hidden, encoder_mask, cache=cache, cache_index=cache_index
+        )
+        out["value"] = self.v_head(out["hidden_states"])[..., 0]
+        return out
+
+    def forward_branch(
+        self, hidden_states, branch_layer, encoder_hidden, encoder_mask, decoder_mask=None
+    ):
+        return self.backbone.forward_branch(
+            hidden_states, branch_layer, encoder_hidden, encoder_mask, decoder_mask
+        )
+
+
+class Seq2SeqLMWithILQLHeads(nn.Module):
+    """T5 policy + ILQL heads (V, twin Q, twin target-Q) on decoder hiddens."""
+
+    config: Any  # Seq2SeqConfig
+    two_qs: bool = True
+
+    def setup(self):
+        from trlx_tpu.models.seq2seq import T5Transformer
+
+        self.backbone = T5Transformer(self.config, name="backbone")
+        self.ilql_heads = ILQLHeadsModule(self.config, self.two_qs, name="ilql_heads")
+
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        decoder_input_ids: Optional[jax.Array] = None,
+        decoder_attention_mask: Optional[jax.Array] = None,
+    ) -> Dict[str, Any]:
+        out = self.backbone(
+            input_ids,
+            attention_mask=attention_mask,
+            decoder_input_ids=decoder_input_ids,
+            decoder_attention_mask=decoder_attention_mask,
+        )
+        qs, target_qs, vs = self.ilql_heads(out["hidden_states"])
+        out.update(qs=qs, target_qs=target_qs, vs=vs)
+        return out
+
+    def backbone_forward(
+        self,
+        input_ids,
+        attention_mask=None,
+        decoder_input_ids=None,
+        decoder_attention_mask=None,
+    ):
+        return self.backbone(
+            input_ids,
+            attention_mask=attention_mask,
+            decoder_input_ids=decoder_input_ids,
+            decoder_attention_mask=decoder_attention_mask,
+        )
+
+    def heads_on(self, hs_actions, hs_states):
+        return self.ilql_heads.heads_on(hs_actions, hs_states)
+
+    def encode_for_decode(self, input_ids, attention_mask, max_decode_len):
+        return self.backbone.encode_for_decode(input_ids, attention_mask, max_decode_len)
+
+    def decode(self, decoder_input_ids, encoder_hidden, encoder_mask, cache=None, cache_index=None):
+        out = self.backbone.decode(
+            decoder_input_ids, encoder_hidden, encoder_mask, cache=cache, cache_index=cache_index
+        )
+        qs, target_qs, vs = self.ilql_heads(out["hidden_states"])
+        out.update(qs=qs, target_qs=target_qs, vs=vs)
+        return out

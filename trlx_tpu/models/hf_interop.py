@@ -359,3 +359,119 @@ def load_pretrained(path: str) -> Tuple[Dict[str, Any], TransformerConfig]:
     hf_config = AutoConfig.from_pretrained(path)
     model = AutoModelForCausalLM.from_pretrained(path)
     return params_from_hf(model, config_from_hf(hf_config))
+
+
+# ---------------------------------------------------------------------------
+# seq2seq (T5 family) import — reference wraps HF T5 for its seq2seq path
+# (``trlx/models/modeling_ppo.py:948-1222``); here the torch checkpoint is
+# converted into the T5Transformer param tree.
+# ---------------------------------------------------------------------------
+
+
+def _t5_attn(sd, prefix) -> Dict[str, Any]:
+    return {
+        "q_proj": _proj(_t(sd[prefix + ".q.weight"])),
+        "k_proj": _proj(_t(sd[prefix + ".k.weight"])),
+        "v_proj": _proj(_t(sd[prefix + ".v.weight"])),
+        "o_proj": _proj(_t(sd[prefix + ".o.weight"])),
+    }
+
+
+def _t5_mlp(sd, prefix, gated: bool) -> Dict[str, Any]:
+    if gated:
+        return {
+            "gate_proj": _proj(_t(sd[prefix + ".wi_0.weight"])),
+            "up_proj": _proj(_t(sd[prefix + ".wi_1.weight"])),
+            "down_proj": _proj(_t(sd[prefix + ".wo.weight"])),
+        }
+    return {
+        "up_proj": _proj(_t(sd[prefix + ".wi.weight"])),
+        "down_proj": _proj(_t(sd[prefix + ".wo.weight"])),
+    }
+
+
+def convert_t5(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """HF T5/Flan-T5 state dict → T5Transformer param tree."""
+    gated = cfg.activation == "gated-gelu"
+    backbone: Dict[str, Any] = {
+        "wte": {"embedding": sd["shared.weight"]},
+        "enc_rel_bias": {
+            "rel_bias": {
+                "embedding": sd[
+                    "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+                ]
+            }
+        },
+        "dec_rel_bias": {
+            "rel_bias": {
+                "embedding": sd[
+                    "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+                ]
+            }
+        },
+        "enc_ln_f": {"scale": sd["encoder.final_layer_norm.weight"]},
+        "dec_ln_f": {"scale": sd["decoder.final_layer_norm.weight"]},
+    }
+    for i in range(cfg.num_layers):
+        lp = f"encoder.block.{i}."
+        backbone[f"enc_{i}"] = {
+            "ln_self": {"scale": sd[lp + "layer.0.layer_norm.weight"]},
+            "self_attn": _t5_attn(sd, lp + "layer.0.SelfAttention"),
+            "ln_mlp": {"scale": sd[lp + "layer.1.layer_norm.weight"]},
+            "mlp": _t5_mlp(sd, lp + "layer.1.DenseReluDense", gated),
+        }
+    for i in range(cfg.num_decoder_layers):
+        lp = f"decoder.block.{i}."
+        backbone[f"dec_{i}"] = {
+            "ln_self": {"scale": sd[lp + "layer.0.layer_norm.weight"]},
+            "self_attn": _t5_attn(sd, lp + "layer.0.SelfAttention"),
+            "ln_cross": {"scale": sd[lp + "layer.1.layer_norm.weight"]},
+            "cross_attn": _t5_attn(sd, lp + "layer.1.EncDecAttention"),
+            "ln_mlp": {"scale": sd[lp + "layer.2.layer_norm.weight"]},
+            "mlp": _t5_mlp(sd, lp + "layer.2.DenseReluDense", gated),
+        }
+    if not cfg.tie_word_embeddings:
+        backbone["lm_head"] = _proj(_t(sd["lm_head.weight"]))
+    return {"backbone": backbone}
+
+
+def seq2seq_config_from_hf(hf_config):
+    """Map a transformers T5Config to :class:`Seq2SeqConfig`."""
+    from trlx_tpu.models.seq2seq import Seq2SeqConfig
+
+    if hf_config.model_type not in ("t5", "mt5"):
+        raise ValueError(f"Unsupported HF model type for seq2seq import: {hf_config.model_type}")
+    act = hf_config.feed_forward_proj  # "relu" | "gated-gelu"
+    return Seq2SeqConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.d_model,
+        num_layers=hf_config.num_layers,
+        num_decoder_layers=hf_config.num_decoder_layers,
+        num_heads=hf_config.num_heads,
+        head_dim=hf_config.d_kv,
+        intermediate_size=hf_config.d_ff,
+        relative_attention_num_buckets=hf_config.relative_attention_num_buckets,
+        relative_attention_max_distance=getattr(
+            hf_config, "relative_attention_max_distance", 128
+        ),
+        layer_norm_epsilon=hf_config.layer_norm_epsilon,
+        activation="gated-gelu" if "gated" in act else "relu",
+        tie_word_embeddings=bool(hf_config.tie_word_embeddings),
+        decoder_start_token_id=hf_config.decoder_start_token_id or 0,
+        pad_token_id=hf_config.pad_token_id or 0,
+    )
+
+
+def seq2seq_params_from_hf(model, cfg=None) -> Tuple[Dict[str, Any], Any]:
+    if cfg is None:
+        cfg = seq2seq_config_from_hf(model.config)
+    sd = torch_state_dict_to_numpy(model)
+    return convert_t5(sd, cfg), cfg
+
+
+def load_pretrained_seq2seq(path: str):
+    from transformers import AutoConfig, AutoModelForSeq2SeqLM
+
+    hf_config = AutoConfig.from_pretrained(path)
+    model = AutoModelForSeq2SeqLM.from_pretrained(path)
+    return seq2seq_params_from_hf(model, seq2seq_config_from_hf(hf_config))

@@ -1,0 +1,496 @@
+"""TPU-native T5-family encoder-decoder backbone (Flax linen).
+
+The reference's seq2seq path wraps HF T5 for PPO and ILQL
+(``trlx/models/modeling_ppo.py:948-1222``, ``modeling_ilql.py:347-488``; used
+by ``examples/ppo_sentiments_t5.py``). Here the same capability is a single
+configurable encoder-decoder covering T5 v1.0 (relu FFN, tied embeddings:
+t5-small/base/large/3b/11b) and v1.1/Flan (gated-GELU, untied: flan-t5-*),
+built on the same conventions as ``CausalTransformer``:
+
+- identical parameter naming (``q_proj``/``o_proj``/``up_proj``/``wte``/…) so
+  the one sharding rule table (``trlx_tpu/parallel/sharding.py``) maps the
+  whole model onto the ``(data, fsdp, model, sequence)`` mesh;
+- explicit functional KV cache for the decoder (self-attn K/V written at
+  ``cache_index``; cross-attn K/V computed once at prefill), so seq2seq
+  generation is one compiled ``lax.while_loop`` program;
+- a ``forward_branch`` that replays the top-k *decoder* blocks on trunk
+  activations — the hydra frozen-reference trick for seq2seq PPO (reference
+  ``T5Branch``, ``modeling_ppo.py:1113-1222``). The parametric relative
+  position bias is computed once by the shared frozen trunk and threaded into
+  the branch, matching the semantics of bottom-layers-frozen training.
+
+T5 numerics notes (matched to the public architecture): RMS layernorm without
+mean subtraction, **no** 1/sqrt(d) attention scaling, relative position bias
+added in layer 0 and shared across layers, and a d_model**-0.5 logit scaling
+when embeddings are tied.
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.models.transformer import param_with_axes
+
+
+@dataclasses.dataclass(frozen=True)
+class Seq2SeqConfig:
+    """Architecture description of a T5-style encoder-decoder."""
+
+    vocab_size: int
+    hidden_size: int  # d_model
+    num_layers: int  # encoder layers
+    num_decoder_layers: int
+    num_heads: int
+    head_dim: int  # d_kv (not necessarily hidden/heads for t5-small!)
+    intermediate_size: int  # d_ff
+
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_epsilon: float = 1e-6
+    activation: str = "relu"  # relu (v1.0) | gated-gelu (v1.1 / flan)
+    tie_word_embeddings: bool = True
+    decoder_start_token_id: int = 0
+    pad_token_id: int = 0
+
+    param_dtype: Any = jnp.float32
+    dtype: Any = jnp.bfloat16
+    remat: str = "none"
+
+    # duck-type compatibility with TransformerConfig consumers (heads, ILQL)
+    @property
+    def kv_heads(self) -> int:
+        return self.num_heads
+
+    @property
+    def dims_per_head(self) -> int:
+        return self.head_dim
+
+    @property
+    def is_seq2seq(self) -> bool:
+        return True
+
+    @staticmethod
+    def t5(size: str = "small", **overrides) -> "Seq2SeqConfig":
+        dims = {
+            "test": dict(vocab_size=259, hidden_size=64, num_layers=2, num_decoder_layers=2, num_heads=4, head_dim=16, intermediate_size=128, relative_attention_num_buckets=8, relative_attention_max_distance=20),
+            "small": dict(vocab_size=32128, hidden_size=512, num_layers=6, num_decoder_layers=6, num_heads=8, head_dim=64, intermediate_size=2048),
+            "base": dict(vocab_size=32128, hidden_size=768, num_layers=12, num_decoder_layers=12, num_heads=12, head_dim=64, intermediate_size=3072),
+            "large": dict(vocab_size=32128, hidden_size=1024, num_layers=24, num_decoder_layers=24, num_heads=16, head_dim=64, intermediate_size=4096),
+            "3b": dict(vocab_size=32128, hidden_size=1024, num_layers=24, num_decoder_layers=24, num_heads=32, head_dim=128, intermediate_size=16384),
+            "11b": dict(vocab_size=32128, hidden_size=1024, num_layers=24, num_decoder_layers=24, num_heads=128, head_dim=128, intermediate_size=65536),
+        }[size]
+        dims.update(overrides)
+        return Seq2SeqConfig(activation="relu", tie_word_embeddings=True, **dims)
+
+    @staticmethod
+    def flan_t5(size: str = "small", **overrides) -> "Seq2SeqConfig":
+        dims = {
+            "test": dict(vocab_size=259, hidden_size=64, num_layers=2, num_decoder_layers=2, num_heads=4, head_dim=16, intermediate_size=128, relative_attention_num_buckets=8, relative_attention_max_distance=20),
+            "small": dict(vocab_size=32128, hidden_size=512, num_layers=8, num_decoder_layers=8, num_heads=6, head_dim=64, intermediate_size=1024),
+            "base": dict(vocab_size=32128, hidden_size=768, num_layers=12, num_decoder_layers=12, num_heads=12, head_dim=64, intermediate_size=2048),
+            "large": dict(vocab_size=32128, hidden_size=1024, num_layers=24, num_decoder_layers=24, num_heads=16, head_dim=64, intermediate_size=2816),
+            "xl": dict(vocab_size=32128, hidden_size=2048, num_layers=24, num_decoder_layers=24, num_heads=32, head_dim=64, intermediate_size=5120),
+            "xxl": dict(vocab_size=32128, hidden_size=4096, num_layers=24, num_decoder_layers=24, num_heads=64, head_dim=64, intermediate_size=10240),
+        }[size]
+        dims.update(overrides)
+        return Seq2SeqConfig(activation="gated-gelu", tie_word_embeddings=False, **dims)
+
+
+def _t5_dense(cfg, features, kernel_axes, name):
+    return nn.Dense(
+        features,
+        use_bias=False,  # T5 uses no biases anywhere
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=param_with_axes(nn.initializers.normal(0.02), kernel_axes),
+        name=name,
+    )
+
+
+def _t5_norm(cfg, name):
+    # T5 layer norm: RMS without mean subtraction, scale only
+    return nn.RMSNorm(
+        epsilon=cfg.layer_norm_epsilon,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        scale_init=param_with_axes(nn.initializers.ones, ("embed",)),
+        name=name,
+    )
+
+
+def relative_position_bucket(
+    relative_position: jax.Array,  # k_pos - q_pos
+    bidirectional: bool,
+    num_buckets: int,
+    max_distance: int,
+) -> jax.Array:
+    """T5's log-bucketed relative position (public T5 bucket scheme)."""
+    ret = jnp.zeros_like(relative_position)
+    n = relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n > 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(-n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class RelativePositionBias(nn.Module):
+    """The parametric rel-pos bias table, owned by layer 0 of each stack."""
+
+    config: Seq2SeqConfig
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, q_positions: jax.Array, k_positions: jax.Array) -> jax.Array:
+        """[Tq], [Tk] → additive bias [1, H, Tq, Tk]."""
+        cfg = self.config
+        rel = k_positions[None, :] - q_positions[:, None]  # [Tq, Tk]
+        buckets = relative_position_bucket(
+            rel,
+            self.bidirectional,
+            cfg.relative_attention_num_buckets,
+            cfg.relative_attention_max_distance,
+        )
+        table = nn.Embed(
+            cfg.relative_attention_num_buckets,
+            cfg.num_heads,
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            embedding_init=param_with_axes(nn.initializers.normal(0.02), ("rel_buckets", "heads")),
+            name="rel_bias",
+        )(buckets)  # [Tq, Tk, H]
+        return table.transpose(2, 0, 1)[None]  # [1, H, Tq, Tk]
+
+
+class T5Attention(nn.Module):
+    """Self- or cross-attention, T5 style (no 1/sqrt(d) scaling, no biases)."""
+
+    config: Seq2SeqConfig
+
+    def setup(self):
+        cfg = self.config
+        HD = cfg.num_heads * cfg.head_dim
+        self.q_proj = _t5_dense(cfg, HD, ("embed", "joined_kv"), "q_proj")
+        self.k_proj = _t5_dense(cfg, HD, ("embed", "joined_kv"), "k_proj")
+        self.v_proj = _t5_dense(cfg, HD, ("embed", "joined_kv"), "v_proj")
+        self.o_proj = _t5_dense(cfg, cfg.hidden_size, ("joined_kv", "embed"), "o_proj")
+
+    def __call__(
+        self,
+        x: jax.Array,  # [B, T, E] queries
+        kv: Optional[jax.Array] = None,  # [B, S, E] for cross-attn (None: self)
+        bias: Optional[jax.Array] = None,  # [B or 1, H, T, S] additive
+        cache: Optional[Dict[str, jax.Array]] = None,
+        cache_index: Optional[jax.Array] = None,
+        precomputed_kv: Optional[Tuple[jax.Array, jax.Array]] = None,
+    ):
+        cfg = self.config
+        B, T, _ = x.shape
+        H, D = cfg.num_heads, cfg.head_dim
+
+        q = self.q_proj(x).reshape(B, T, H, D)
+        if precomputed_kv is not None:
+            k, v = precomputed_kv  # cross-attn during decode
+        else:
+            src = x if kv is None else kv
+            S = src.shape[1]
+            k = self.k_proj(src).reshape(B, S, H, D)
+            v = self.v_proj(src).reshape(B, S, H, D)
+
+        new_cache = None
+        if cache is not None:  # decoder self-attn: write this step into cache
+            k_cache = jax.lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, cache_index, 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, cache_index, 0, 0)
+            )
+            k, v = k_cache, v_cache
+            new_cache = {"k": k_cache, "v": v_cache}
+
+        scores = jnp.einsum("bthd,bshd->bhts", q, k)  # NOTE: no sqrt(d) scale
+        if bias is not None:
+            scores = scores + bias.astype(scores.dtype)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, H * D)
+        out = self.o_proj(out)
+        return out, new_cache
+
+    def compute_kv(self, src: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Project cross-attention K/V once (decode-time prefill)."""
+        cfg = self.config
+        B, S, _ = src.shape
+        H, D = cfg.num_heads, cfg.head_dim
+        return (
+            self.k_proj(src).reshape(B, S, H, D),
+            self.v_proj(src).reshape(B, S, H, D),
+        )
+
+
+class T5MLP(nn.Module):
+    config: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        if cfg.activation == "gated-gelu":
+            gate = _t5_dense(cfg, cfg.intermediate_size, ("embed", "ffn"), "gate_proj")(x)
+            up = _t5_dense(cfg, cfg.intermediate_size, ("embed", "ffn"), "up_proj")(x)
+            h = nn.gelu(gate, approximate=True) * up
+        else:
+            h = nn.relu(_t5_dense(cfg, cfg.intermediate_size, ("embed", "ffn"), "up_proj")(x))
+        return _t5_dense(cfg, cfg.hidden_size, ("ffn", "embed"), "down_proj")(h)
+
+
+class T5EncoderBlock(nn.Module):
+    config: Seq2SeqConfig
+
+    def setup(self):
+        cfg = self.config
+        self.ln_self = _t5_norm(cfg, "ln_self")
+        self.self_attn = T5Attention(cfg, name="self_attn")
+        self.ln_mlp = _t5_norm(cfg, "ln_mlp")
+        self.mlp = T5MLP(cfg, name="mlp")
+
+    def __call__(self, x, bias):
+        h, _ = self.self_attn(self.ln_self(x), bias=bias)
+        x = x + h
+        x = x + self.mlp(self.ln_mlp(x))
+        return x
+
+
+class T5DecoderBlock(nn.Module):
+    config: Seq2SeqConfig
+
+    def setup(self):
+        cfg = self.config
+        self.ln_self = _t5_norm(cfg, "ln_self")
+        self.self_attn = T5Attention(cfg, name="self_attn")
+        self.ln_cross = _t5_norm(cfg, "ln_cross")
+        self.cross_attn = T5Attention(cfg, name="cross_attn")
+        self.ln_mlp = _t5_norm(cfg, "ln_mlp")
+        self.mlp = T5MLP(cfg, name="mlp")
+
+    def __call__(
+        self,
+        x,
+        self_bias,
+        enc_hidden,
+        cross_bias,
+        cache=None,
+        cache_index=None,
+        cross_kv=None,
+    ):
+        h, new_cache = self.self_attn(
+            self.ln_self(x), bias=self_bias, cache=cache, cache_index=cache_index
+        )
+        x = x + h
+        h, _ = self.cross_attn(
+            self.ln_cross(x), kv=enc_hidden, bias=cross_bias, precomputed_kv=cross_kv
+        )
+        x = x + h
+        x = x + self.mlp(self.ln_mlp(x))
+        return x, new_cache
+
+    def cross_kv(self, enc_hidden: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        return self.cross_attn.compute_kv(enc_hidden)
+
+
+class T5Transformer(nn.Module):
+    """Full encoder-decoder. Decoder slots are positions (no left-padding)."""
+
+    config: Seq2SeqConfig
+
+    def setup(self):
+        cfg = self.config
+        self.wte = nn.Embed(
+            cfg.vocab_size,
+            cfg.hidden_size,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            embedding_init=param_with_axes(nn.initializers.normal(1.0), ("vocab", "embed")),
+            name="wte",
+        )
+        enc_block = T5EncoderBlock
+        dec_block = T5DecoderBlock
+        if cfg.remat == "full":
+            enc_block = nn.remat(T5EncoderBlock)
+            dec_block = nn.remat(T5DecoderBlock, methods=["__call__", "cross_kv"])
+        self.enc_rel_bias = RelativePositionBias(cfg, bidirectional=True, name="enc_rel_bias")
+        self.dec_rel_bias = RelativePositionBias(cfg, bidirectional=False, name="dec_rel_bias")
+        self.enc_blocks = [enc_block(cfg, name=f"enc_{i}") for i in range(cfg.num_layers)]
+        self.dec_blocks = [dec_block(cfg, name=f"dec_{i}") for i in range(cfg.num_decoder_layers)]
+        self.enc_ln_f = _t5_norm(cfg, "enc_ln_f")
+        self.dec_ln_f = _t5_norm(cfg, "dec_ln_f")
+        if not cfg.tie_word_embeddings:
+            self.lm_head = _t5_dense(cfg, cfg.vocab_size, ("embed", "vocab"), "lm_head")
+
+    # ---- pieces ----
+
+    def _logits(self, h):
+        cfg = self.config
+        if cfg.tie_word_embeddings:
+            return self.wte.attend(h * (cfg.hidden_size ** -0.5))
+        return self.lm_head(h)
+
+    def _pad_bias(self, mask: jax.Array, Tq: int) -> jax.Array:
+        """[B, S] key mask → additive [B, 1, Tq, S]."""
+        neg = jnp.asarray(-1e9, jnp.float32)
+        return jnp.where(mask[:, None, None, :] > 0, 0.0, neg) * jnp.ones(
+            (1, 1, Tq, 1), jnp.float32
+        )
+
+    def encode(self, input_ids: jax.Array, attention_mask: Optional[jax.Array] = None) -> jax.Array:
+        B, S = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, S), jnp.int32)
+        pos = jnp.arange(S)
+        bias = self.enc_rel_bias(pos, pos) + self._pad_bias(attention_mask, S)
+        x = self.wte(input_ids)
+        for block in self.enc_blocks:
+            x = block(x, bias)
+        return self.enc_ln_f(x)
+
+    def decode(
+        self,
+        decoder_input_ids: jax.Array,  # [B, T]
+        encoder_hidden: jax.Array,  # [B, S, E]
+        encoder_mask: jax.Array,  # [B, S]
+        decoder_mask: Optional[jax.Array] = None,  # [B, T] (right-padded)
+        cache: Optional[List[Dict[str, Any]]] = None,
+        cache_index: Optional[jax.Array] = None,
+        branch_layer: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        cfg = self.config
+        B, T = decoder_input_ids.shape
+        x = self.wte(decoder_input_ids)
+
+        if cache is None:
+            q_pos = jnp.arange(T)
+            k_pos = jnp.arange(T)
+            self_bias = self.dec_rel_bias(q_pos, k_pos)
+            self_bias = self_bias + jnp.where(
+                (k_pos[None, :] <= q_pos[:, None])[None, None], 0.0, -1e9
+            )
+            if decoder_mask is not None:
+                self_bias = self_bias + self._pad_bias(decoder_mask, T)
+        else:
+            S_dec = cache[0]["k"].shape[1]
+            q_pos = cache_index + jnp.arange(T)
+            k_pos = jnp.arange(S_dec)
+            self_bias = self.dec_rel_bias(q_pos, k_pos)
+            self_bias = self_bias + jnp.where(
+                (k_pos[None, :] <= q_pos[:, None])[None, None], 0.0, -1e9
+            )
+        cross_bias = self._pad_bias(encoder_mask, T)
+
+        branch_input = None
+        new_cache = [] if cache is not None else None
+        for i, block in enumerate(self.dec_blocks):
+            if branch_layer is not None and i == len(self.dec_blocks) - branch_layer:
+                branch_input = x
+            layer_cache = cache[i] if cache is not None else None
+            cross_kv = (
+                (layer_cache["ck"], layer_cache["cv"]) if layer_cache is not None else None
+            )
+            x, updated = block(
+                x, self_bias, encoder_hidden, cross_bias,
+                cache=layer_cache, cache_index=cache_index, cross_kv=cross_kv,
+            )
+            if cache is not None:
+                updated["ck"], updated["cv"] = layer_cache["ck"], layer_cache["cv"]
+                new_cache.append(updated)
+
+        h = self.dec_ln_f(x)
+        return {
+            "logits": self._logits(h),
+            "hidden_states": h,
+            "pre_norm_hidden": x,
+            "branch_input": branch_input,
+            "cache": new_cache,
+        }
+
+    def __call__(
+        self,
+        input_ids: jax.Array,  # encoder tokens [B, S]
+        attention_mask: Optional[jax.Array] = None,  # [B, S]
+        decoder_input_ids: Optional[jax.Array] = None,  # [B, T]
+        decoder_attention_mask: Optional[jax.Array] = None,
+        branch_layer: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        cfg = self.config
+        B = input_ids.shape[0]
+        if attention_mask is None:
+            attention_mask = jnp.ones(input_ids.shape, jnp.int32)
+        if decoder_input_ids is None:
+            decoder_input_ids = jnp.full((B, 1), cfg.decoder_start_token_id, jnp.int32)
+        enc = self.encode(input_ids, attention_mask)
+        out = self.decode(
+            decoder_input_ids, enc, attention_mask,
+            decoder_mask=decoder_attention_mask, branch_layer=branch_layer,
+        )
+        out["encoder_hidden"] = enc
+        return out
+
+    def forward_branch(
+        self,
+        hidden_states: jax.Array,  # [B, T, E] decoder activations entering branch
+        branch_layer: int,
+        encoder_hidden: jax.Array,
+        encoder_mask: jax.Array,
+        decoder_mask: Optional[jax.Array] = None,
+    ) -> Dict[str, Any]:
+        """Replay the top ``branch_layer`` decoder blocks + final norm + head
+        (seq2seq hydra reference branch, reference ``T5Branch``
+        ``modeling_ppo.py:1113-1222``). The rel-pos bias is recomputed from
+        this (frozen) branch's own table — identical to the policy's because
+        layer 0 of the decoder is part of the frozen trunk."""
+        B, T, _ = hidden_states.shape
+        q_pos = jnp.arange(T)
+        self_bias = self.dec_rel_bias(q_pos, q_pos)
+        self_bias = self_bias + jnp.where(
+            (q_pos[None, :] <= q_pos[:, None])[None, None], 0.0, -1e9
+        )
+        if decoder_mask is not None:
+            self_bias = self_bias + self._pad_bias(decoder_mask, T)
+        cross_bias = self._pad_bias(encoder_mask, T)
+        x = hidden_states
+        for block in self.dec_blocks[len(self.dec_blocks) - branch_layer :]:
+            x, _ = block(x, self_bias, encoder_hidden, cross_bias)
+        h = self.dec_ln_f(x)
+        return {"logits": self._logits(h), "hidden_states": h}
+
+    def encode_for_decode(
+        self, input_ids: jax.Array, attention_mask: jax.Array, max_decode_len: int
+    ) -> Tuple[jax.Array, List[Dict[str, jax.Array]]]:
+        """Encoder pass + fresh decoder cache with cross-attn K/V prefilled
+        (computed once per sequence, reused by every decode step)."""
+        cfg = self.config
+        B = input_ids.shape[0]
+        enc = self.encode(input_ids, attention_mask)
+        cache = []
+        for i in range(cfg.num_decoder_layers):
+            ck, cv = self.dec_blocks[i].cross_kv(enc)
+            cache.append(
+                {
+                    "k": jnp.zeros((B, max_decode_len, cfg.num_heads, cfg.head_dim), cfg.dtype),
+                    "v": jnp.zeros((B, max_decode_len, cfg.num_heads, cfg.head_dim), cfg.dtype),
+                    "ck": ck,
+                    "cv": cv,
+                }
+            )
+        return enc, cache

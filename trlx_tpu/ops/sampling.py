@@ -67,6 +67,41 @@ def process_logits(
     return logits
 
 
+
+
+def sample_token_from_logits(
+    logits: jax.Array,  # [B, V] raw last-position logits
+    step_out: Dict[str, Any],
+    sample_rng: jax.Array,
+    config: GenerationConfig,
+    step: jax.Array,
+    adjust_logits: Optional[Callable[[Dict[str, Any], jax.Array], jax.Array]],
+) -> Tuple[jax.Array, jax.Array]:
+    """Shared sampling semantics for both decode loops: adjust-logits hook,
+    min_new_tokens eos blocking, temperature/top-k/top-p filtering,
+    sample-or-argmax, and behavior logprob of the chosen token."""
+    if adjust_logits is not None:
+        logits = adjust_logits(step_out, logits)
+    logits = logits.astype(jnp.float32)
+    if config.eos_token_id is not None and config.min_new_tokens > 0:
+        block_eos = step < config.min_new_tokens
+        logits = jnp.where(
+            block_eos
+            & (jnp.arange(logits.shape[-1])[None, :] == config.eos_token_id),
+            -jnp.inf,
+            logits,
+        )
+    filtered = process_logits(logits, config.temperature, config.top_k, config.top_p)
+    if config.do_sample:
+        next_token = jax.random.categorical(sample_rng, filtered, axis=-1)
+    else:
+        next_token = jnp.argmax(filtered, axis=-1)
+    logprob = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), next_token[:, None], axis=-1
+    )[:, 0]
+    return next_token, logprob
+
+
 class GenerationOutput(NamedTuple):
     sequences: jax.Array  # [B, P + N] prompt (left-padded) ‖ response
     response_tokens: jax.Array  # [B, N] pad-filled after eos
@@ -144,26 +179,9 @@ def generate(
 
     def sample_step(carry: Carry) -> Carry:
         rng, sample_rng = jax.random.split(carry.rng)
-        logits = carry.logits
-        if adjust_logits is not None:
-            logits = adjust_logits(carry.step_out, logits)
-        logits = logits.astype(jnp.float32)
-        if config.eos_token_id is not None and config.min_new_tokens > 0:
-            block_eos = carry.step < config.min_new_tokens
-            logits = jnp.where(
-                block_eos
-                & (jnp.arange(logits.shape[-1])[None, :] == config.eos_token_id),
-                -jnp.inf,
-                logits,
-            )
-        filtered = process_logits(logits, config.temperature, config.top_k, config.top_p)
-        if config.do_sample:
-            next_token = jax.random.categorical(sample_rng, filtered, axis=-1)
-        else:
-            next_token = jnp.argmax(filtered, axis=-1)
-        logprob = jnp.take_along_axis(
-            jax.nn.log_softmax(logits, axis=-1), next_token[:, None], axis=-1
-        )[:, 0]
+        next_token, logprob = sample_token_from_logits(
+            carry.logits, carry.step_out, sample_rng, config, carry.step, adjust_logits
+        )
 
         next_token = jnp.where(carry.done, config.pad_token_id, next_token).astype(jnp.int32)
         live = ~carry.done
@@ -223,6 +241,123 @@ def generate(
         cache=cache,
         logits=last_logits,
         step_out=_last_step_info(prefill_out),
+        done=jnp.zeros((B,), bool),
+        step=jnp.asarray(0, jnp.int32),
+        rng=rng,
+    )
+    final = jax.lax.while_loop(cond, sample_step, init)
+
+    sequences = jnp.concatenate([input_ids, final.tokens], axis=1)
+    return GenerationOutput(
+        sequences=sequences,
+        response_tokens=final.tokens,
+        response_mask=final.mask,
+        response_logprobs=final.logprobs,
+        response_values=final.values,
+        prompt_mask=attention_mask.astype(jnp.int32),
+    )
+
+
+def generate_seq2seq(
+    encode_fn: Callable[..., Tuple[jax.Array, Any]],
+    decode_fn: Callable[..., Dict[str, Any]],
+    params: Any,
+    input_ids: jax.Array,  # [B, P] right-padded encoder prompts
+    attention_mask: jax.Array,  # [B, P]
+    rng: jax.Array,
+    config: GenerationConfig,
+    start_token_id: int = 0,
+    adjust_logits: Optional[Callable[[Dict[str, Any], jax.Array], jax.Array]] = None,
+) -> GenerationOutput:
+    """Seq2seq sampling: one encoder pass, then a ``lax.while_loop`` decoder
+    (reference: HF ``generate`` on the T5 wrappers, used by the seq2seq PPO/
+    ILQL paths ``trlx/trainer/accelerate_ppo_trainer.py:152-179``,
+    ``modeling_ilql.py:460-488``).
+
+    ``encode_fn(params, input_ids, attention_mask, max_decode_len)`` returns
+    ``(encoder_hidden, decoder_cache)`` with cross-attn K/V prefilled;
+    ``decode_fn(params, decoder_input_ids, encoder_hidden, encoder_mask,
+    cache, cache_index)`` returns at least ``logits`` and ``cache``.
+
+    Decoder sequences all start at slot 0 with ``start_token_id`` — no
+    left-padding complications. Fully jittable with static ``config``.
+    """
+    B, P = input_ids.shape
+    N = config.max_new_tokens
+    input_ids = input_ids.astype(jnp.int32)
+
+    enc_hidden, cache = encode_fn(params, input_ids, attention_mask, N + 1)
+    start = jnp.full((B, 1), start_token_id, jnp.int32)
+    out0 = decode_fn(
+        params, start, enc_hidden, attention_mask, cache, jnp.asarray(0, jnp.int32)
+    )
+
+    def _last_step_info(out: Dict[str, Any]) -> Dict[str, Any]:
+        info = {}
+        for k, v in out.items():
+            if k in ("cache", "logits", "branch_input", "pre_norm_hidden", "encoder_hidden") or v is None:
+                continue
+            info[k] = jax.tree_util.tree_map(lambda x: x[:, -1], v)
+        return info
+
+    class Carry(NamedTuple):
+        tokens: jax.Array
+        logprobs: jax.Array
+        values: jax.Array
+        mask: jax.Array
+        cache: Any
+        logits: jax.Array
+        step_out: Any
+        done: jax.Array
+        step: jax.Array
+        rng: jax.Array
+
+    def sample_step(carry: Carry) -> Carry:
+        rng, sample_rng = jax.random.split(carry.rng)
+        next_token, logprob = sample_token_from_logits(
+            carry.logits, carry.step_out, sample_rng, config, carry.step, adjust_logits
+        )
+
+        next_token = jnp.where(carry.done, config.pad_token_id, next_token).astype(jnp.int32)
+        live = ~carry.done
+        tokens = carry.tokens.at[:, carry.step].set(next_token)
+        logprobs = carry.logprobs.at[:, carry.step].set(jnp.where(live, logprob, 0.0))
+        value = carry.step_out.get("value", jnp.zeros((B,), jnp.float32))
+        values = carry.values.at[:, carry.step].set(jnp.where(live, value, 0.0))
+        mask = carry.mask.at[:, carry.step].set(live.astype(jnp.int32))
+
+        done = carry.done
+        if config.eos_token_id is not None:
+            done = done | (next_token == config.eos_token_id)
+
+        out = decode_fn(
+            params, next_token[:, None], enc_hidden, attention_mask,
+            carry.cache, carry.step + 1,
+        )
+        return Carry(
+            tokens=tokens,
+            logprobs=logprobs,
+            values=values,
+            mask=mask,
+            cache=out["cache"],
+            logits=out["logits"][:, -1, :],
+            step_out=_last_step_info(out),
+            done=done,
+            step=carry.step + 1,
+            rng=rng,
+        )
+
+    def cond(carry: Carry) -> jax.Array:
+        return (carry.step < N) & ~jnp.all(carry.done)
+
+    init = Carry(
+        tokens=jnp.full((B, N), config.pad_token_id, jnp.int32),
+        logprobs=jnp.zeros((B, N), jnp.float32),
+        values=jnp.zeros((B, N), jnp.float32),
+        mask=jnp.zeros((B, N), jnp.int32),
+        cache=out0["cache"],
+        logits=out0["logits"][:, -1, :],
+        step_out=_last_step_info(out0),
         done=jnp.zeros((B,), bool),
         step=jnp.asarray(0, jnp.int32),
         rng=rng,

@@ -32,7 +32,12 @@ from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.tokenizer import from_config as tokenizer_from_config
 from trlx_tpu.models.builder import build_causal_lm, trainable_mask
 from trlx_tpu.models.transformer import make_kv_cache
-from trlx_tpu.ops.sampling import GenerationConfig, GenerationOutput, generate
+from trlx_tpu.ops.sampling import (
+    GenerationConfig,
+    GenerationOutput,
+    generate,
+    generate_seq2seq,
+)
 from trlx_tpu.parallel import make_mesh, set_global_mesh, shard_batch, shard_params
 from trlx_tpu.pipeline import BasePipeline
 from trlx_tpu.trainer import BaseRLTrainer
@@ -99,18 +104,35 @@ class TPUBaseTrainer(BaseRLTrainer):
         self.tokenizer = tokenizer_from_config(config.tokenizer)
 
         two_qs = bool(getattr(config.method, "two_qs", True))
-        self.module, params, self.tcfg = build_causal_lm(
-            config.model,
-            config.parallel,
-            head=self.model_head,
-            two_qs=two_qs,
-            seed=config.train.seed,
-        )
-        params = shard_params(params, self.mesh)
+        # seq2seq (T5) vs causal arch selection (reference ``get_arch``,
+        # ``accelerate_ppo_trainer.py:120-134``)
+        self.is_seq2seq = config.model.model_arch_type == "seq2seq"
+        if self.is_seq2seq:
+            from trlx_tpu.models.builder import build_seq2seq_lm, seq2seq_trainable_mask
 
-        self.param_mask = trainable_mask(
-            params, self.tcfg, config.model.num_layers_unfrozen
-        )
+            self.module, params, self.tcfg = build_seq2seq_lm(
+                config.model,
+                config.parallel,
+                head=self.model_head,
+                two_qs=two_qs,
+                seed=config.train.seed,
+            )
+            params = shard_params(params, self.mesh)
+            self.param_mask = seq2seq_trainable_mask(
+                params, self.tcfg, config.model.num_layers_unfrozen
+            )
+        else:
+            self.module, params, self.tcfg = build_causal_lm(
+                config.model,
+                config.parallel,
+                head=self.model_head,
+                two_qs=two_qs,
+                seed=config.train.seed,
+            )
+            params = shard_params(params, self.mesh)
+            self.param_mask = trainable_mask(
+                params, self.tcfg, config.model.num_layers_unfrozen
+            )
         default_lr = config.optimizer.kwargs.get("lr")
         self.schedule = get_scheduler(
             config.scheduler.name, dict(config.scheduler.kwargs), default_lr=default_lr
@@ -238,21 +260,54 @@ class TPUBaseTrainer(BaseRLTrainer):
     ) -> Callable:
         key = (gen_config, extra_kwargs)
         if key not in self._generate_fns:
-            apply_fn = self._apply_fn()
-            tcfg = self.tcfg
             adjust = self.adjust_logits_fn(dict(extra_kwargs))
+            if self.is_seq2seq:
+                module = self.module
+                start_id = self.tcfg.decoder_start_token_id
 
-            def fn(params, input_ids, attention_mask, rng):
-                return generate(
-                    apply_fn,
-                    params,
-                    lambda B, S: make_kv_cache(tcfg, B, S),
-                    input_ids,
-                    attention_mask,
-                    rng,
-                    gen_config,
-                    adjust_logits=adjust,
-                )
+                def encode_fn(params, input_ids, attention_mask, max_len):
+                    return module.apply(
+                        {"params": params}, input_ids, attention_mask, max_len,
+                        method=type(module).encode_for_decode,
+                    )
+
+                def decode_fn(params, dec_ids, enc_hidden, enc_mask, cache, cache_index):
+                    # keywords: T5Transformer.decode has decoder_mask as its
+                    # 4th positional arg; positional cache would mis-bind
+                    return module.apply(
+                        {"params": params}, dec_ids, enc_hidden, enc_mask,
+                        cache=cache, cache_index=cache_index,
+                        method=type(module).decode,
+                    )
+
+                def fn(params, input_ids, attention_mask, rng):
+                    return generate_seq2seq(
+                        encode_fn,
+                        decode_fn,
+                        params,
+                        input_ids,
+                        attention_mask,
+                        rng,
+                        gen_config,
+                        start_token_id=start_id,
+                        adjust_logits=adjust,
+                    )
+
+            else:
+                apply_fn = self._apply_fn()
+                tcfg = self.tcfg
+
+                def fn(params, input_ids, attention_mask, rng):
+                    return generate(
+                        apply_fn,
+                        params,
+                        lambda B, S: make_kv_cache(tcfg, B, S),
+                        input_ids,
+                        attention_mask,
+                        rng,
+                        gen_config,
+                        adjust_logits=adjust,
+                    )
 
             self._generate_fns[key] = jax.jit(fn)
         return self._generate_fns[key]
@@ -332,7 +387,13 @@ class TPUBaseTrainer(BaseRLTrainer):
                 str_output += self.tokenizer.eos_token
             str_prompts.append(str_prompt)
             str_outputs.append(str_output)
-            str_samples.append(str_prompt + str_output)
+            if self.is_seq2seq:
+                # seq2seq samples join prompt and output with the sep token
+                # (reference ``decode``, ``accelerate_base_trainer.py:219-221``)
+                sep = getattr(self.tokenizer, "sep_token", None) or " "
+                str_samples.append(str_prompt + sep + str_output)
+            else:
+                str_samples.append(str_prompt + str_output)
         return str_samples, str_prompts, str_outputs
 
     # ------------------------------------------------------------------

@@ -32,6 +32,7 @@ from trlx_tpu.models.heads import sync_target_q_params
 from trlx_tpu.models.ilql import ILQLConfig, batched_index_select
 from trlx_tpu.pipeline.offline_pipeline import (
     ILQLRolloutStorage,
+    ILQLSeq2SeqRolloutStorage,
     tokenize_dialogue,
 )
 from trlx_tpu.trainer import register_trainer
@@ -116,6 +117,61 @@ def make_experience(
     )
 
 
+def make_experience_seq2seq(
+    samples: List[Union[str, List[str]]],
+    rewards: List[float],
+    tokenizer: Optional[Tokenizer] = None,
+    max_length: int = 2048,
+    verbose: bool = True,
+) -> ILQLSeq2SeqRolloutStorage:
+    """Seq2seq variant: the prompt feeds the encoder, the output becomes the
+    decoder sequence with actions/states indexed over decoder positions
+    (reference ``make_experience_seq2seq``,
+    ``accelerate_ilql_trainer.py:175-240``)."""
+    if verbose:
+        logger.info("Collecting rollouts")
+    if tokenizer is not None:
+        samples = [tokenize_dialogue(s, tokenizer, max_length) for s in samples]
+
+    all_input_ids = []
+    all_output_ids = []
+    all_actions_ixs = []
+    all_states_ixs = []
+    all_dones = []
+    for sample in samples:
+        prompt_tokens = [t for m in sample if not m.is_output for t in m.tokens]
+        output_tokens = [t for m in sample if m.is_output for t in m.tokens]
+        all_input_ids.append(np.asarray(prompt_tokens, np.int32))
+        all_output_ids.append(np.asarray(output_tokens, np.int32))
+        length = len(output_tokens)
+        actions_ixs = np.arange(0, max(length - 1, 0), dtype=np.int32)
+        states_ixs = np.concatenate([actions_ixs, np.array([max(length - 1, 0)], np.int32)])
+        all_dones.append(np.array([1] * (len(states_ixs) - 1) + [0], np.int32))
+        all_actions_ixs.append(actions_ixs)
+        all_states_ixs.append(states_ixs)
+
+    returns = np.asarray(rewards, dtype=np.float64)
+    returns = returns - returns.mean()
+    std = returns.std()
+    if not np.isnan(std) and std > 0:
+        returns = returns / (std + np.finfo(returns.dtype).eps)
+    token_rewards = [np.zeros(len(ixs), np.float32) for ixs in all_actions_ixs]
+    for rs, ret in zip(token_rewards, returns):
+        if len(rs):
+            rs[-1] = ret
+
+    attention_mask = [np.ones(len(x), np.int32) for x in all_input_ids]
+    return ILQLSeq2SeqRolloutStorage(
+        all_input_ids,
+        attention_mask,
+        all_output_ids,
+        token_rewards,
+        all_states_ixs,
+        all_actions_ixs,
+        all_dones,
+    )
+
+
 @register_trainer
 class ILQLTrainer(TPUBaseTrainer):
     model_head = "ilql"
@@ -133,9 +189,14 @@ class ILQLTrainer(TPUBaseTrainer):
     def make_experience(
         self, samples, rewards, max_length: int = 2048
     ) -> None:
-        self.store = make_experience(
-            samples, rewards, self.tokenizer, max_length=max_length
-        )
+        if self.is_seq2seq:
+            self.store = make_experience_seq2seq(
+                samples, rewards, self.tokenizer, max_length=max_length
+            )
+        else:
+            self.store = make_experience(
+                samples, rewards, self.tokenizer, max_length=max_length
+            )
 
     # ------------------------------------------------------------------
     # loss
@@ -146,12 +207,25 @@ class ILQLTrainer(TPUBaseTrainer):
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
         module = self.module
 
-        backbone_out = module.apply(
-            {"params": params},
-            batch["input_ids"],
-            attention_mask=batch["attention_mask"],
-            method=type(module).backbone_forward,
-        )
+        if self.is_seq2seq:
+            # decoder positions carry actions/states (reference seq2seq heads
+            # forward, ``modeling_ilql.py:396-427``)
+            backbone_out = module.apply(
+                {"params": params},
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                decoder_input_ids=batch["decoder_input_ids"],
+                method=type(module).backbone_forward,
+            )
+            action_source = batch["decoder_input_ids"]
+        else:
+            backbone_out = module.apply(
+                {"params": params},
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                method=type(module).backbone_forward,
+            )
+            action_source = batch["input_ids"]
         hidden = backbone_out["hidden_states"]
         logits_all = backbone_out["logits"]
 
@@ -164,9 +238,9 @@ class ILQLTrainer(TPUBaseTrainer):
             method=type(module).heads_on,
         )
         logits = batched_index_select(logits_all, batch["actions_ixs"])
-        # the action token itself = input_ids shifted left, at the action index
+        # the action token itself = the next token after the action index
         actions = jnp.take_along_axis(
-            batch["input_ids"][:, 1:], batch["actions_ixs"], axis=1
+            action_source[:, 1:], batch["actions_ixs"], axis=1
         )
         return self.ilql.loss(
             logits=logits,

@@ -66,14 +66,27 @@ class PPOTrainer(TPUBaseTrainer):
         # so the snapshot must own its buffers.
         nlu = config.model.num_layers_unfrozen
         self.num_layers_unfrozen = nlu
-        if nlu > 0:
-            branch = hydra_ref_params(self.state.params, self.tcfg, nlu)
-            self.ref_params = jax.tree_util.tree_map(jnp.copy, branch)
+        if self.is_seq2seq:
+            from trlx_tpu.models.builder import seq2seq_hydra_ref_params
+            from trlx_tpu.models.seq2seq import T5Transformer
+
+            if nlu > 0:
+                branch = seq2seq_hydra_ref_params(self.state.params, self.tcfg, nlu)
+                self.ref_params = jax.tree_util.tree_map(jnp.copy, branch)
+            else:
+                self.ref_params = jax.tree_util.tree_map(
+                    jnp.copy, self.state.params["backbone"]
+                )
+            self._ref_module = T5Transformer(self.tcfg)
         else:
-            self.ref_params = jax.tree_util.tree_map(
-                jnp.copy, self.state.params["backbone"]
-            )
-        self._ref_module = CausalTransformer(self.tcfg)
+            if nlu > 0:
+                branch = hydra_ref_params(self.state.params, self.tcfg, nlu)
+                self.ref_params = jax.tree_util.tree_map(jnp.copy, branch)
+            else:
+                self.ref_params = jax.tree_util.tree_map(
+                    jnp.copy, self.state.params["backbone"]
+                )
+            self._ref_module = CausalTransformer(self.tcfg)
 
         self.running_moments = RunningMoments()
         self.ref_mean: Optional[float] = method.ref_mean
@@ -119,6 +132,69 @@ class PPOTrainer(TPUBaseTrainer):
         ref_module = self._ref_module
         nlu = self.num_layers_unfrozen
         B, P, N = batch_shape
+
+        if self.is_seq2seq:
+            start_id = self.tcfg.decoder_start_token_id
+
+            def score_fn(params, ref_params, sequences, prompt_mask, response_tokens,
+                         response_mask, scores, kl_coef):
+                # encoder side: the prompt; decoder side: teacher-forced
+                # responses shifted right behind the start token (reference
+                # seq2seq scoring, ``accelerate_ppo_trainer.py:369-398``)
+                prompt_ids = sequences[:, :P]
+                dec_in = jnp.concatenate(
+                    [jnp.full((B, 1), start_id, jnp.int32), response_tokens[:, :-1]],
+                    axis=1,
+                )
+                dec_mask = jnp.concatenate(
+                    [jnp.ones((B, 1), jnp.int32), response_mask[:, :-1]], axis=1
+                )
+                out = module.apply(
+                    {"params": params},
+                    prompt_ids,
+                    attention_mask=prompt_mask,
+                    decoder_input_ids=dec_in,
+                    decoder_attention_mask=dec_mask,
+                    branch_layer=nlu if nlu > 0 else None,
+                )
+                # decoder position i predicts response token i directly
+                logprobs = logprobs_of_labels(out["logits"], response_tokens)
+                values = out["value"]
+
+                if nlu > 0:
+                    ref_out = module.apply(
+                        {"params": {"backbone": ref_params}},
+                        out["branch_input"],
+                        nlu,
+                        out["encoder_hidden"],
+                        prompt_mask,
+                        dec_mask,
+                        method=type(module).forward_branch,
+                    )
+                else:
+                    ref_out = ref_module.apply(
+                        {"params": ref_params},
+                        prompt_ids,
+                        attention_mask=prompt_mask,
+                        decoder_input_ids=dec_in,
+                        decoder_attention_mask=dec_mask,
+                    )
+                ref_logprobs = logprobs_of_labels(ref_out["logits"], response_tokens)
+
+                rewards, (mean_kl, mean_kl_per_seq) = kl_penalty_rewards(
+                    logprobs, ref_logprobs, response_mask, scores, kl_coef
+                )
+                return {
+                    "logprobs": logprobs,
+                    "values": values,
+                    "rewards": rewards,
+                    "mean_kl": mean_kl,
+                    "mean_kl_per_seq": mean_kl_per_seq,
+                }
+
+            fn = jax.jit(score_fn)
+            self._score_fns[batch_shape] = fn
+            return fn
 
         def score_fn(params, ref_params, sequences, prompt_mask, response_tokens,
                      response_mask, scores, kl_coef):
@@ -291,6 +367,34 @@ class PPOTrainer(TPUBaseTrainer):
         advantages, returns = method.get_advantages_and_returns(
             old_values, rewards, response_mask
         )
+
+        if self.is_seq2seq:
+            B = queries.shape[0]
+            start_id = self.tcfg.decoder_start_token_id
+            dec_in = jnp.concatenate(
+                [jnp.full((B, 1), start_id, jnp.int32), responses[:, :-1]], axis=1
+            )
+            dec_mask = jnp.concatenate(
+                [jnp.ones((B, 1), jnp.int32), batch["response_mask"][:, :-1]], axis=1
+            )
+            out = self.module.apply(
+                {"params": params},
+                queries,
+                attention_mask=query_mask,
+                decoder_input_ids=dec_in,
+                decoder_attention_mask=dec_mask,
+            )
+            logprobs = logprobs_of_labels(out["logits"], responses)
+            values_pred = out["value"]
+            return method.loss(
+                logprobs=logprobs,
+                values=values_pred,
+                old_logprobs=old_logprobs,
+                old_values=old_values,
+                advantages=advantages,
+                returns=returns,
+                mask=response_mask,
+            )
 
         input_ids = jnp.concatenate([queries, responses], axis=1)
         attention_mask = jnp.concatenate(
