@@ -1,0 +1,128 @@
+"""LoRA / parameter-efficient tuning tests (reference capability:
+OpenDelta lora via ``model.peft_kwargs``, ``trlx/utils/modeling.py:389-450``,
+hooked in ``accelerate_base_trainer.py:133-144``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.data.configs import ModelConfig
+from trlx_tpu.models.builder import (
+    LORA_TARGET_GROUPS,
+    build_causal_lm,
+    merge_lora_params,
+    parse_peft_overrides,
+    trainable_mask,
+)
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def _flat(tree):
+    return {
+        "/".join(str(getattr(k, "key", k)) for k in path): v
+        for path, v in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
+
+
+def test_parse_peft_overrides():
+    ov = parse_peft_overrides({"peft_type": "LORA", "r": 4, "lora_alpha": 8, "modified_modules": "attention"})
+    assert ov == dict(lora_r=4, lora_alpha=8.0, lora_targets=LORA_TARGET_GROUPS["attention"])
+    with pytest.raises(ValueError, match="Only LoRA"):
+        parse_peft_overrides({"peft_type": "adapter"})
+    with pytest.raises(ValueError, match="modified_modules"):
+        parse_peft_overrides({"modified_modules": "bogus"})
+
+
+def _lora_model():
+    mc = ModelConfig(
+        model_path="builtin:gpt2-test",
+        num_layers_unfrozen=1,
+        peft_kwargs={"peft_type": "lora", "r": 4, "lora_alpha": 8, "modified_modules": "attention"},
+        model_extra_kwargs=dict(dtype=jnp.float32),
+    )
+    return build_causal_lm(mc, head="value")
+
+
+def test_lora_noop_at_init_and_fold():
+    module, params, tcfg = _lora_model()
+    ids = jnp.ones((2, 8), jnp.int32)
+    out = module.apply({"params": params}, ids)
+
+    def strip(t):
+        if isinstance(t, dict):
+            return {k: strip(v) for k, v in t.items() if k not in ("lora_a", "lora_b")}
+        return t
+
+    plain_module, _, _ = build_causal_lm(
+        ModelConfig(model_path="builtin:gpt2-test", model_extra_kwargs=dict(dtype=jnp.float32)),
+        head="value",
+    )
+    base_out = plain_module.apply({"params": strip(params)}, ids)
+    np.testing.assert_array_equal(np.asarray(out["logits"]), np.asarray(base_out["logits"]))
+
+    # perturb adapters, then folding must reproduce the adapted forward exactly
+    bumped = jax.tree_util.tree_map_with_path(
+        lambda p, x: x + 0.01 if "lora" in "/".join(str(getattr(k, "key", "")) for k in p) else x,
+        params,
+    )
+    out_adapted = module.apply({"params": bumped}, ids)
+    folded = merge_lora_params(bumped, tcfg)
+    out_folded = plain_module.apply({"params": strip(folded)}, ids)
+    np.testing.assert_allclose(
+        np.asarray(out_adapted["logits"]), np.asarray(out_folded["logits"]), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_lora_trainable_mask():
+    module, params, tcfg = _lora_model()
+    mask = _flat(trainable_mask(params, tcfg, 1))
+    trainables = sorted(k for k, v in mask.items() if v)
+    # adapters in the unfrozen layer + heads only
+    assert all("lora_" in k or k.startswith("v_head") for k in trainables)
+    assert any(k.startswith("backbone/h_1/attn/q_proj/lora_a") for k in trainables)
+    assert not any("/h_0/" in k for k in trainables)
+    assert not any(
+        k.endswith("/kernel") and "lora" not in k and k.startswith("backbone")
+        for k in trainables
+    )
+
+
+def test_ppo_with_lora_e2e(tmp_path):
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.ppo  # noqa: F401
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            seq_length=32, batch_size=4, total_steps=2, eval_interval=2,
+            checkpoint_interval=100, epochs=1, checkpoint_dir=str(tmp_path), tracker=None,
+        ),
+        model=dict(
+            model_path="builtin:gpt2-test",
+            num_layers_unfrozen=1,
+            peft_kwargs={"peft_type": "lora", "r": 4, "modified_modules": "all"},
+        ),
+        method=dict(
+            num_rollouts=4, chunk_size=4, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    trainer = get_trainer(config.train.trainer)(
+        config=config,
+        reward_fn=lambda samples, prompts, outputs, **kw: [float(len(o)) for o in outputs],
+        metric_fn=None,
+        stop_sequences=[],
+    )
+    pipe = get_pipeline(config.train.pipeline)(["hello", "world"] * 2, 16, trainer.tokenizer)
+    trainer.add_prompt_pipeline(pipe)
+    trainer.make_experience(4)
+    before = jax.tree_util.tree_leaves(trainer.state.params["backbone"]["h_0"])[0].copy()
+    loader = trainer.store.create_loader(4, shuffle=True)
+    stats = trainer.train_step(next(iter(loader)))
+    assert np.isfinite(float(np.asarray(stats["losses/total_loss"])))
+    after = jax.tree_util.tree_leaves(trainer.state.params["backbone"]["h_0"])[0]
+    np.testing.assert_array_equal(np.asarray(before), np.asarray(after))  # frozen base unchanged

@@ -24,6 +24,110 @@ from trlx_tpu.models.transformer import (
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
 
 
+LORA_TARGET_GROUPS = {
+    "attention": ("q_proj", "k_proj", "v_proj", "o_proj"),
+    "mlp": ("gate_proj", "up_proj", "down_proj"),
+}
+LORA_TARGET_GROUPS["all"] = LORA_TARGET_GROUPS["attention"] + LORA_TARGET_GROUPS["mlp"]
+
+
+def parse_peft_overrides(peft_kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    """ModelConfig.peft_kwargs → backbone config overrides (reference
+    ``parse_delta_kwargs``, ``trlx/utils/modeling.py:419-450``; like the
+    reference, only LoRA is supported)."""
+    kw = dict(peft_kwargs)
+    peft_type = str(kw.pop("peft_type", kw.pop("delta_type", "lora"))).lower()
+    if peft_type != "lora":
+        raise ValueError(f"Only LoRA peft is supported (got '{peft_type}')")
+    modified = kw.pop("modified_modules", "all")
+    if isinstance(modified, str):
+        if modified not in LORA_TARGET_GROUPS:
+            raise ValueError(
+                f"modified_modules '{modified}' not in {sorted(LORA_TARGET_GROUPS)}; "
+                "pass an explicit list of projection names instead"
+            )
+        targets = LORA_TARGET_GROUPS[modified]
+    else:
+        targets = tuple(modified)
+    out = dict(
+        lora_r=int(kw.pop("r", kw.pop("lora_r", 8))),
+        lora_alpha=float(kw.pop("lora_alpha", 16.0)),
+        lora_targets=targets,
+    )
+    if kw:
+        raise ValueError(f"Unknown peft_kwargs keys: {sorted(kw)}")
+    return out
+
+
+def merge_trees(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    """Deep-merge ``override`` into ``base`` (override wins on leaves). Used to
+    overlay imported HF weights onto an initialized tree without dropping
+    params the checkpoint does not carry (LoRA adapters, fresh heads)."""
+    out = dict(base)
+    for k, v in override.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = merge_trees(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def merge_lora_params(params: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """Fold trained adapters into their kernels (``W += (alpha/r)·AB``) and
+    drop the lora leaves — for HF-format export of a LoRA-tuned model."""
+    import numpy as np
+
+    scale = cfg.lora_alpha / cfg.lora_r
+
+    def fold(tree):
+        if not isinstance(tree, dict):
+            return tree
+        if "lora_a" in tree and "kernel" in tree:
+            out = {k: v for k, v in tree.items() if k not in ("lora_a", "lora_b")}
+            out["kernel"] = tree["kernel"] + (
+                np.asarray(tree["lora_a"]) @ np.asarray(tree["lora_b"])
+            ) * scale
+            return out
+        return {k: fold(v) for k, v in tree.items()}
+
+    return fold(params)
+
+
+
+def _assemble_overrides(
+    model_config: ModelConfig,
+    parallel: Optional[ParallelConfig],
+    scan_layers_supported: bool = True,
+) -> Dict[str, Any]:
+    """Shared config-override assembly for both architectures: user extras,
+    peft translation, and parallel-derived dtypes/remat."""
+    overrides: Dict[str, Any] = dict(model_config.model_extra_kwargs or {})
+    if not scan_layers_supported:
+        overrides.pop("scan_layers", None)
+    if model_config.peft_kwargs:
+        overrides.update(parse_peft_overrides(model_config.peft_kwargs))
+    if parallel is not None:
+        overrides.setdefault("param_dtype", DTYPES[parallel.param_dtype])
+        overrides.setdefault("dtype", DTYPES[parallel.compute_dtype])
+        overrides.setdefault("remat", parallel.remat)
+        if scan_layers_supported:
+            overrides.setdefault("scan_layers", parallel.scan_layers)
+    return overrides
+
+
+def _import_hf_backbone(params, head, backbone_numpy, param_dtype):
+    """Overlay imported HF weights onto initialized params (deep merge keeps
+    LoRA adapters and fresh heads)."""
+    backbone = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x, param_dtype), backbone_numpy
+    )
+    if head is None:
+        return merge_trees(params, backbone)
+    params = dict(params)
+    params["backbone"] = merge_trees(params["backbone"], backbone)
+    return params
+
+
 def resolve_transformer_config(
     model_config: ModelConfig, parallel: Optional[ParallelConfig] = None
 ) -> Tuple[TransformerConfig, Optional[str]]:
@@ -31,12 +135,7 @@ def resolve_transformer_config(
     import dataclasses
 
     path = model_config.model_path
-    overrides: Dict[str, Any] = dict(model_config.model_extra_kwargs or {})
-    if parallel is not None:
-        overrides.setdefault("param_dtype", DTYPES[parallel.param_dtype])
-        overrides.setdefault("dtype", DTYPES[parallel.compute_dtype])
-        overrides.setdefault("remat", parallel.remat)
-        overrides.setdefault("scan_layers", parallel.scan_layers)
+    overrides = _assemble_overrides(model_config, parallel)
 
     if path.startswith("builtin:"):
         return config_from_spec(path, **overrides), None
@@ -84,14 +183,7 @@ def build_causal_lm(
         from trlx_tpu.models.hf_interop import load_pretrained
 
         hf_params, _ = load_pretrained(hf_path)
-        backbone = jax.tree_util.tree_map(
-            lambda x: jnp.asarray(x, tcfg.param_dtype), hf_params["backbone"]
-        )
-        if head is None:
-            params = backbone
-        else:
-            params = dict(params)
-            params["backbone"] = backbone
+        params = _import_hf_backbone(params, head, hf_params["backbone"], tcfg.param_dtype)
     return module, params, tcfg
 
 
@@ -114,40 +206,64 @@ def hydra_ref_params(params: Dict[str, Any], tcfg: TransformerConfig, num_layers
     return jax.tree_util.tree_map(lambda x: x, keep)  # shallow copy
 
 
+
+
+def _mark(tree, value: bool):
+    return jax.tree_util.tree_map(lambda _: value, tree)
+
+
+def _mark_lora(tree, layer_in_range: bool):
+    """True only on adapter leaves (``lora_*``) when the layer is in the
+    unfrozen range — the base always freezes under LoRA."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: layer_in_range
+        and str(getattr(path[-1], "key", "")).startswith("lora_"),
+        tree,
+    )
+
+
+def _mask_heads(subtree):
+    return {
+        name: _mark(tree, not name.startswith("target_q_head"))
+        for name, tree in subtree.items()
+    }
+
+
 def trainable_mask(
     params: Dict[str, Any], tcfg: TransformerConfig, num_layers_unfrozen: int
 ) -> Dict[str, Any]:
     """Bool pytree: True for trainable leaves. ``num_layers_unfrozen == -1``
     trains everything; otherwise only the top-k blocks, final norm, lm head,
     and any value/Q heads train (reference ``freeze_bottom_causal_layers``,
-    ``trlx/utils/modeling.py:34-44``). Target-Q heads never train."""
+    ``trlx/utils/modeling.py:34-44``). Target-Q heads never train.
 
-    def mark(tree, value: bool):
-        return jax.tree_util.tree_map(lambda _: value, tree)
+    With LoRA enabled (``tcfg.lora_r > 0``) the base model freezes entirely
+    and only adapter leaves in the unfrozen-layer range plus heads train
+    (reference: OpenDelta freezes the base and trains layer-ranged
+    modified_modules, ``trlx/utils/modeling.py:389-417``)."""
 
+    lora = getattr(tcfg, "lora_r", 0) > 0
     mask: Dict[str, Any] = {}
     for top_key, subtree in params.items():
         if top_key == "backbone":
             sub = {}
             for name, layer_tree in subtree.items():
-                if num_layers_unfrozen < 0:
-                    trainable = True
-                elif name.startswith("h_"):
-                    # only bottom blocks freeze; embeddings/norm/head stay
-                    # trainable (reference freeze_bottom_causal_layers,
-                    # trlx/utils/modeling.py:34-44)
-                    trainable = int(name[2:]) >= tcfg.num_layers - num_layers_unfrozen
+                if name.startswith("h_"):
+                    in_range = (
+                        num_layers_unfrozen < 0
+                        or int(name[2:]) >= tcfg.num_layers - num_layers_unfrozen
+                    )
                 else:
-                    trainable = True
-                sub[name] = mark(layer_tree, trainable)
+                    in_range = True
+                if lora:
+                    sub[name] = _mark_lora(layer_tree, in_range and name.startswith("h_"))
+                else:
+                    sub[name] = _mark(layer_tree, in_range)
             mask[top_key] = sub
         elif top_key == "ilql_heads":
-            mask[top_key] = {
-                name: mark(tree, not name.startswith("target_q_head"))
-                for name, tree in subtree.items()
-            }
+            mask[top_key] = _mask_heads(subtree)
         else:
-            mask[top_key] = mark(subtree, True)
+            mask[top_key] = _mark(subtree, True)
     return mask
 
 
@@ -167,12 +283,7 @@ def resolve_seq2seq_config(
     from trlx_tpu.models.seq2seq import Seq2SeqConfig
 
     path = model_config.model_path
-    overrides: Dict[str, Any] = dict(model_config.model_extra_kwargs or {})
-    overrides.pop("scan_layers", None)
-    if parallel is not None:
-        overrides.setdefault("param_dtype", DTYPES[parallel.param_dtype])
-        overrides.setdefault("dtype", DTYPES[parallel.compute_dtype])
-        overrides.setdefault("remat", parallel.remat)
+    overrides = _assemble_overrides(model_config, parallel, scan_layers_supported=False)
 
     if path.startswith("builtin:"):
         spec = path.split(":", 1)[1]
@@ -226,14 +337,7 @@ def build_seq2seq_lm(
         from trlx_tpu.models.hf_interop import load_pretrained_seq2seq
 
         hf_params, _ = load_pretrained_seq2seq(hf_path)
-        backbone = jax.tree_util.tree_map(
-            lambda x: jnp.asarray(x, scfg.param_dtype), hf_params["backbone"]
-        )
-        if head is None:
-            params = backbone
-        else:
-            params = dict(params)
-            params["backbone"] = backbone
+        params = _import_hf_backbone(params, head, hf_params["backbone"], scfg.param_dtype)
     return module, params, scfg
 
 
@@ -268,33 +372,35 @@ def seq2seq_trainable_mask(
     *except* the decoder blocks (``decoder.block[:-0] == []``), so the whole
     decoder trains — mirrored here for behavioral parity."""
 
-    def mark(tree, value: bool):
-        return jax.tree_util.tree_map(lambda _: value, tree)
-
     frozen_names = {"wte", "enc_ln_f", "dec_ln_f", "enc_rel_bias", "dec_rel_bias"}
+    lora = getattr(scfg, "lora_r", 0) > 0
     mask: Dict[str, Any] = {}
     for top_key, subtree in params.items():
         if top_key == "backbone":
             sub = {}
             for name, layer_tree in subtree.items():
+                is_dec_block = name.startswith("dec_") and name[4:].isdigit()
                 if num_layers_unfrozen < 0:
                     trainable = True
                 elif name.startswith("enc_") or name in frozen_names:
                     trainable = False
-                elif name.startswith("dec_") and name[4:].isdigit():
+                elif is_dec_block:
                     trainable = (
                         num_layers_unfrozen == 0  # reference: k=0 trains all decoder blocks
                         or int(name[4:]) >= scfg.num_decoder_layers - num_layers_unfrozen
                     )
                 else:
                     trainable = True  # lm_head
-                sub[name] = mark(layer_tree, trainable)
+                if lora:
+                    # adapters only, within the unfrozen decoder range
+                    # (reference hardcodes the decoder prefix for T5,
+                    # trlx/utils/modeling.py:400-402)
+                    sub[name] = _mark_lora(layer_tree, trainable and is_dec_block)
+                else:
+                    sub[name] = _mark(layer_tree, trainable)
             mask[top_key] = sub
         elif top_key == "ilql_heads":
-            mask[top_key] = {
-                name: mark(tree, not name.startswith("target_q_head"))
-                for name, tree in subtree.items()
-            }
+            mask[top_key] = _mask_heads(subtree)
         else:
-            mask[top_key] = mark(subtree, True)
+            mask[top_key] = _mark(subtree, True)
     return mask

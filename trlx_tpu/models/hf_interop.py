@@ -441,7 +441,11 @@ def seq2seq_config_from_hf(hf_config):
 
     if hf_config.model_type not in ("t5", "mt5"):
         raise ValueError(f"Unsupported HF model type for seq2seq import: {hf_config.model_type}")
-    act = hf_config.feed_forward_proj  # "relu" | "gated-gelu"
+    act = hf_config.feed_forward_proj
+    if act not in ("relu", "gated-gelu"):
+        raise ValueError(
+            f"Unsupported T5 feed_forward_proj '{act}' (supported: relu, gated-gelu)"
+        )
     return Seq2SeqConfig(
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.d_model,
@@ -455,7 +459,7 @@ def seq2seq_config_from_hf(hf_config):
             hf_config, "relative_attention_max_distance", 128
         ),
         layer_norm_epsilon=hf_config.layer_norm_epsilon,
-        activation="gated-gelu" if "gated" in act else "relu",
+        activation=act,
         tie_word_embeddings=bool(hf_config.tie_word_embeddings),
         decoder_start_token_id=hf_config.decoder_start_token_id or 0,
         pad_token_id=hf_config.pad_token_id or 0,

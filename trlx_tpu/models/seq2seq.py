@@ -60,6 +60,11 @@ class Seq2SeqConfig:
     dtype: Any = jnp.bfloat16
     remat: str = "none"
 
+    # LoRA (see TransformerConfig.lora_*); r=0 disables
+    lora_r: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ()
+
     # duck-type compatibility with TransformerConfig consumers (heads, ILQL)
     @property
     def kv_heads(self) -> int:
@@ -100,13 +105,21 @@ class Seq2SeqConfig:
         return Seq2SeqConfig(activation="gated-gelu", tie_word_embeddings=False, **dims)
 
 
-def _t5_dense(cfg, features, kernel_axes, name):
+def _t5_dense(cfg, features, kernel_axes, name, lora_ok=True):
+    kernel_init = param_with_axes(nn.initializers.normal(0.02), kernel_axes)
+    if lora_ok and cfg.lora_r and name in cfg.lora_targets:
+        from trlx_tpu.models.transformer import LoRADense
+
+        return LoRADense(
+            features, False, cfg.dtype, cfg.param_dtype, kernel_init,
+            nn.initializers.zeros, cfg.lora_r, cfg.lora_alpha, name=name,
+        )
     return nn.Dense(
         features,
         use_bias=False,  # T5 uses no biases anywhere
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
-        kernel_init=param_with_axes(nn.initializers.normal(0.02), kernel_axes),
+        kernel_init=kernel_init,
         name=name,
     )
 
@@ -180,14 +193,19 @@ class T5Attention(nn.Module):
     """Self- or cross-attention, T5 style (no 1/sqrt(d) scaling, no biases)."""
 
     config: Seq2SeqConfig
+    # encoder modules skip LoRA: the reference restricts adapters to decoder
+    # blocks for T5 (``trlx/utils/modeling.py:400-402``), so encoder adapters
+    # could never train and would be dead weight
+    lora_ok: bool = True
 
     def setup(self):
         cfg = self.config
         HD = cfg.num_heads * cfg.head_dim
-        self.q_proj = _t5_dense(cfg, HD, ("embed", "joined_kv"), "q_proj")
-        self.k_proj = _t5_dense(cfg, HD, ("embed", "joined_kv"), "k_proj")
-        self.v_proj = _t5_dense(cfg, HD, ("embed", "joined_kv"), "v_proj")
-        self.o_proj = _t5_dense(cfg, cfg.hidden_size, ("joined_kv", "embed"), "o_proj")
+        ok = self.lora_ok
+        self.q_proj = _t5_dense(cfg, HD, ("embed", "joined_kv"), "q_proj", ok)
+        self.k_proj = _t5_dense(cfg, HD, ("embed", "joined_kv"), "k_proj", ok)
+        self.v_proj = _t5_dense(cfg, HD, ("embed", "joined_kv"), "v_proj", ok)
+        self.o_proj = _t5_dense(cfg, cfg.hidden_size, ("joined_kv", "embed"), "o_proj", ok)
 
     def __call__(
         self,
@@ -243,17 +261,19 @@ class T5Attention(nn.Module):
 
 class T5MLP(nn.Module):
     config: Seq2SeqConfig
+    lora_ok: bool = True
 
     @nn.compact
     def __call__(self, x):
         cfg = self.config
+        ok = self.lora_ok
         if cfg.activation == "gated-gelu":
-            gate = _t5_dense(cfg, cfg.intermediate_size, ("embed", "ffn"), "gate_proj")(x)
-            up = _t5_dense(cfg, cfg.intermediate_size, ("embed", "ffn"), "up_proj")(x)
+            gate = _t5_dense(cfg, cfg.intermediate_size, ("embed", "ffn"), "gate_proj", ok)(x)
+            up = _t5_dense(cfg, cfg.intermediate_size, ("embed", "ffn"), "up_proj", ok)(x)
             h = nn.gelu(gate, approximate=True) * up
         else:
-            h = nn.relu(_t5_dense(cfg, cfg.intermediate_size, ("embed", "ffn"), "up_proj")(x))
-        return _t5_dense(cfg, cfg.hidden_size, ("ffn", "embed"), "down_proj")(h)
+            h = nn.relu(_t5_dense(cfg, cfg.intermediate_size, ("embed", "ffn"), "up_proj", ok)(x))
+        return _t5_dense(cfg, cfg.hidden_size, ("ffn", "embed"), "down_proj", ok)(h)
 
 
 class T5EncoderBlock(nn.Module):
@@ -262,9 +282,9 @@ class T5EncoderBlock(nn.Module):
     def setup(self):
         cfg = self.config
         self.ln_self = _t5_norm(cfg, "ln_self")
-        self.self_attn = T5Attention(cfg, name="self_attn")
+        self.self_attn = T5Attention(cfg, lora_ok=False, name="self_attn")
         self.ln_mlp = _t5_norm(cfg, "ln_mlp")
-        self.mlp = T5MLP(cfg, name="mlp")
+        self.mlp = T5MLP(cfg, lora_ok=False, name="mlp")
 
     def __call__(self, x, bias):
         h, _ = self.self_attn(self.ln_self(x), bias=bias)

@@ -102,6 +102,16 @@ class TransformerConfig:
     # elsewhere), "xla" (dot-product, XLA-fused), or "pallas" (force flash)
     attention_impl: str = "auto"
 
+    # LoRA (reference: OpenDelta lora via ``model.peft_kwargs``,
+    # ``trlx/utils/modeling.py:389-450``). r=0 disables. Adapters are created
+    # on every matching projection; the trainable mask keeps only the
+    # unfrozen-layer range learnable, which matches the reference's
+    # layer-ranged modified_modules regex with zero-init B making the rest
+    # exact no-ops.
+    lora_r: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ()
+
     def resolved_attention_impl(self) -> str:
         if self.attention_impl == "auto":
             return "pallas" if jax.default_backend() == "tpu" else "xla"
@@ -320,14 +330,60 @@ def Norm(config: TransformerConfig, name: str):
     )
 
 
+class LoRADense(nn.Module):
+    """Dense with an additive low-rank branch: ``y = xW (+b) + (alpha/r)·xAB``.
+
+    Parameters live at the same tree level as a plain Dense (``kernel``/
+    ``bias`` plus ``lora_a``/``lora_b``), so HF import and the path-based
+    sharding rules are unchanged. ``lora_b`` is zero-init: the module is an
+    exact no-op until trained."""
+
+    features: int
+    use_bias: bool
+    dtype: Any
+    param_dtype: Any
+    kernel_init: Callable
+    bias_init: Callable
+    r: int
+    alpha: float
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init, (in_features, self.features), self.param_dtype)
+        y = x @ kernel.astype(self.dtype)
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (self.features,), self.param_dtype)
+            y = y + bias.astype(self.dtype)
+        a = self.param("lora_a", nn.initializers.he_uniform(), (in_features, self.r), self.param_dtype)
+        b = self.param("lora_b", nn.initializers.zeros, (self.r, self.features), self.param_dtype)
+        scale = self.alpha / self.r
+        y = y + (x @ a.astype(self.dtype)) @ b.astype(self.dtype) * scale
+        return y
+
+
 def _dense(cfg, features, use_bias, kernel_axes, name=None):
+    kernel_init = param_with_axes(nn.initializers.normal(0.02), kernel_axes)
+    bias_init = param_with_axes(nn.initializers.zeros, (kernel_axes[-1],))
+    if getattr(cfg, "lora_r", 0) and name in getattr(cfg, "lora_targets", ()):
+        return LoRADense(
+            features,
+            use_bias,
+            cfg.dtype,
+            cfg.param_dtype,
+            kernel_init,
+            bias_init,
+            cfg.lora_r,
+            cfg.lora_alpha,
+            name=name,
+        )
     return nn.Dense(
         features,
         use_bias=use_bias,
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
-        kernel_init=param_with_axes(nn.initializers.normal(0.02), kernel_axes),
-        bias_init=param_with_axes(nn.initializers.zeros, (kernel_axes[-1],)),
+        kernel_init=kernel_init,
+        bias_init=bias_init,
         name=name,
     )
 

@@ -102,6 +102,20 @@ def sample_token_from_logits(
     return next_token, logprob
 
 
+_NON_CARRY_KEYS = ("cache", "logits", "branch_input", "pre_norm_hidden", "encoder_hidden")
+
+
+def last_step_info(out: Dict[str, Any]) -> Dict[str, Any]:
+    """Keep only last-position views of model outputs so the while_loop
+    carry has step-invariant shapes (prefill is [B,P,…], decode [B,1,…])."""
+    info = {}
+    for k, v in out.items():
+        if k in _NON_CARRY_KEYS or v is None:
+            continue
+        info[k] = jax.tree_util.tree_map(lambda x: x[:, -1], v)
+    return info
+
+
 class GenerationOutput(NamedTuple):
     sequences: jax.Array  # [B, P + N] prompt (left-padded) ‖ response
     response_tokens: jax.Array  # [B, N] pad-filled after eos
@@ -153,16 +167,6 @@ def generate(
     cache = prefill_out["cache"]
     last_logits = prefill_out["logits"][:, -1, :]  # [B, V]
     prompt_len = jnp.sum(attention_mask, axis=1).astype(jnp.int32)  # [B]
-
-    def _last_step_info(out: Dict[str, Any]) -> Dict[str, Any]:
-        """Keep only last-position views of model outputs so the while_loop
-        carry has step-invariant shapes (prefill is [B,P,…], decode [B,1,…])."""
-        info = {}
-        for k, v in out.items():
-            if k in ("cache", "logits", "branch_input", "pre_norm_hidden") or v is None:
-                continue
-            info[k] = jax.tree_util.tree_map(lambda x: x[:, -1], v)
-        return info
 
     class Carry(NamedTuple):
         tokens: jax.Array  # [B, N]
@@ -217,7 +221,7 @@ def generate(
             slot_mask=slot_mask,
             cache=out["cache"],
             logits=out["logits"][:, -1, :],
-            step_out=_last_step_info(out),
+            step_out=last_step_info(out),
             done=done,
             step=carry.step + 1,
             rng=rng,
@@ -240,7 +244,7 @@ def generate(
         slot_mask=slot_mask,
         cache=cache,
         logits=last_logits,
-        step_out=_last_step_info(prefill_out),
+        step_out=last_step_info(prefill_out),
         done=jnp.zeros((B,), bool),
         step=jnp.asarray(0, jnp.int32),
         rng=rng,
@@ -292,14 +296,6 @@ def generate_seq2seq(
         params, start, enc_hidden, attention_mask, cache, jnp.asarray(0, jnp.int32)
     )
 
-    def _last_step_info(out: Dict[str, Any]) -> Dict[str, Any]:
-        info = {}
-        for k, v in out.items():
-            if k in ("cache", "logits", "branch_input", "pre_norm_hidden", "encoder_hidden") or v is None:
-                continue
-            info[k] = jax.tree_util.tree_map(lambda x: x[:, -1], v)
-        return info
-
     class Carry(NamedTuple):
         tokens: jax.Array
         logprobs: jax.Array
@@ -341,7 +337,7 @@ def generate_seq2seq(
             mask=mask,
             cache=out["cache"],
             logits=out["logits"][:, -1, :],
-            step_out=_last_step_info(out),
+            step_out=last_step_info(out),
             done=done,
             step=carry.step + 1,
             rng=rng,
@@ -357,7 +353,7 @@ def generate_seq2seq(
         mask=jnp.zeros((B, N), jnp.int32),
         cache=out0["cache"],
         logits=out0["logits"][:, -1, :],
-        step_out=_last_step_info(out0),
+        step_out=last_step_info(out0),
         done=jnp.zeros((B,), bool),
         step=jnp.asarray(0, jnp.int32),
         rng=rng,
