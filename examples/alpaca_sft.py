@@ -1,0 +1,68 @@
+"""SFT on Alpaca-style instruction data (capability parity:
+``/root/reference/examples/alpaca/sft_alpaca.py``): dialog-masked
+cross-entropy on (instruction → response) pairs."""
+
+import os
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_sft_config
+
+_SYNTH = [
+    ("Give three tips for staying healthy.",
+     "Eat a balanced diet, exercise regularly, and get enough sleep."),
+    ("Describe the water cycle briefly.",
+     "Water evaporates, condenses into clouds, falls as precipitation, and collects again."),
+    ("Suggest a name for a bakery.",
+     "How about 'Rise and Shine Breads'?"),
+    ("Explain what a variable is in programming.",
+     "A variable is a named storage location that holds a value which can change."),
+]
+
+
+def load_alpaca(n: int = 512, seed: int = 0):
+    try:
+        from datasets import load_dataset
+
+        ds = load_dataset("tatsu-lab/alpaca", split="train").shuffle(seed=seed).select(range(n))
+        return [
+            (f"{ins} {inp}".strip(), out)
+            for ins, inp, out in zip(ds["instruction"], ds["input"], ds["output"])
+        ]
+    except Exception:
+        return [(q, a) for q, a in _SYNTH * (n // len(_SYNTH) + 1)][:n]
+
+
+def main(hparams=None):
+    model_path = os.environ.get("MODEL_PATH", "builtin:gpt2-small")
+    tokenizer_path = model_path if os.path.isdir(model_path) else "builtin:bytes"
+    data = load_alpaca(512)
+
+    config = default_sft_config().evolve(
+        train=dict(
+            seq_length=256, batch_size=16, total_steps=2000, eval_interval=200,
+            checkpoint_interval=2000, checkpoint_dir="ckpts/sft_alpaca",
+        ),
+        model=dict(model_path=model_path),
+        tokenizer=dict(tokenizer_path=tokenizer_path),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    prompt = "Below is an instruction. Write a response.\n### Instruction: {}\n### Response:"
+    return trlx.train(
+        samples=[[prompt.format(q), " " + a] for q, a in data],
+        eval_prompts=[prompt.format(q) for q, _ in data[:32]],
+        metric_fn=lambda samples, prompts, outputs, **kw: {
+            "length": [float(len(o.split())) for o in outputs]
+        },
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

@@ -1,0 +1,72 @@
+"""PPO for architectural layout generation (capability parity:
+``/root/reference/examples/architext.py`` — prompts describe a desired
+apartment, the model emits room layouts, reward checks the spec)."""
+
+import os
+import re
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ppo_config
+
+PROMPTS = [
+    "[prompt] the bedroom is adjacent to the living room [layout]",
+    "[prompt] a bedroom is adjacent to the kitchen [layout]",
+    "[prompt] the house has two bedrooms and one bathroom [layout]",
+    "[prompt] the kitchen is not adjacent to the bathroom [layout]",
+    "[prompt] the house has three bedrooms [layout]",
+]
+
+
+def spec_reward(prompt: str, layout: str) -> float:
+    """+1 when the named rooms appear (with requested counts), −1 otherwise."""
+    text = layout.lower()
+    score = 0.0
+    counts = {"two": 2, "three": 3, "one": 1}
+    for word, k in counts.items():
+        m = re.search(rf"{word} (bedroom|bathroom)", prompt)
+        if m:
+            room = m.group(1)
+            score += 1.0 if len(re.findall(room, text)) >= k else -1.0
+    for room in ("bedroom", "living room", "kitchen", "bathroom"):
+        if room in prompt and room in text:
+            score += 0.5
+    return score
+
+
+def main(hparams=None):
+    model_path = os.environ.get("MODEL_PATH", "builtin:gpt2-small")
+    tokenizer_path = model_path if os.path.isdir(model_path) else "builtin:bytes"
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            seq_length=160, batch_size=32, total_steps=4000, eval_interval=200,
+            checkpoint_interval=4000, checkpoint_dir="ckpts/architext",
+        ),
+        model=dict(model_path=model_path, num_layers_unfrozen=2),
+        tokenizer=dict(tokenizer_path=tokenizer_path),
+        method=dict(
+            num_rollouts=128, chunk_size=64,
+            gen_kwargs=dict(max_new_tokens=60, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        return [spec_reward(p, o) for p, o in zip(prompts, outputs)]
+
+    return trlx.train(
+        reward_fn=reward_fn,
+        prompts=PROMPTS * 20,
+        eval_prompts=PROMPTS * 4,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

@@ -1,0 +1,133 @@
+"""Shared helpers for the Anthropic-HH examples (capability parity:
+``/root/reference/examples/hh/``).
+
+- ``load_hh_pairs`` / ``load_hh_prompts``: the HH dataset when the hub is
+  reachable, else a templated dialogue corpus.
+- ``CONFIG_LADDER``: the reference's ``CONFIG_NAME`` size ladder
+  (``ppo_hh.py:69-105``: 125M → 20B), re-expressed as TPU mesh presets
+  instead of DeepSpeed stages.
+- ``reward_client``: scores samples against a reward server over HTTP —
+  the host-side equivalent of the reference's Triton-gRPC client
+  (``ppo_hh.py:118-138``); falls back to a lexical helpfulness heuristic.
+"""
+
+import json
+import os
+import urllib.request
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+_QUESTIONS = [
+    "How do I bake bread without an oven?",
+    "What is a good way to learn the piano as an adult?",
+    "Can you explain how tides work?",
+    "What should I pack for a week of winter hiking?",
+    "How do I politely decline a meeting invitation?",
+    "Why does my sourdough starter smell like acetone?",
+]
+_GOOD = [
+    "Here is a step by step approach you can follow. First, gather what you need, then take it slowly and check your progress as you go. If anything is unclear, I am happy to explain in more detail.",
+    "A practical option is to start small and build a routine. Consistent short sessions work better than rare long ones, and tracking progress helps you stay motivated.",
+]
+_BAD = [
+    "I don't know, figure it out yourself.",
+    "That's a silly question and not worth answering.",
+]
+
+HELPFUL_WORDS = (
+    "step approach follow gather check explain detail practical option start "
+    "routine consistent progress helps happy glad sure course recommend"
+).split()
+UNHELPFUL_WORDS = "don't know silly stupid won't refuse whatever useless".split()
+
+
+def load_hh_pairs(n: int = 256, seed: int = 0) -> List[Dict[str, str]]:
+    """[{prompt, chosen, rejected}] dialogue preference pairs."""
+    try:
+        from datasets import load_dataset
+
+        ds = load_dataset("Anthropic/hh-rlhf", split="train").shuffle(seed=seed).select(range(n))
+        out = []
+        for c, r in zip(ds["chosen"], ds["rejected"]):
+            ix = c.rfind("Assistant:")
+            out.append(
+                {"prompt": c[: ix + len("Assistant:")], "chosen": c[ix + len("Assistant:"):], "rejected": r[r.rfind("Assistant:") + len("Assistant:"):]}
+            )
+        return out
+    except Exception:
+        rng = np.random.RandomState(seed)
+        out = []
+        for _ in range(n):
+            q = _QUESTIONS[rng.randint(len(_QUESTIONS))]
+            out.append(
+                {
+                    "prompt": f"\n\nHuman: {q}\n\nAssistant:",
+                    "chosen": " " + _GOOD[rng.randint(len(_GOOD))],
+                    "rejected": " " + _BAD[rng.randint(len(_BAD))],
+                }
+            )
+        return out
+
+
+def load_hh_prompts(n: int = 128, seed: int = 0) -> List[str]:
+    return [p["prompt"] for p in load_hh_pairs(n, seed)]
+
+
+# The reference's CONFIG_NAME ladder (125M/1B/6B/20B,
+# ``examples/hh/ppo_hh.py:69-105``) selects batch sizes + DeepSpeed configs;
+# here it selects builtin model specs + mesh axes (fsdp scales, model axis
+# joins at 6B+, matching how TPU pods would host these sizes).
+CONFIG_LADDER: Dict[str, Dict] = {
+    "125M": dict(model="builtin:gptneox-160m", batch_size=32, seq_length=1024,
+                 num_layers_unfrozen=2, parallel=dict(data=-1, fsdp=1, model=1, sequence=1)),
+    "1B": dict(model="builtin:gptneox-1.4b", batch_size=8, seq_length=1024,
+               num_layers_unfrozen=2, parallel=dict(data=1, fsdp=-1, model=1, sequence=1)),
+    "6B": dict(model="builtin:gptj-6b", batch_size=4, seq_length=1024,
+               num_layers_unfrozen=2, parallel=dict(data=1, fsdp=-1, model=2, sequence=1)),
+    "20B": dict(model="builtin:gptneox-20b", batch_size=1, seq_length=1024,
+                num_layers_unfrozen=2, parallel=dict(data=1, fsdp=-1, model=4, sequence=1)),
+}
+
+
+def ladder_config(default: str = "125M") -> Dict:
+    return CONFIG_LADDER[os.environ.get("CONFIG_NAME", default)]
+
+
+def lexical_helpfulness(texts: List[str]) -> List[float]:
+    out = []
+    for t in texts:
+        words = t.lower().split()
+        if not words:
+            out.append(0.0)
+            continue
+        good = sum(w.strip(".,!?") in HELPFUL_WORDS for w in words)
+        bad = sum(w.strip(".,!?") in UNHELPFUL_WORDS for w in words)
+        out.append((good - 2 * bad) / max(len(words), 20))
+    return out
+
+
+def reward_client(samples: List[str]) -> List[float]:
+    """Score via the reward server at ``$REWARD_HOST`` (HTTP POST of JSON,
+    the host-side stand-in for the reference's Triton-gRPC scoring); lexical
+    fallback when unset/unreachable."""
+    host = os.environ.get("REWARD_HOST")
+    if host:
+        try:
+            req = urllib.request.Request(
+                f"http://{host}/score",
+                data=json.dumps({"samples": samples}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return list(json.loads(resp.read())["scores"])
+        except Exception as e:
+            # a mid-training scale switch poisons reward whitening — shout
+            import sys
+
+            print(
+                f"WARNING: reward server {host} unreachable ({e}); "
+                "falling back to the lexical heuristic — reward scale changed!",
+                file=sys.stderr,
+            )
+    return lexical_helpfulness(samples)

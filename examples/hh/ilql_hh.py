@@ -1,0 +1,54 @@
+"""Offline ILQL on Anthropic-HH preference pairs (capability parity:
+``/root/reference/examples/hh/ilql_hh.py``): chosen replies get reward 1,
+rejected ones 0 (the reference labels both sides the same way)."""
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ilql_config
+
+from hh_util import ladder_config, load_hh_pairs, load_hh_prompts, reward_client
+
+
+def main(hparams=None):
+    rung = ladder_config()
+    pairs = load_hh_pairs(512, seed=0)
+    samples = [[p["prompt"], p["chosen"]] for p in pairs] + [
+        [p["prompt"], p["rejected"]] for p in pairs
+    ]
+    rewards = [1.0] * len(pairs) + [0.0] * len(pairs)
+
+    config = default_ilql_config().evolve(
+        train=dict(
+            seq_length=rung["seq_length"],
+            batch_size=rung["batch_size"],
+            total_steps=3000,
+            eval_interval=500,
+            checkpoint_interval=3000,
+            checkpoint_dir="ckpts/ilql_hh",
+        ),
+        model=dict(model_path=rung["model"]),
+        tokenizer=dict(tokenizer_path="builtin:bytes"),
+        parallel=rung["parallel"],
+        method=dict(gen_kwargs=dict(max_new_tokens=128, top_k=20, beta=1.0, temperature=1.0)),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    def metric_fn(samples, prompts, outputs, **kwargs):
+        return {"reward": reward_client(samples)}
+
+    return trlx.train(
+        samples=samples,
+        rewards=rewards,
+        eval_prompts=load_hh_prompts(64, seed=1),
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

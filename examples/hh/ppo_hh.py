@@ -1,0 +1,59 @@
+"""PPO on Anthropic-HH dialogues (capability parity:
+``/root/reference/examples/hh/ppo_hh.py``): maximize a helpfulness reward
+over assistant replies. ``CONFIG_NAME`` ∈ {125M, 1B, 6B, 20B} picks the
+model-size ladder rung (reference ``:69-105``); ``REWARD_HOST`` points at a
+reward server (see ``serve_reward.py``), replacing the reference's
+``TRITON_HOST`` gRPC scoring (``:118-138``)."""
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ppo_config
+
+from hh_util import ladder_config, load_hh_prompts, reward_client
+
+
+def main(hparams=None):
+    rung = ladder_config()
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            seq_length=rung["seq_length"],
+            batch_size=rung["batch_size"],
+            total_steps=6000,
+            eval_interval=500,
+            checkpoint_interval=6000,
+            checkpoint_dir="ckpts/ppo_hh",
+        ),
+        model=dict(
+            model_path=rung["model"],
+            num_layers_unfrozen=rung["num_layers_unfrozen"],
+        ),
+        tokenizer=dict(tokenizer_path="builtin:bytes"),
+        parallel=rung["parallel"],
+        method=dict(
+            num_rollouts=64,
+            chunk_size=16,
+            gen_kwargs=dict(max_new_tokens=128, top_k=0, top_p=1.0, do_sample=True, temperature=1.0),
+        ),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        return reward_client(samples)
+
+    return trlx.train(
+        reward_fn=reward_fn,
+        prompts=load_hh_prompts(256, seed=0),
+        eval_prompts=load_hh_prompts(64, seed=1),
+        stop_sequences=["Human:", "human:", "Assistant:", "assistant:"],
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

@@ -1,0 +1,75 @@
+"""Host-side reward server for the HH examples.
+
+The reference serves its 6B reward model from a separate GPU behind Triton
+Inference Server over gRPC (``examples/hh/to_triton.py``,
+``triton_config.pbtxt``; client ``ppo_hh.py:118-138``). The TPU-native
+equivalent keeps the same decoupling — reward scoring runs in its own host
+process, possibly on a different host/chip than training — behind a minimal
+stdlib HTTP endpoint:
+
+    python serve_reward.py --checkpoint ckpts/reward_model --port 9000
+    REWARD_HOST=localhost:9000 python ppo_hh.py
+
+POST /score {"samples": [...]} → {"scores": [...]}. With no checkpoint the
+lexical heuristic serves (useful for wiring tests).
+"""
+
+import argparse
+import json
+import os
+import sys
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "summarize_rlhf")
+)
+
+from hh_util import lexical_helpfulness
+
+
+def build_scorer(checkpoint_dir):
+    if checkpoint_dir:
+        from ppo_summarize import load_reward_fn  # stage-2 pickle format
+
+        fn = load_reward_fn(checkpoint_dir)
+        if fn is not None:
+            return lambda samples: [float(x) for x in fn(samples)]
+    return lexical_helpfulness
+
+
+def make_handler(scorer):
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            if self.path != "/score":
+                self.send_response(404)
+                self.end_headers()
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length))
+            scores = scorer(payload["samples"])
+            body = json.dumps({"scores": list(map(float, scores))}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet
+            pass
+
+    return Handler
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--checkpoint", default=None, help="stage-2 reward_model.pkl dir")
+    ap.add_argument("--port", type=int, default=9000)
+    args = ap.parse_args()
+    server = HTTPServer(("0.0.0.0", args.port), make_handler(build_scorer(args.checkpoint)))
+    print(f"reward server on :{args.port}")
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

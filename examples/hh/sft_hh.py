@@ -1,0 +1,47 @@
+"""SFT on the chosen side of Anthropic-HH (capability parity:
+``/root/reference/examples/hh/sft_hh.py``)."""
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_sft_config
+
+from hh_util import ladder_config, load_hh_pairs, load_hh_prompts, reward_client
+
+
+def main(hparams=None):
+    rung = ladder_config()
+    pairs = load_hh_pairs(512, seed=0)
+
+    config = default_sft_config().evolve(
+        train=dict(
+            seq_length=rung["seq_length"],
+            batch_size=rung["batch_size"],
+            total_steps=3000,
+            eval_interval=500,
+            checkpoint_interval=3000,
+            checkpoint_dir="ckpts/sft_hh",
+        ),
+        model=dict(model_path=rung["model"]),
+        tokenizer=dict(tokenizer_path="builtin:bytes"),
+        parallel=rung["parallel"],
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    def metric_fn(samples, prompts, outputs, **kwargs):
+        return {"reward": reward_client(samples)}
+
+    return trlx.train(
+        samples=[[p["prompt"], p["chosen"]] for p in pairs],
+        eval_prompts=load_hh_prompts(64, seed=1),
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

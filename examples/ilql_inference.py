@@ -1,0 +1,43 @@
+"""Load a trained ILQL checkpoint and generate with advantage-reshaped
+sampling (capability parity: ``/root/reference/examples/nemo_ilql_inference.py``
+— the TP/PP-aware NeMo checkpoint loader + inference loop; here the mesh
+comes from the same ParallelConfig the training run used and the checkpoint
+is the trainer's saved state)."""
+
+import os
+import sys
+
+import numpy as np
+
+from trlx_tpu.data.default_configs import default_ilql_config
+from trlx_tpu.trainer import get_trainer
+import trlx_tpu.trainer.ilql  # noqa: F401 (registration)
+
+
+def main(checkpoint_dir: str, prompts=None, hparams=None):
+    config = default_ilql_config().evolve(
+        train=dict(checkpoint_dir=checkpoint_dir, tracker=None),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    trainer = get_trainer(config.train.trainer)(config=config, metric_fn=None)
+    trainer.load(checkpoint_dir)
+
+    prompts = prompts or ["I thought this movie was", "The acting in this film"]
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+
+    pipe = PromptPipeline(prompts, config.train.seq_length, trainer.tokenizer)
+    batch = next(iter(pipe.create_loader(len(prompts), shuffle=False)))
+    ids = np.asarray(batch["input_ids"])
+    out = trainer.generate(ids, np.asarray(batch["attention_mask"]), eval_mode=True)
+    _, _, outputs = trainer.decode(ids, np.asarray(out.response_tokens))
+    for p, o in zip(prompts, outputs):
+        print(f"{p!r} -> {o!r}")
+    return outputs
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "ckpts/ilql_sentiments")

@@ -1,0 +1,61 @@
+"""Large-model offline ILQL (capability parity:
+``/root/reference/examples/nemo_ilql_sentiments.py`` — the reference's
+NeMo-Megatron 20B path with TP=4 + sequence parallelism,
+``configs/nemo_configs/megatron_20b.yaml``).
+
+The TPU equivalent is the *same* trainer the small examples use: only the
+mesh changes — fsdp sharding for the 20B weights, a 4-way ``model`` (tensor
+parallel) axis, bf16 compute, full rematerialization. No second backend to
+maintain: GSPMD covers what Megatron TP/PP/SP covers in the reference
+(SURVEY.md §2.3)."""
+
+import os
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ilql_config
+
+from sentiment_util import get_positive_sentiment_fn, load_imdb_texts, review_prompts
+
+
+def main(hparams=None):
+    model_path = os.environ.get("MODEL_PATH", "builtin:gptneox-20b")
+    tokenizer_path = model_path if os.path.isdir(model_path) else "builtin:bytes"
+    sentiment = get_positive_sentiment_fn()
+    texts, _ = load_imdb_texts(512, seed=0)
+
+    config = default_ilql_config().evolve(
+        train=dict(
+            seq_length=1024,
+            batch_size=8,
+            total_steps=2000,
+            eval_interval=200,
+            checkpoint_interval=1000,
+            checkpoint_dir="ckpts/ilql_20b",
+        ),
+        model=dict(model_path=model_path),
+        tokenizer=dict(tokenizer_path=tokenizer_path),
+        parallel=dict(
+            data=1, fsdp=-1, model=4, sequence=1,
+            compute_dtype="bfloat16", remat="full",
+        ),
+        method=dict(gen_kwargs=dict(max_new_tokens=64, top_k=20, beta=2.0)),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    return trlx.train(
+        samples=texts,
+        rewards=sentiment(texts),
+        eval_prompts=review_prompts(64, seed=1),
+        metric_fn=lambda samples, prompts, outputs, **kw: {"sentiment": sentiment(samples)},
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

@@ -1,0 +1,76 @@
+"""Offline ILQL on Simulacra-style (prompt, caption, rating) data (capability
+parity: ``/root/reference/examples/simulacra.py`` — image-generation prompts
+rated 1-10 from the Simulacra Aesthetic Captions sqlite dump)."""
+
+import os
+
+import numpy as np
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ilql_config
+
+_SUBJECTS = ["a castle", "a forest", "a city skyline", "a sailboat", "a dragon", "a garden"]
+_STYLES = ["in watercolor", "as pixel art", "in oil paint", "at sunset", "under moonlight"]
+_GOOD_MODS = ["highly detailed", "masterful composition", "vivid colors"]
+_BAD_MODS = ["blurry", "low effort", "poorly cropped"]
+
+
+def load_simulacra(n: int = 512, seed: int = 0):
+    """(prompts, ratings). Reads $SIMULACRA_DB (sqlite, the reference's
+    format) when present, else synthesizes rated captions."""
+    db = os.environ.get("SIMULACRA_DB")
+    if db and os.path.exists(db):
+        import sqlite3
+
+        conn = sqlite3.connect(db)
+        rows = conn.execute(
+            "SELECT prompt, AVG(rating) FROM ratings "
+            "JOIN images ON images.id = ratings.iid "
+            "JOIN generations ON generations.id = images.gid "
+            "GROUP BY prompt LIMIT ?",
+            (n,),
+        ).fetchall()
+        return [r[0] for r in rows], [float(r[1]) for r in rows]
+    rng = np.random.RandomState(seed)
+    prompts, ratings = [], []
+    for _ in range(n):
+        good = rng.rand() < 0.5
+        mod = (_GOOD_MODS if good else _BAD_MODS)[rng.randint(3)]
+        prompts.append(
+            f"{_SUBJECTS[rng.randint(len(_SUBJECTS))]} {_STYLES[rng.randint(len(_STYLES))]}, {mod}"
+        )
+        ratings.append(float(rng.randint(7, 11) if good else rng.randint(1, 5)))
+    return prompts, ratings
+
+
+def main(hparams=None):
+    model_path = os.environ.get("MODEL_PATH", "builtin:gpt2-small")
+    tokenizer_path = model_path if os.path.isdir(model_path) else "builtin:bytes"
+    prompts, ratings = load_simulacra(512)
+
+    config = default_ilql_config().evolve(
+        train=dict(
+            seq_length=128, batch_size=16, total_steps=2000, eval_interval=200,
+            checkpoint_interval=2000, checkpoint_dir="ckpts/ilql_simulacra",
+        ),
+        model=dict(model_path=model_path),
+        tokenizer=dict(tokenizer_path=tokenizer_path),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    return trlx.train(
+        samples=prompts,
+        rewards=ratings,
+        eval_prompts=["a castle ", "a forest ", "a sailboat "] * 10,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

@@ -1,0 +1,88 @@
+"""PPO summarization with T5 on CNN/DailyMail (capability parity:
+``/root/reference/examples/summarize_daily_cnn/t5_summarize_daily_cnn.py`` —
+seq2seq PPO where the reward is ROUGE against the reference highlights)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "summarize_rlhf"))
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ppo_config
+
+from summarize_util import rouge_scores
+
+_FALLBACK_DOCS = [
+    (
+        "The city council voted on Tuesday to expand the park along the river, "
+        "adding new bike paths and a playground after months of public debate.",
+        "council approves river park expansion",
+    ),
+    (
+        "Researchers announced a battery design that charges in five minutes "
+        "while retaining most of its capacity over thousands of cycles.",
+        "new battery charges in five minutes",
+    ),
+    (
+        "A winter storm closed schools across the region on Monday, with more "
+        "snow expected through the week and officials urging caution on roads.",
+        "storm closes schools, more snow expected",
+    ),
+]
+
+
+def load_cnn(n: int = 256, seed: int = 0):
+    try:
+        from datasets import load_dataset
+
+        ds = load_dataset("cnn_dailymail", "3.0.0", split="train")
+        ds = ds.shuffle(seed=seed).select(range(n))
+        return [("summarize: " + a, h) for a, h in zip(ds["article"], ds["highlights"])]
+    except Exception:
+        docs = [( "summarize: " + d, s) for d, s in _FALLBACK_DOCS]
+        return (docs * (n // len(docs) + 1))[:n]
+
+
+def main(hparams=None):
+    model_path = os.environ.get("MODEL_PATH", "builtin:t5-small")
+    tokenizer_path = model_path if os.path.isdir(model_path) else "builtin:bytes"
+    data = load_cnn(256, seed=0)
+    eval_data = load_cnn(64, seed=1)
+    ref_by_prompt = dict(data)
+    ref_by_prompt.update(dict(eval_data))
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            seq_length=384, batch_size=8, total_steps=4000, eval_interval=200,
+            checkpoint_interval=4000, checkpoint_dir="ckpts/ppo_t5_cnn",
+        ),
+        model=dict(model_path=model_path, model_arch_type="seq2seq", num_layers_unfrozen=-1),
+        tokenizer=dict(tokenizer_path=tokenizer_path, padding_side="right"),
+        method=dict(
+            num_rollouts=64, chunk_size=8,
+            gen_kwargs=dict(max_new_tokens=60, top_k=0, top_p=0.95, do_sample=True),
+        ),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        return [
+            rouge_scores([o], [ref_by_prompt.get(p, "")])["rouge_avg"]
+            for p, o in zip(prompts, outputs)
+        ]
+
+    return trlx.train(
+        reward_fn=reward_fn,
+        prompts=[p for p, _ in data],
+        eval_prompts=[p for p, _ in eval_data],
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

@@ -1,0 +1,143 @@
+"""Shared helpers for the 3-stage TL;DR summarization RLHF pipeline
+(capability parity: ``/root/reference/examples/summarize_rlhf/``).
+
+The reference uses CarperAI's openai_summarize_tldr / openai_summarize_comparisons
+datasets and ROUGE from ``evaluate``. Offline fallbacks: a templated
+post/summary corpus with preference pairs, and a dependency-free ROUGE-1/2/L
+implementation (same definitions as the public metric).
+"""
+
+import os
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+_TOPICS = [
+    ("my cat keeps knocking things off the shelf", "cat knocks things off shelves"),
+    ("my neighbor plays loud music every night", "neighbor plays loud music nightly"),
+    ("i burned dinner twice this week while multitasking", "multitasking ruined dinner twice"),
+    ("our project deadline moved up by a month", "project deadline moved up a month"),
+    ("the gym near my house closed without notice", "local gym closed suddenly"),
+    ("my laptop battery dies within an hour now", "laptop battery barely lasts an hour"),
+]
+
+_FILLER = (
+    "So basically what happened was that over the last few weeks things kept "
+    "getting worse and I did not really know what to do about it. I talked to "
+    "a few friends and got conflicting advice, and now I am posting here to "
+    "get an outside perspective on the whole situation."
+)
+
+
+def load_tldr(n: int = 256, seed: int = 0) -> List[Dict[str, str]]:
+    """[{prompt, label}] — TL;DR posts with reference summaries.
+
+    Tries the CarperAI dataset via ``datasets`` (reference
+    ``train_sft.py``), else emits templated posts.
+    """
+    try:
+        from datasets import load_dataset
+
+        ds = load_dataset("CarperAI/openai_summarize_tldr", split="train")
+        ds = ds.shuffle(seed=seed).select(range(n))
+        return [{"prompt": p, "label": l} for p, l in zip(ds["prompt"], ds["label"])]
+    except Exception:
+        rng = np.random.RandomState(seed)
+        out = []
+        for _ in range(n):
+            topic, summary = _TOPICS[rng.randint(len(_TOPICS))]
+            post = f"SUBREDDIT: r/advice POST: {topic}. {_FILLER} TL;DR:"
+            out.append({"prompt": post, "label": " " + summary})
+        return out
+
+
+def load_comparisons(n: int = 256, seed: int = 0) -> List[Dict[str, str]]:
+    """[{prompt, chosen, rejected}] preference pairs for reward modeling."""
+    try:
+        from datasets import load_dataset
+
+        ds = load_dataset("CarperAI/openai_summarize_comparisons", split="train")
+        ds = ds.shuffle(seed=seed).select(range(n))
+        return [
+            {"prompt": p, "chosen": c, "rejected": r}
+            for p, c, r in zip(ds["prompt"], ds["chosen"], ds["rejected"])
+        ]
+    except Exception:
+        rng = np.random.RandomState(seed)
+        out = []
+        for _ in range(n):
+            topic, summary = _TOPICS[rng.randint(len(_TOPICS))]
+            # short form: byte-level tokenization must fit prompt+continuation
+            # inside small context windows or pairs truncate to identical
+            post = f"POST: {topic}. TL;DR:"
+            bad = " ".join(rng.permutation(_FILLER.split()[:8]))
+            out.append({"prompt": post, "chosen": " " + summary, "rejected": " " + bad})
+        return out
+
+
+# ---------------------------------------------------------------------------
+# dependency-free ROUGE (the reference pulls in `evaluate`; definitions match
+# the public ROUGE-1/2/L F-measures)
+# ---------------------------------------------------------------------------
+
+
+def _ngrams(tokens: List[str], n: int):
+    return [tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1)]
+
+
+def _f1(match: int, pred: int, ref: int) -> float:
+    if pred == 0 or ref == 0 or match == 0:
+        return 0.0
+    p, r = match / pred, match / ref
+    return 2 * p * r / (p + r)
+
+
+def _lcs_len(a: List[str], b: List[str]) -> int:
+    dp = [0] * (len(b) + 1)
+    for x in a:
+        prev = 0
+        for j, y in enumerate(b, 1):
+            cur = dp[j]
+            dp[j] = prev + 1 if x == y else max(dp[j], dp[j - 1])
+            prev = cur
+    return dp[-1]
+
+
+def rouge_scores(preds: List[str], refs: List[str]) -> Dict[str, float]:
+    """Mean ROUGE-1/2/L F1 + their average (the reference's reported set,
+    ``examples/summarize_rlhf/README.md:51-54``)."""
+    r1s, r2s, rls = [], [], []
+    for pred, ref in zip(preds, refs):
+        pt, rt = pred.lower().split(), ref.lower().split()
+        for n, acc in ((1, r1s), (2, r2s)):
+            pn, rn = _ngrams(pt, n), _ngrams(rt, n)
+            overlap = 0
+            counts: Dict[tuple, int] = {}
+            for g in rn:
+                counts[g] = counts.get(g, 0) + 1
+            for g in pn:
+                if counts.get(g, 0) > 0:
+                    counts[g] -= 1
+                    overlap += 1
+            acc.append(_f1(overlap, len(pn), len(rn)))
+        rls.append(_f1(_lcs_len(pt, rt), len(pt), len(rt)))
+    out = {
+        "rouge1": float(np.mean(r1s) if r1s else 0.0),
+        "rouge2": float(np.mean(r2s) if r2s else 0.0),
+        "rougeL": float(np.mean(rls) if rls else 0.0),
+    }
+    out["rouge_avg"] = (out["rouge1"] + out["rouge2"] + out["rougeL"]) / 3
+    return out
+
+
+def resolve_model(default_hub: str = "EleutherAI/gpt-j-6B") -> Tuple[str, str]:
+    path = os.environ.get("MODEL_PATH")
+    if path:
+        return path, path
+    try:
+        from transformers import AutoConfig
+
+        AutoConfig.from_pretrained(default_hub)
+        return default_hub, default_hub
+    except Exception:
+        return "builtin:gpt2-small", "builtin:bytes"

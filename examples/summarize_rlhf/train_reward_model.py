@@ -1,0 +1,128 @@
+"""Stage 2/3 of the TL;DR RLHF pipeline: train the pairwise reward model
+(capability parity:
+``/root/reference/examples/summarize_rlhf/reward_model/train_reward_model_gptj.py``
+over ``GPTRewardModel``). Saves params + config for stage 3's reward fn."""
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from trlx_tpu.data.configs import ModelConfig
+from trlx_tpu.data.tokenizer import from_config as tokenizer_from_config
+from trlx_tpu.data.configs import TokenizerConfig
+from trlx_tpu.models.reward import build_reward_model, reward_loss_fn
+from trlx_tpu.utils import logging
+
+from summarize_util import load_comparisons, resolve_model
+
+logger = logging.get_logger(__name__)
+
+
+def tokenize_pairs(comparisons, tokenizer, max_length: int):
+    """Preference pairs → fixed-shape chosen/rejected id+mask arrays."""
+    def encode(text):
+        ids = tokenizer.encode(text)[:max_length]
+        out = np.zeros(max_length, np.int32)
+        mask = np.zeros(max_length, np.int32)
+        out[: len(ids)] = ids
+        mask[: len(ids)] = 1
+        return out, mask
+
+    batch = {"chosen_ids": [], "rejected_ids": [], "chosen_mask": [], "rejected_mask": []}
+    identical = 0
+    for c in comparisons:
+        ci, cm = encode(c["prompt"] + c["chosen"])
+        ri, rm = encode(c["prompt"] + c["rejected"])
+        if np.array_equal(ci, ri):
+            identical += 1
+        batch["chosen_ids"].append(ci)
+        batch["rejected_ids"].append(ri)
+        batch["chosen_mask"].append(cm)
+        batch["rejected_mask"].append(rm)
+    if identical:
+        # right-truncation (parity with the reference's tokenizer settings)
+        # can cut off the continuations entirely; such pairs carry no signal
+        logger.warning(
+            f"{identical}/{len(comparisons)} pairs identical after truncation "
+            f"to {max_length} tokens — raise max_length"
+        )
+    return {k: np.stack(v) for k, v in batch.items()}
+
+
+def save_reward_checkpoint(directory, params, tcfg, tokenizer_path):
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, "reward_model.pkl"), "wb") as f:
+        pickle.dump(
+            {
+                "params": jax.device_get(params),
+                "config": tcfg.__dict__,
+                "tokenizer_path": tokenizer_path,
+            },
+            f,
+        )
+
+
+def main(hparams=None):
+    hparams = hparams or {}
+    model_path, tokenizer_path = resolve_model()
+    model_path = hparams.get("model_path", model_path)
+    tokenizer_path = hparams.get("tokenizer_path", tokenizer_path)
+    max_length = int(hparams.get("max_length", 256))
+    batch_size = int(hparams.get("batch_size", 8))
+    total_steps = int(hparams.get("total_steps", 500))
+    lr = float(hparams.get("lr", 1e-5))
+    out_dir = hparams.get("checkpoint_dir", "ckpts/reward_model")
+    extra = hparams.get("model_extra_kwargs")
+
+    tokenizer = tokenizer_from_config(TokenizerConfig(tokenizer_path=tokenizer_path))
+    module, params, tcfg = build_reward_model(
+        ModelConfig(model_path=model_path, model_extra_kwargs=extra)
+    )
+    if max_length > tcfg.max_position_embeddings:
+        logger.warning(
+            f"max_length {max_length} exceeds the model's position table "
+            f"({tcfg.max_position_embeddings}); clamping"
+        )
+        max_length = tcfg.max_position_embeddings
+    comparisons = tokenize_pairs(
+        load_comparisons(int(hparams.get("n_pairs", 256)), seed=0), tokenizer, max_length
+    )
+
+    opt = optax.adamw(lr)
+    opt_state = jax.jit(opt.init)(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        (loss, stats), grads = jax.value_and_grad(
+            lambda p: reward_loss_fn(module, p, batch), has_aux=True
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, stats
+
+    n = comparisons["chosen_ids"].shape[0]
+    rng = np.random.RandomState(0)
+    stats = {}
+    for it in range(total_steps):
+        ix = rng.randint(0, n, batch_size)
+        batch = {k: jnp.asarray(v[ix]) for k, v in comparisons.items()}
+        params, opt_state, loss, stats = step(params, opt_state, batch)
+        if it % 50 == 0:
+            logger.info(
+                f"step {it}: loss {float(loss):.4f} "
+                f"acc {float(stats['reward/accuracy']):.3f}"
+            )
+
+    save_reward_checkpoint(out_dir, params, tcfg, tokenizer_path)
+    logger.info(f"reward model saved to {out_dir}")
+    return {k: float(v) for k, v in stats.items()}
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

@@ -1,0 +1,51 @@
+"""Stage 1/3 of the TL;DR RLHF pipeline: supervised fine-tuning on
+post→summary pairs (capability parity:
+``/root/reference/examples/summarize_rlhf/sft/train_gptj_summarize.py``),
+reporting ROUGE on held-out prompts."""
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_sft_config
+
+from summarize_util import load_tldr, resolve_model, rouge_scores
+
+
+def main(hparams=None):
+    model_path, tokenizer_path = resolve_model()
+    data = load_tldr(512, seed=0)
+    eval_data = load_tldr(64, seed=1)
+    label_by_prompt = {d["prompt"]: d["label"] for d in eval_data}
+
+    config = default_sft_config().evolve(
+        train=dict(
+            seq_length=256,
+            batch_size=16,
+            total_steps=2000,
+            eval_interval=200,
+            checkpoint_interval=2000,
+            checkpoint_dir="ckpts/sft_summarize",
+        ),
+        model=dict(model_path=model_path),
+        tokenizer=dict(tokenizer_path=tokenizer_path),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    def metric_fn(samples, prompts, outputs, **kwargs):
+        refs = [label_by_prompt.get(p, "") for p in prompts]
+        return {k: [v] * len(outputs) for k, v in rouge_scores(outputs, refs).items()}
+
+    return trlx.train(
+        samples=[[d["prompt"], d["label"]] for d in data],
+        eval_prompts=[d["prompt"] for d in eval_data],
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

@@ -121,3 +121,201 @@ def test_ilql_sentiments_t5_smoke(tmp_path, monkeypatch):
         }
     )
     assert trainer is not None
+
+
+sys.path.insert(0, os.path.abspath(os.path.join(EXAMPLES, "summarize_rlhf")))
+sys.path.insert(0, os.path.abspath(os.path.join(EXAMPLES, "hh")))
+
+_TINY = {
+    "train.total_steps": 2,
+    "train.epochs": 1,
+    "train.eval_interval": 2,
+    "train.batch_size": 4,
+    "train.seq_length": 48,
+    "train.tracker": None,
+}
+
+
+def _tiny(tmp_path, **kw):
+    d = dict(_TINY)
+    d["train.checkpoint_dir"] = str(tmp_path / "ckpt")
+    d.update(kw)
+    return d
+
+
+def test_summarize_rlhf_three_stages(tmp_path, monkeypatch):
+    """The full pipeline end-to-end at toy scale: SFT → reward model →
+    PPO using the stage-2 checkpoint as the reward."""
+    monkeypatch.delenv("MODEL_PATH", raising=False)
+    import train_sft, train_reward_model, ppo_summarize
+
+    assert train_sft.main(_tiny(tmp_path, **{"model.model_path": "builtin:gpt2-test"})) is not None
+
+    rm_dir = str(tmp_path / "rm")
+    stats = train_reward_model.main(
+        dict(model_path="builtin:gpt2-test", tokenizer_path="builtin:bytes",
+             max_length=128, batch_size=4, total_steps=8, n_pairs=16,
+             checkpoint_dir=rm_dir)
+    )
+    # pairs must actually diverge (0.0 would mean truncation collapsed them)
+    assert np.isfinite(stats["reward/loss"]) and stats["reward/loss"] > 0.0
+    assert os.path.exists(os.path.join(rm_dir, "reward_model.pkl"))
+
+    trainer = ppo_summarize.main(
+        _tiny(
+            tmp_path,
+            reward_checkpoint_dir=rm_dir,
+            **{
+                "model.model_path": "builtin:gpt2-test",
+                "model.num_layers_unfrozen": 1,
+                "method.num_rollouts": 4,
+                "method.chunk_size": 4,
+                "method.ppo_epochs": 1,
+                "method.gen_kwargs.max_new_tokens": 5,
+            },
+        )
+    )
+    assert trainer is not None
+
+
+def test_hh_ppo_with_reward_server(tmp_path, monkeypatch):
+    """ppo_hh scoring through a live local reward server (the Triton-gRPC
+    equivalent), plus the lexical fallback when the server is absent."""
+    import threading
+    from http.server import HTTPServer
+
+    import serve_reward, ppo_hh
+    from hh_util import reward_client
+
+    server = HTTPServer(("127.0.0.1", 0), serve_reward.make_handler(serve_reward.build_scorer(None)))
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        monkeypatch.setenv("REWARD_HOST", f"127.0.0.1:{port}")
+        scores = reward_client(["Here is a step by step approach", "I don't know"])
+        assert scores[0] > scores[1]
+        monkeypatch.setenv("CONFIG_NAME", "125M")
+        trainer = ppo_hh.main(
+            _tiny(
+                tmp_path,
+                **{
+                    "model.model_path": "builtin:gpt2-test",
+                    "model.num_layers_unfrozen": 1,
+                    "parallel.data": -1,
+                    "method.num_rollouts": 4,
+                    "method.chunk_size": 4,
+                    "method.ppo_epochs": 1,
+                    "method.gen_kwargs.max_new_tokens": 5,
+                },
+            )
+        )
+        assert trainer is not None
+    finally:
+        server.shutdown()
+
+
+def test_hh_sft_and_ilql_smoke(tmp_path, monkeypatch):
+    monkeypatch.setenv("CONFIG_NAME", "125M")
+    monkeypatch.delenv("REWARD_HOST", raising=False)
+    import sft_hh, ilql_hh
+
+    assert sft_hh.main(
+        _tiny(tmp_path, **{"model.model_path": "builtin:gpt2-test", "parallel.data": -1})
+    ) is not None
+    assert ilql_hh.main(
+        _tiny(
+            tmp_path,
+            **{
+                "model.model_path": "builtin:gpt2-test",
+                "parallel.data": -1,
+                "method.gen_kwargs.max_new_tokens": 4,
+                "method.gen_kwargs.top_k": 2,
+            },
+        )
+    ) is not None
+
+
+def test_program_synthesis_interpreter():
+    from grounded_program_synthesis import interpret, reward_for, sample_task
+
+    assert interpret("sort(reverse(x))", [3, 1, 2]) == [1, 2, 3]
+    assert interpret("negate(take2(x))", [3, 1, 2]) == [-3, -1]
+    assert interpret("bogus(x)", [1]) is None
+    assert interpret("sort(x", [1]) is None
+    rng = np.random.RandomState(0)
+    task = sample_task(rng)
+    assert reward_for(task, task["gold"]) == 1.0
+    assert reward_for(task, "zzz") == -1.0
+
+
+def test_architext_reward():
+    from architext import spec_reward
+
+    good = spec_reward(
+        "[prompt] the house has two bedrooms and one bathroom [layout]",
+        "bedroom one, bedroom two, bathroom, kitchen",
+    )
+    bad = spec_reward(
+        "[prompt] the house has two bedrooms and one bathroom [layout]", "kitchen only"
+    )
+    assert good > bad
+
+
+def test_misc_example_smokes(tmp_path, monkeypatch):
+    monkeypatch.delenv("MODEL_PATH", raising=False)
+    import alpaca_sft, ilql_simulacra, grounded_program_synthesis
+
+    assert alpaca_sft.main(
+        _tiny(tmp_path, **{"model.model_path": "builtin:gpt2-test"})
+    ) is not None
+    assert ilql_simulacra.main(
+        _tiny(
+            tmp_path,
+            **{
+                "model.model_path": "builtin:gpt2-test",
+                "method.gen_kwargs.max_new_tokens": 4,
+                "method.gen_kwargs.top_k": 2,
+            },
+        )
+    ) is not None
+    assert grounded_program_synthesis.main(
+        _tiny(
+            tmp_path,
+            **{
+                "model.model_path": "builtin:gpt2-test",
+                "model.num_layers_unfrozen": 1,
+                "method.num_rollouts": 4,
+                "method.chunk_size": 4,
+                "method.ppo_epochs": 1,
+                "method.gen_kwargs.max_new_tokens": 5,
+            },
+        )
+    ) is not None
+
+
+def test_t5_cnn_smoke(tmp_path, monkeypatch):
+    monkeypatch.delenv("MODEL_PATH", raising=False)
+    import ppo_summarize_t5_cnn
+
+    assert ppo_summarize_t5_cnn.main(
+        _tiny(
+            tmp_path,
+            **{
+                "model.model_path": "builtin:t5-test",
+                "method.num_rollouts": 4,
+                "method.chunk_size": 4,
+                "method.ppo_epochs": 1,
+                "method.gen_kwargs.max_new_tokens": 5,
+            },
+        )
+    ) is not None
+
+
+def test_rouge_sanity():
+    from summarize_util import rouge_scores
+
+    perfect = rouge_scores(["the cat sat on the mat"], ["the cat sat on the mat"])
+    assert perfect["rouge1"] == 1.0 and perfect["rougeL"] == 1.0
+    nothing = rouge_scores(["dog"], ["the cat sat"])
+    assert nothing["rouge_avg"] == 0.0

@@ -1,0 +1,130 @@
+"""Reward model tests: the masked-vectorized pairwise loss must reproduce the
+reference's per-sample loop semantics
+(``examples/summarize_rlhf/reward_model/reward_model.py:59-95``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data.configs import ModelConfig
+from trlx_tpu.models.reward import (
+    build_reward_model,
+    end_scores,
+    pairwise_reward_loss,
+    reward_loss_fn,
+)
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def _loop_reference(c_rew, r_rew, c_ids, r_ids, c_mask, r_mask):
+    """Per-pair Python loop with the reference's slicing semantics."""
+    B, T = c_ids.shape
+    losses, accs = [], []
+    for i in range(B):
+        if np.array_equal(c_ids[i] * c_mask[i], r_ids[i] * r_mask[i]) and np.array_equal(
+            c_mask[i], r_mask[i]
+        ):
+            continue
+        c_len = int(c_mask[i].sum())
+        r_len = int(r_mask[i].sum())
+        end = max(c_len, r_len)
+        differs = (c_ids[i] != r_ids[i]) | (c_mask[i] != r_mask[i])
+        div = int(np.argmax(differs))
+        c_trunc = c_rew[i, div:end]
+        r_trunc = r_rew[i, div:end]
+        losses.append(-np.log(1.0 / (1.0 + np.exp(-(c_trunc - r_trunc)))).mean())
+        accs.append(float(c_rew[i, c_len - 1] > r_rew[i, r_len - 1]))
+    return np.mean(losses), np.mean(accs)
+
+
+def test_pairwise_loss_matches_loop_reference():
+    rs = np.random.RandomState(0)
+    B, T = 6, 12
+    c_ids = rs.randint(1, 50, (B, T))
+    r_ids = c_ids.copy()
+    c_mask = np.ones((B, T), np.int32)
+    r_mask = np.ones((B, T), np.int32)
+    for i in range(B):
+        div = rs.randint(2, 8)
+        r_ids[i, div:] = rs.randint(1, 50, T - div)
+        c_end = rs.randint(div + 1, T + 1)
+        r_end = rs.randint(div + 1, T + 1)
+        c_mask[i, c_end:] = 0
+        r_mask[i, r_end:] = 0
+        c_ids[i, c_end:] = 0
+        r_ids[i, r_end:] = 0
+    c_rew = rs.randn(B, T).astype(np.float32)
+    r_rew = rs.randn(B, T).astype(np.float32)
+
+    loss, stats = pairwise_reward_loss(
+        jnp.asarray(c_rew), jnp.asarray(r_rew),
+        jnp.asarray(c_ids), jnp.asarray(r_ids),
+        jnp.asarray(c_mask), jnp.asarray(r_mask),
+    )
+    ref_loss, ref_acc = _loop_reference(c_rew, r_rew, c_ids, r_ids, c_mask, r_mask)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+    np.testing.assert_allclose(float(stats["reward/accuracy"]), ref_acc, rtol=1e-6)
+
+
+def test_identical_pairs_contribute_nothing():
+    rs = np.random.RandomState(1)
+    ids = rs.randint(1, 50, (2, 8))
+    mask = np.ones((2, 8), np.int32)
+    rew = rs.randn(2, 8).astype(np.float32)
+    loss, _ = pairwise_reward_loss(
+        jnp.asarray(rew), jnp.asarray(rew + 1.0),
+        jnp.asarray(ids), jnp.asarray(ids),
+        jnp.asarray(mask), jnp.asarray(mask),
+    )
+    assert float(loss) == 0.0
+
+
+def test_end_scores():
+    rew = jnp.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    mask = jnp.asarray([[1, 1, 0], [1, 1, 1]])
+    np.testing.assert_array_equal(np.asarray(end_scores(rew, mask)), [2.0, 6.0])
+
+
+def test_reward_model_trains():
+    """A few steps on a separable synthetic preference set must improve
+    accuracy above chance."""
+    import optax
+
+    module, params, tcfg = build_reward_model(
+        ModelConfig(
+            model_path="builtin:gpt2-test",
+            model_extra_kwargs=dict(dtype=jnp.float32),
+        )
+    )
+    rs = np.random.RandomState(2)
+    B, T = 8, 10
+    # chosen sequences end in token 7, rejected in token 3 — learnable signal
+    prompts = rs.randint(10, 40, (B, 6))
+    chosen = np.concatenate([prompts, np.full((B, 4), 7)], axis=1)
+    rejected = np.concatenate([prompts, np.full((B, 4), 3)], axis=1)
+    mask = np.ones((B, T), np.int32)
+    batch = {
+        "chosen_ids": jnp.asarray(chosen),
+        "rejected_ids": jnp.asarray(rejected),
+        "chosen_mask": jnp.asarray(mask),
+        "rejected_mask": jnp.asarray(mask),
+    }
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        (loss, stats), grads = jax.value_and_grad(
+            lambda p: reward_loss_fn(module, p, batch), has_aux=True
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, stats
+
+    first_loss = None
+    for i in range(30):
+        params, opt_state, loss, stats = step(params, opt_state)
+        if first_loss is None:
+            first_loss = float(loss)
+    assert float(loss) < first_loss
+    assert float(stats["reward/accuracy"]) == 1.0

@@ -77,6 +77,8 @@ def merge_lora_params(params: Dict[str, Any], cfg) -> Dict[str, Any]:
     drop the lora leaves — for HF-format export of a LoRA-tuned model."""
     import numpy as np
 
+    if not getattr(cfg, "lora_r", 0):
+        return params  # nothing to fold
     scale = cfg.lora_alpha / cfg.lora_r
 
     def fold(tree):

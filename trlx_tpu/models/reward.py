@@ -1,0 +1,138 @@
+"""Reward model: LM backbone + scalar head, pairwise preference training.
+
+Reference: ``GPTRewardModel``
+(``examples/summarize_rlhf/reward_model/reward_model.py:6-104``) — a causal
+LM whose scalar head scores every position; training compares chosen vs
+rejected continuations of the same prompt with ``-log σ(r_c − r_r)`` averaged
+over the positions from the first diverging token to the longer sequence's
+end, and inference reads the score at the last non-pad token.
+
+TPU redesign: the reference loops over the batch in Python (dynamic
+``nonzero`` slicing per pair). Here divergence/end indices become masks over
+the fixed ``[B, T]`` block (argmax of the mismatch indicator, masked means),
+so the whole loss is one fused jitted program — no host control flow, static
+shapes, MXU-friendly.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.transformer import (
+    CausalTransformer,
+    TransformerConfig,
+    param_with_axes,
+)
+
+
+class RewardModel(nn.Module):
+    """Causal LM + per-position scalar reward head (bias-free, f32)."""
+
+    config: TransformerConfig
+
+    def setup(self):
+        self.backbone = CausalTransformer(self.config, name="backbone")
+        self.r_head = nn.Dense(
+            1,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=self.config.param_dtype,
+            kernel_init=param_with_axes(nn.initializers.normal(0.02), ("embed", "head_out")),
+            name="r_head",
+        )
+
+    def __call__(
+        self, input_ids: jax.Array, attention_mask: Optional[jax.Array] = None
+    ) -> Dict[str, Any]:
+        out = self.backbone(input_ids, attention_mask=attention_mask)
+        rewards = self.r_head(out["hidden_states"].astype(jnp.float32))[..., 0]
+        return {"rewards": rewards, "hidden_states": out["hidden_states"]}
+
+
+def end_scores(rewards: jax.Array, attention_mask: jax.Array) -> jax.Array:
+    """Reward at each sequence's last non-pad position ([B, T] → [B])."""
+    lengths = jnp.maximum(jnp.sum(attention_mask, axis=1).astype(jnp.int32), 1)
+    return jnp.take_along_axis(rewards, (lengths - 1)[:, None], axis=1)[:, 0]
+
+
+def pairwise_reward_loss(
+    chosen_rewards: jax.Array,  # [B, T]
+    rejected_rewards: jax.Array,  # [B, T]
+    chosen_ids: jax.Array,  # [B, T] right-padded
+    rejected_ids: jax.Array,  # [B, T]
+    chosen_mask: jax.Array,  # [B, T]
+    rejected_mask: jax.Array,  # [B, T]
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Masked-vectorized preference loss (semantics of the reference's
+    per-pair loop): mean over positions in ``[divergence, end)`` of
+    ``-log σ(r_chosen − r_rejected)``, where divergence is the first token
+    where the pair differs and end covers the longer of the two sequences."""
+    T = chosen_ids.shape[1]
+    positions = jnp.arange(T)[None, :]
+
+    differs = (chosen_ids != rejected_ids) | (chosen_mask != rejected_mask)
+    any_diff = jnp.any(differs, axis=1)
+    div_ix = jnp.argmax(differs, axis=1)  # first True (0 if none)
+    c_len = jnp.sum(chosen_mask, axis=1).astype(jnp.int32)
+    r_len = jnp.sum(rejected_mask, axis=1).astype(jnp.int32)
+    end_ix = jnp.maximum(c_len, r_len)
+
+    span = (positions >= div_ix[:, None]) & (positions < end_ix[:, None])
+    span = span & any_diff[:, None]  # identical pairs contribute nothing
+    n = jnp.maximum(jnp.sum(span, axis=1), 1)
+
+    delta = chosen_rewards - rejected_rewards
+    per_pos = -jax.nn.log_sigmoid(delta) * span
+    per_pair = jnp.sum(per_pos, axis=1) / n
+    n_pairs = jnp.maximum(jnp.sum(any_diff), 1)
+    loss = jnp.sum(per_pair * any_diff) / n_pairs
+
+    c_end = end_scores(chosen_rewards, chosen_mask)
+    r_end = end_scores(rejected_rewards, rejected_mask)
+    acc = jnp.sum((c_end > r_end) * any_diff) / n_pairs
+    stats = {
+        "reward/loss": loss,
+        "reward/accuracy": acc,
+        "reward/chosen_end_mean": jnp.mean(c_end),
+        "reward/rejected_end_mean": jnp.mean(r_end),
+        "reward/margin": jnp.mean((c_end - r_end) * any_diff),
+    }
+    return loss, stats
+
+
+def reward_loss_fn(
+    module: RewardModel,
+    params: Any,
+    batch: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One fused forward over the stacked chosen‖rejected batch + loss
+    (the reference concatenates the halves the same way)."""
+    ids = jnp.concatenate([batch["chosen_ids"], batch["rejected_ids"]], axis=0)
+    mask = jnp.concatenate([batch["chosen_mask"], batch["rejected_mask"]], axis=0)
+    rewards = module.apply({"params": params}, ids, attention_mask=mask)["rewards"]
+    B = batch["chosen_ids"].shape[0]
+    return pairwise_reward_loss(
+        rewards[:B], rewards[B:],
+        batch["chosen_ids"], batch["rejected_ids"],
+        batch["chosen_mask"], batch["rejected_mask"],
+    )
+
+
+def build_reward_model(model_config, parallel=None, seed: int = 0):
+    """ModelConfig → (module, params, tcfg), HF backbone import included."""
+    from trlx_tpu.models.builder import (
+        _import_hf_backbone,
+        resolve_transformer_config,
+    )
+
+    tcfg, hf_path = resolve_transformer_config(model_config, parallel)
+    module = RewardModel(tcfg)
+    params = module.init(jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))["params"]
+    if hf_path is not None:
+        from trlx_tpu.models.hf_interop import load_pretrained
+
+        hf_params, _ = load_pretrained(hf_path)
+        params = _import_hf_backbone(params, "reward", hf_params["backbone"], tcfg.param_dtype)
+    return module, params, tcfg
