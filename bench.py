@@ -8,9 +8,28 @@ tokens, 40 new tokens per rollout (the reference ppo_sentiments gen_kwargs,
 fwd + hydra-ref fwd + KL) and run the 4×1 optimization steps — the same
 work AcceleratePPOTrainer does per epoch (SURVEY.md §3.2-3.3).
 
-Baseline: single-A100 trlx ppo_sentiments ≈ 40 samples/s (estimate from the
-reference's W&B `trlx-references` runs: ~1k rollouts+updates in ~25 min);
-``vs_baseline`` = samples_per_sec / 40.0 (target ≥3.0 per BASELINE.json).
+Baseline denominator (``A100_BASELINE_SAMPLES_PER_SEC = 40``): the reference
+publishes no throughput numbers (SURVEY.md §6), so this is a derived
+estimate, stated openly.  Derivation: the reference ppo_sentiments config
+(``trlx/data/default_configs.py:15-57``) runs 10k optimization steps of
+batch 128 with ``num_rollouts=128``/``ppo_epochs=4`` — i.e. one 128-rollout
+collection (128×40-token KV-cached decodes + scoring fwd + hydra-ref fwd)
+per 4 updates.  An A100 runs gpt2-small (124M) batched decode at roughly
+25-35ms/step at batch 128 in fp16 HF ``generate`` (memory-bound decode:
+~0.25GB weights × 2 reads per token-step against ~1.5TB/s effective HBM,
+plus attention/softmax and per-step host sync overhead), giving ~1.0-1.4s
+per 40-token rollout chunk, ~0.4s for the two scoring forwards, and ~0.4s
+for 4 updates — ≈2s per 128-sample cycle ⇒ ~55-65 samples/s upper bound,
+degraded in practice by HF generate's per-step Python/host overhead and the
+reference's host-side re-tokenization between decode and scoring
+(``accelerate_ppo_trainer.py:329-348``) to ~40 samples/s.  ``vs_baseline`` =
+samples_per_sec / 40.0 (target ≥3.0 per BASELINE.json).
+
+Robustness: the TPU backend can be transiently unavailable (single-tenant
+chip contended by a concurrent driver check — this killed BENCH_r01).  Init
+is retried with backoff; if the accelerator never comes up, the bench falls
+back to forced-CPU with a reduced work size so it still emits a parsable
+JSON line (tagged ``[cpu-fallback]`` in the metric name).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -25,8 +44,49 @@ import numpy as np
 A100_BASELINE_SAMPLES_PER_SEC = 40.0
 
 
+def _init_devices(retries=4, delay=15.0):
+    """``jax.devices()`` with fail-soft retry, then forced-CPU fallback.
+
+    Returns ``(devices, fallback_exc)`` — ``fallback_exc`` is None unless we
+    gave up on the accelerator and dropped to CPU.
+    """
+    import jax
+
+    last_err = None
+    for i in range(retries):
+        try:
+            return jax.devices(), None
+        except Exception as e:  # backend init failure (e.g. contended chip)
+            last_err = e
+            print(f"bench: backend init failed (try {i + 1}/{retries}): {e}", file=sys.stderr)
+            try:
+                import jax.extend.backend
+
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
+            time.sleep(delay * (i + 1))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    try:
+        import jax.extend.backend
+
+        jax.extend.backend.clear_backends()
+    except Exception:
+        pass
+    return jax.devices(), last_err
+
+
 def main():
     import jax
+
+    devices, fallback_err = _init_devices()
+    on_cpu = devices[0].platform == "cpu"
+    if fallback_err is not None:
+        print(f"bench: accelerator unavailable, CPU fallback: {fallback_err}", file=sys.stderr)
 
     from trlx_tpu.data.default_configs import default_ppo_config
     from trlx_tpu.pipeline import get_pipeline
@@ -34,8 +94,10 @@ def main():
     import trlx_tpu.trainer.ppo  # noqa: F401
     import trlx_tpu.pipeline.offline_pipeline  # noqa: F401
 
-    n_dev = jax.device_count()
-    chunk = int(os.environ.get("BENCH_CHUNK", 128))
+    n_dev = len(devices)
+    # CPU fallback: shrink the timed unit so the bench finishes under the
+    # driver timeout; the resulting number is tagged, not comparable.
+    chunk = int(os.environ.get("BENCH_CHUNK", 16 if on_cpu else 128))
     # byte-level prompts, 64 tokens each; bucketing keeps one compiled shape
     prompt_tokens = 64
     max_new = 40
@@ -91,7 +153,7 @@ def main():
         return stats
 
     one_cycle()  # warmup: compiles decode, score, train programs
-    n_cycles = int(os.environ.get("BENCH_CYCLES", 3))
+    n_cycles = int(os.environ.get("BENCH_CYCLES", 1 if on_cpu else 3))
     t0 = time.time()
     for _ in range(n_cycles):
         stats = one_cycle()
@@ -99,10 +161,11 @@ def main():
 
     samples_per_sec = n_cycles * chunk / dt
     per_chip = samples_per_sec / max(n_dev, 1)
+    tag = " [cpu-fallback]" if on_cpu else ""
     print(
         json.dumps(
             {
-                "metric": "ppo_sentiments-shaped e2e throughput (gpt2-small, 64+40 tok)",
+                "metric": "ppo_sentiments-shaped e2e throughput (gpt2-small, 64+40 tok)" + tag,
                 "value": round(samples_per_sec, 3),
                 "unit": "samples/sec",
                 "vs_baseline": round(samples_per_sec / A100_BASELINE_SAMPLES_PER_SEC, 3),
