@@ -1,0 +1,83 @@
+"""PPO sentiment steering on LLaMA (capability parity:
+``/root/reference/examples/ppo_sentiments_llama.py`` — LLaMA-7B fine-tuned
+with PPO on IMDB review prompts, sentiment-classifier reward, hydra frozen
+reference branch).
+
+Model resolves in order: ``$MODEL_PATH`` (a local HF LLaMA checkpoint), else
+the offline ``builtin:llama-7b`` preset (random init, byte tokenizer —
+identical wiring, lower reward fidelity). The GQA path
+(``num_kv_heads < num_heads``) and rotary/rmsnorm/silu stack are exercised
+either way.
+"""
+
+import os
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ppo_config
+
+from sentiment_util import get_positive_sentiment_fn, review_prompts
+
+
+def resolve_model():
+    path = os.environ.get("MODEL_PATH")
+    if path:
+        return path, path
+    return "builtin:llama-7b", "builtin:bytes"
+
+
+def llama_config(model_path, tokenizer_path):
+    return default_ppo_config().evolve(
+        train=dict(
+            seq_length=1024,
+            batch_size=32,
+            total_steps=10000,
+            eval_interval=100,
+            checkpoint_interval=10000,
+            save_best=False,
+            checkpoint_dir="ckpts/ppo_sentiments_llama",
+        ),
+        # hydra branch over the top 2 layers, as in the reference config
+        model=dict(model_path=model_path, num_layers_unfrozen=2),
+        tokenizer=dict(tokenizer_path=tokenizer_path, truncation_side="right"),
+        optimizer=dict(
+            name="adamw", kwargs=dict(lr=1e-5, betas=(0.9, 0.95), eps=1e-8, weight_decay=1e-6)
+        ),
+        scheduler=dict(name="cosine_annealing", kwargs=dict(T_max=10000, eta_min=1e-5, lr=1e-5)),
+        # bf16 compute + fsdp sharding: a 7B model spreads over the chips
+        parallel=dict(data=1, fsdp=-1, model=1, compute_dtype="bfloat16", remat="minimal"),
+        method=dict(
+            num_rollouts=128,
+            chunk_size=128,
+            ppo_epochs=4,
+            init_kl_coef=0.05,
+            gen_kwargs=dict(max_new_tokens=40, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+
+
+def main(hparams=None):
+    model_path, tokenizer_path = resolve_model()
+    config = llama_config(model_path, tokenizer_path)
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    sentiment = get_positive_sentiment_fn()
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        return sentiment(outputs)
+
+    return trlx.train(
+        reward_fn=reward_fn,
+        prompts=review_prompts(256),
+        eval_prompts=review_prompts(64),
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

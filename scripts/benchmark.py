@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""CLI for the benchmark suite + comparator (``trlx_tpu.benchmark``) —
+the ``scripts/benchmark.sh`` + ``trlx/reference.py`` equivalent.
+
+    python scripts/benchmark.py run --output-dir benchmarks/main --scale ci
+    python scripts/benchmark.py report benchmarks/main benchmarks/branch
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from trlx_tpu.benchmark import main
+
+if __name__ == "__main__":
+    sys.exit(main())

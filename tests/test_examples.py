@@ -319,3 +319,32 @@ def test_rouge_sanity():
     assert perfect["rouge1"] == 1.0 and perfect["rougeL"] == 1.0
     nothing = rouge_scores(["dog"], ["the cat sat"])
     assert nothing["rouge_avg"] == 0.0
+
+
+def test_ppo_sentiments_llama_gqa_smoke(tmp_path):
+    """VERDICT #9: the llama example end-to-end on the GQA test preset
+    (num_kv_heads=2 < num_heads=4 — grouped-query decode, rotary/rmsnorm/silu
+    stack, hydra branch over rmsnorm layers)."""
+    import ppo_sentiments_llama
+
+    trainer = ppo_sentiments_llama.main(
+        {
+            "model.model_path": "builtin:llama-test",
+            "train.seq_length": 32,
+            "train.total_steps": 2,
+            "train.epochs": 1,
+            "train.eval_interval": 2,
+            "train.batch_size": 8,
+            "train.eval_batch_size": 8,
+            "train.checkpoint_dir": str(tmp_path / "ckpt"),
+            "model.num_layers_unfrozen": 1,
+            "parallel.data": -1,
+            "parallel.fsdp": 2,
+            "method.num_rollouts": 8,
+            "method.chunk_size": 8,
+            "method.ppo_epochs": 1,
+            "method.gen_kwargs.max_new_tokens": 8,
+        }
+    )
+    assert trainer.iter_count >= 1
+    assert trainer.tcfg.kv_heads < trainer.tcfg.num_heads  # really GQA

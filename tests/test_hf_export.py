@@ -1,0 +1,126 @@
+"""HF-format export round-trip: torch → trlx_tpu → exported directory →
+``transformers.from_pretrained`` → identical logits; heads merged under the
+reference's ``v_head.`` / ``ilql_heads.`` prefixes
+(``trlx/models/modeling_ppo.py:306-328``, ``modeling_ilql.py:322-344``,
+``accelerate_base_trainer.py:256-272``).
+"""
+
+import numpy as np
+import pytest
+
+from trlx_tpu.data.configs import ModelConfig
+from trlx_tpu.models import hf_interop
+from trlx_tpu.models.builder import build_causal_lm
+
+from tests.test_models import _tiny_hf
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama", "gpt_neox", "gptj", "opt", "bloom"])
+def test_roundtrip_exact_logits(family, tmp_path):
+    """import tiny torch model → export → reload in transformers → exact parity."""
+    import torch
+    import transformers
+
+    hf, params, cfg = _tiny_hf(family)
+    out_dir = str(tmp_path / family)
+    hf_interop.save_pretrained_hf(out_dir, params, cfg)
+
+    reloaded = transformers.AutoModelForCausalLM.from_pretrained(out_dir)
+    reloaded.eval()
+    ids = torch.tensor(np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 12)))
+    with torch.no_grad():
+        ref = hf(ids).logits.numpy()
+        got = reloaded(ids).logits.numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_head_prefix_merge(tmp_path):
+    import torch
+
+    module, params, tcfg = build_causal_lm(ModelConfig("builtin:gpt2-test"), head="value")
+    sd = hf_interop.params_to_hf_state_dict(params, tcfg)
+    assert "v_head.0.weight" in sd and "v_head.2.weight" in sd
+    # torch Linear layout: [out, in]
+    assert sd["v_head.0.weight"].shape == (2 * tcfg.hidden_size, tcfg.hidden_size)
+    assert sd["v_head.2.weight"].shape == (1, 2 * tcfg.hidden_size)
+
+    module, params, tcfg = build_causal_lm(ModelConfig("builtin:gpt2-test"), head="ilql")
+    sd = hf_interop.params_to_hf_state_dict(params, tcfg)
+    for key in (
+        "ilql_heads.heads.v_head.0.weight",
+        "ilql_heads.heads.q_heads.0.2.weight",
+        "ilql_heads.heads.q_heads.1.0.bias",
+        "ilql_heads.heads.target_q_heads.0.0.weight",
+    ):
+        assert key in sd, key
+
+    out_dir = str(tmp_path / "ilql")
+    hf_interop.save_pretrained_hf(out_dir, params, tcfg)
+    bin_sd = torch.load(out_dir + "/pytorch_model.bin", weights_only=True)
+    assert "ilql_heads.heads.q_heads.0.0.weight" in bin_sd
+
+
+def test_scan_layout_exports_identically():
+    from trlx_tpu.models.transformer import stack_layer_params
+
+    _, params, cfg = _tiny_hf("gpt2")
+    sd_flat = hf_interop.params_to_hf_state_dict(params, cfg)
+    scan_cfg = cfg.__class__(**{**cfg.__dict__, "scan_layers": True})
+    stacked = {"backbone": stack_layer_params(params["backbone"], cfg.num_layers)}
+    sd_scan = hf_interop.params_to_hf_state_dict(stacked, scan_cfg)
+    assert sd_flat.keys() == sd_scan.keys()
+    for k in sd_flat:
+        np.testing.assert_array_equal(np.asarray(sd_flat[k]), np.asarray(sd_scan[k]), err_msg=k)
+
+
+def test_lora_merged_on_export():
+    """Trained adapters fold into kernels at export (W += (alpha/r)·AB)."""
+    module, params, tcfg = build_causal_lm(
+        ModelConfig(
+            "builtin:gpt2-test",
+            peft_kwargs={"peft_type": "lora", "r": 4, "lora_alpha": 8, "modified_modules": "attention"},
+        ),
+        head="value",
+    )
+    # make the adapter non-trivial so the merge is observable
+    import jax.numpy as jnp
+
+    a = params["backbone"]["h_0"]["attn"]["q_proj"]["lora_a"]
+    b = jnp.ones_like(params["backbone"]["h_0"]["attn"]["q_proj"]["lora_b"]) * 0.01
+    params["backbone"]["h_0"]["attn"]["q_proj"]["lora_b"] = b
+    sd = hf_interop.params_to_hf_state_dict(params, tcfg)
+    base = np.asarray(params["backbone"]["h_0"]["attn"]["q_proj"]["kernel"])
+    merged = np.asarray(sd["transformer.h.0.attn.c_attn.weight"])[:, : tcfg.hidden_size]
+    expected = base + (np.asarray(a) @ np.asarray(b)) * (tcfg.lora_alpha / tcfg.lora_r)
+    np.testing.assert_allclose(merged, expected, atol=1e-6)
+    assert not any("lora" in k for k in sd)
+
+
+def test_trainer_save_pretrained_writes_hf(tmp_path):
+    """TPUBaseTrainer.save_pretrained emits a transformers-loadable dir."""
+    import transformers
+
+    from trlx_tpu.data.default_configs import default_sft_config
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.sft  # noqa: F401
+
+    cfg = default_sft_config().evolve(
+        train=dict(
+            seq_length=32,
+            batch_size=8,
+            total_steps=1,
+            eval_interval=100,
+            checkpoint_interval=100,
+            epochs=1,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+            tracker=None,
+        ),
+        model=dict(model_path="builtin:gpt2-test"),
+    )
+    trainer = get_trainer(cfg.train.trainer)(
+        config=cfg, reward_fn=None, metric_fn=None, stop_sequences=[]
+    )
+    out = str(tmp_path / "hf_out")
+    trainer.save_pretrained(out)
+    model = transformers.AutoModelForCausalLM.from_pretrained(out)
+    assert model.config.vocab_size == trainer.tcfg.vocab_size

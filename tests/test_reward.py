@@ -128,3 +128,28 @@ def test_reward_model_trains():
             first_loss = float(loss)
     assert float(loss) < first_loss
     assert float(stats["reward/accuracy"]) == 1.0
+
+
+def test_reward_model_hf_import_scan_layers(tmp_path):
+    """HF weights must land in the stacked h_scan layout, not as ignored h_i
+    keys beside a random backbone (regression: build_reward_model previously
+    skipped the stacking conversion build_causal_lm does)."""
+    import torch
+    import transformers as tf
+
+    from trlx_tpu.models.reward import build_reward_model
+
+    torch.manual_seed(0)
+    hf = tf.GPT2LMHeadModel(
+        tf.GPT2Config(vocab_size=97, n_positions=64, n_embd=32, n_layer=2, n_head=4)
+    )
+    hf.save_pretrained(tmp_path / "hf")
+
+    module, params, tcfg = build_reward_model(
+        ModelConfig(str(tmp_path / "hf"), model_extra_kwargs={"scan_layers": True})
+    )
+    assert tcfg.scan_layers and "h_scan" in params["backbone"]
+    assert "h_0" not in params["backbone"]
+    got = np.asarray(params["backbone"]["h_scan"]["block"]["attn"]["o_proj"]["kernel"][0])
+    want = hf.state_dict()["transformer.h.0.attn.c_proj.weight"].numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)

@@ -119,3 +119,98 @@ def test_model_forward_with_sequence_mesh_matches_unsharded():
         np.asarray(lp, np.float32)[valid], np.asarray(lx, np.float32)[valid],
         atol=5e-4, rtol=5e-4,
     )
+
+
+@pytest.mark.parametrize("placement", ["contiguous", "zigzag"])
+def test_ring_placements_match_oracle(placement):
+    """Both chunk placements are numerically the same exact attention."""
+    q, k, v, mask = _mk(T=32, left_pad=4, seed=7)
+    mesh = _mesh(4)
+    out = jax.jit(
+        lambda q, k, v: ring_flash_attention(
+            q, k, v, mask, mesh, placement=placement,
+            block_q=8, block_k=8, interpret=True,
+        )
+    )(q, k, v)
+    ref, _ = attention_reference(q, k, v, mask, causal=True)
+    valid = np.asarray(mask) > 0
+    np.testing.assert_allclose(
+        np.asarray(out)[valid], np.asarray(ref)[valid], atol=3e-5, rtol=3e-5
+    )
+
+
+def test_ring_alibi_matches_oracle():
+    """ALiBi rides the ring as true token positions (VERDICT #10: no more
+    silent fallback for alibi models under sequence parallelism)."""
+    from trlx_tpu.models.transformer import alibi_slopes
+
+    q, k, v, mask = _mk(T=32, left_pad=5, seed=11)
+    mesh = _mesh(4)
+    H = q.shape[2]
+    slopes = jnp.asarray(alibi_slopes(H), jnp.float32)
+    positions = jnp.maximum(jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1, 0)
+
+    out = jax.jit(
+        lambda q, k, v: ring_flash_attention(
+            q, k, v, mask, mesh,
+            q_positions=positions, k_positions=positions, alibi_slopes=slopes,
+            block_q=8, block_k=8, interpret=True,
+        )
+    )(q, k, v)
+    ref, _ = attention_reference(
+        q, k, v, mask, causal=True,
+        q_positions=positions, k_positions=positions, alibi_slopes=slopes,
+    )
+    valid = np.asarray(mask) > 0
+    np.testing.assert_allclose(
+        np.asarray(out)[valid], np.asarray(ref)[valid], atol=3e-5, rtol=3e-5
+    )
+
+
+def test_ring_alibi_gradients_match_full():
+    from trlx_tpu.models.transformer import alibi_slopes
+
+    q, k, v, mask = _mk(T=32, left_pad=0, seed=13)
+    mesh = _mesh(4)
+    H = q.shape[2]
+    slopes = jnp.asarray(alibi_slopes(H), jnp.float32)
+    positions = jnp.maximum(jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1, 0)
+
+    def loss_ring(q, k, v):
+        out = ring_flash_attention(
+            q, k, v, mask, mesh,
+            q_positions=positions, k_positions=positions, alibi_slopes=slopes,
+            block_q=8, block_k=8, interpret=True,
+        )
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_ref(q, k, v):
+        out, _ = attention_reference(
+            q, k, v, mask, causal=True,
+            q_positions=positions, k_positions=positions, alibi_slopes=slopes,
+        )
+        return jnp.sum(out.astype(jnp.float32) * jnp.cos(out.astype(jnp.float32)))
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf), atol=5e-4, rtol=5e-4)
+
+
+def test_zigzag_schedule_is_balanced():
+    """The imbalance benchmark (VERDICT #10): contiguous placement wastes the
+    causal saving (wall ≈ 2× useful work/device); zigzag recovers it."""
+    from trlx_tpu.parallel.ring_attention import ring_schedule_work, zigzag_order
+
+    for n in (4, 8):
+        _, wall_contig, work = ring_schedule_work(n, "contiguous")
+        _, wall_zig, work_z = ring_schedule_work(n, "zigzag")
+        assert abs(work - work_z) < 1e-9  # same useful FLOPs either way
+        ideal = work / n
+        assert wall_contig / ideal > 1.7  # contiguous: ~2× the ideal wall
+        assert wall_zig / ideal < 1.3  # zigzag: near-balanced
+        assert wall_zig < 0.7 * wall_contig
+
+    # the permutation really is an involution partition of [0, T)
+    order = zigzag_order(32, 4)
+    assert sorted(order.tolist()) == list(range(32))

@@ -194,6 +194,13 @@ class TrainConfig:
     # Number of eval prompts generated/scored per evaluate() call; None = all.
     eval_batch_size: Optional[int] = None
 
+    # Gradient accumulation: microbatches per optimizer step. batch_size must
+    # be divisible; grads are averaged over the ``lax.scan`` of microbatch
+    # passes inside the one jitted step, so global batch is no longer capped
+    # by per-device memory (reference gets this from DeepSpeed / NeMo's
+    # micro-vs-global batch, ``megatron_20b.yaml:51-52``).
+    grad_accum: int = 1
+
     from_dict = classmethod(_strict_from_dict)
 
 

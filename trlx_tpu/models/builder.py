@@ -185,7 +185,12 @@ def build_causal_lm(
         from trlx_tpu.models.hf_interop import load_pretrained
 
         hf_params, _ = load_pretrained(hf_path)
-        params = _import_hf_backbone(params, head, hf_params["backbone"], tcfg.param_dtype)
+        backbone = hf_params["backbone"]
+        if tcfg.scan_layers:
+            from trlx_tpu.models.transformer import stack_layer_params
+
+            backbone = stack_layer_params(backbone, tcfg.num_layers)
+        params = _import_hf_backbone(params, head, backbone, tcfg.param_dtype)
     return module, params, tcfg
 
 
@@ -197,8 +202,15 @@ def hydra_ref_params(params: Dict[str, Any], tcfg: TransformerConfig, num_layers
     backbone = params["backbone"] if "backbone" in params else params
     keep = {}
     start = tcfg.num_layers - num_layers_unfrozen
-    for i in range(start, tcfg.num_layers):
-        keep[f"h_{i}"] = backbone[f"h_{i}"]
+    if tcfg.scan_layers:
+        keep["h_scan"] = {
+            "block": jax.tree_util.tree_map(
+                lambda p: p[start:], backbone["h_scan"]["block"]
+            )
+        }
+    else:
+        for i in range(start, tcfg.num_layers):
+            keep[f"h_{i}"] = backbone[f"h_{i}"]
     if tcfg.final_norm:
         keep["ln_f"] = backbone["ln_f"]
     if tcfg.tie_word_embeddings:
@@ -231,6 +243,39 @@ def _mask_heads(subtree):
     }
 
 
+def _scan_layer_vector(tcfg, num_layers_unfrozen: int):
+    """Per-layer 0/1 trainability over the stacked layer dim, or None when
+    every layer trains (``num_layers_unfrozen == -1``)."""
+    import numpy as np
+
+    if num_layers_unfrozen < 0:
+        return None
+    vec = np.zeros(tcfg.num_layers, np.float32)
+    if num_layers_unfrozen > 0:
+        vec[tcfg.num_layers - num_layers_unfrozen :] = 1.0
+    return vec
+
+
+def _mask_scan_blocks(layer_tree, tcfg, num_layers_unfrozen: int, lora: bool):
+    """Mask leaves for the stacked ``h_scan`` subtree: bools where uniform,
+    a per-layer 0/1 vector where only some layers train (consumed by
+    ``get_optimizer``'s layer-wise freeze)."""
+    vec = _scan_layer_vector(tcfg, num_layers_unfrozen)
+    if lora:
+
+        def leaf_mask(path, _):
+            if not str(getattr(path[-1], "key", "")).startswith("lora_"):
+                return False
+            return True if vec is None else vec
+
+        return jax.tree_util.tree_map_with_path(leaf_mask, layer_tree)
+    if vec is None or vec.all():
+        return _mark(layer_tree, True)
+    if not vec.any():
+        return _mark(layer_tree, False)
+    return jax.tree_util.tree_map(lambda _: vec, layer_tree)
+
+
 def trainable_mask(
     params: Dict[str, Any], tcfg: TransformerConfig, num_layers_unfrozen: int
 ) -> Dict[str, Any]:
@@ -250,6 +295,11 @@ def trainable_mask(
         if top_key == "backbone":
             sub = {}
             for name, layer_tree in subtree.items():
+                if name == "h_scan":
+                    sub[name] = _mask_scan_blocks(
+                        layer_tree, tcfg, num_layers_unfrozen, lora
+                    )
+                    continue
                 if name.startswith("h_"):
                     in_range = (
                         num_layers_unfrozen < 0

@@ -11,7 +11,7 @@ Torch ``nn.Linear`` weights are [out, in] and transpose to Flax's [in, out];
 GPT-2's Conv1D is already [in, out].
 """
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -247,6 +247,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
     mt = hf_config.model_type
     if mt == "gpt2":
         return TransformerConfig(
+            model_type=mt,
             vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.n_embd,
             num_layers=hf_config.n_layer,
@@ -259,6 +260,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
         )
     if mt == "llama":
         return TransformerConfig(
+            model_type=mt,
             vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,
             num_layers=hf_config.num_hidden_layers,
@@ -278,6 +280,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
     if mt == "gpt_neox":
         head_dim = hf_config.hidden_size // hf_config.num_attention_heads
         return TransformerConfig(
+            model_type=mt,
             vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,
             num_layers=hf_config.num_hidden_layers,
@@ -295,6 +298,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
         )
     if mt == "gptj":
         return TransformerConfig(
+            model_type=mt,
             vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.n_embd,
             num_layers=hf_config.n_layer,
@@ -315,6 +319,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
         )
     if mt == "opt":
         return TransformerConfig(
+            model_type=mt,
             vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,
             num_layers=hf_config.num_hidden_layers,
@@ -328,6 +333,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
         )
     if mt == "bloom":
         return TransformerConfig(
+            model_type=mt,
             vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,
             num_layers=hf_config.n_layer,
@@ -479,3 +485,366 @@ def load_pretrained_seq2seq(path: str):
     hf_config = AutoConfig.from_pretrained(path)
     model = AutoModelForSeq2SeqLM.from_pretrained(path)
     return seq2seq_params_from_hf(model, seq2seq_config_from_hf(hf_config))
+
+
+# ---------------------------------------------------------------------------
+# Export: trlx_tpu param tree → HF (torch) checkpoint directory.
+#
+# Inverse of the import converters above, including the reference's head
+# merging semantics: value/ILQL head weights are folded into the state dict
+# under ``v_head.`` / ``ilql_heads.`` prefixes with the reference's own
+# torch module names (``trlx/models/modeling_ppo.py:306-328``,
+# ``modeling_ilql.py:322-344``), so a checkpoint exported here loads both in
+# plain ``transformers`` (heads ignored) and in reference trlx (heads
+# re-split).
+# ---------------------------------------------------------------------------
+
+
+def _fuse_headmajor_qkv(attn: Dict[str, Any], num_heads: int, head_dim: int):
+    """Inverse of :func:`_split_headmajor_qkv`: q/k/v kernels [E, H*D] →
+    fused [3*H*D, E] torch weight with head-major interleave (+ fused bias)."""
+    E = attn["q_proj"]["kernel"].shape[0]
+    ws = []
+    for name in ("q_proj", "k_proj", "v_proj"):
+        ws.append(_t(np.asarray(attn[name]["kernel"])).reshape(num_heads, head_dim, E))
+    w = np.stack(ws, axis=1).reshape(num_heads * 3 * head_dim, E)
+    b = None
+    if "bias" in attn["q_proj"]:
+        bs = [
+            np.asarray(attn[name]["bias"]).reshape(num_heads, head_dim)
+            for name in ("q_proj", "k_proj", "v_proj")
+        ]
+        b = np.stack(bs, axis=1).reshape(-1)
+    return w, b
+
+
+def _put_ln(sd: Dict[str, np.ndarray], prefix: str, ln: Dict[str, Any]) -> None:
+    sd[f"{prefix}.weight"] = np.asarray(ln["scale"])
+    if "bias" in ln:
+        sd[f"{prefix}.bias"] = np.asarray(ln["bias"])
+
+
+def _put_linear(sd, prefix, proj, transpose=True) -> None:
+    kernel = np.asarray(proj["kernel"])
+    sd[f"{prefix}.weight"] = _t(kernel) if transpose else kernel
+    if "bias" in proj:
+        sd[f"{prefix}.bias"] = np.asarray(proj["bias"])
+
+
+def export_gpt2(backbone: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
+    p = "transformer."
+    sd: Dict[str, np.ndarray] = {
+        p + "wte.weight": np.asarray(backbone["wte"]["embedding"]),
+        p + "wpe.weight": np.asarray(backbone["wpe"]["embedding"]),
+    }
+    _put_ln(sd, p + "ln_f", backbone["ln_f"])
+    for i in range(cfg.num_layers):
+        lp = f"{p}h.{i}."
+        h = backbone[f"h_{i}"]
+        _put_ln(sd, lp + "ln_1", h["ln_attn"])
+        _put_ln(sd, lp + "ln_2", h["ln_mlp"])
+        attn = h["attn"]
+        # Conv1D layout [in, out]: our kernels go in untransposed
+        sd[lp + "attn.c_attn.weight"] = np.concatenate(
+            [np.asarray(attn[k]["kernel"]) for k in ("q_proj", "k_proj", "v_proj")], axis=1
+        )
+        sd[lp + "attn.c_attn.bias"] = np.concatenate(
+            [np.asarray(attn[k]["bias"]) for k in ("q_proj", "k_proj", "v_proj")]
+        )
+        _put_linear(sd, lp + "attn.c_proj", attn["o_proj"], transpose=False)
+        _put_linear(sd, lp + "mlp.c_fc", h["mlp"]["up_proj"], transpose=False)
+        _put_linear(sd, lp + "mlp.c_proj", h["mlp"]["down_proj"], transpose=False)
+    sd["lm_head.weight"] = sd[p + "wte.weight"]  # tied
+    return sd
+
+
+def export_llama(backbone: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
+    p = "model."
+    sd: Dict[str, np.ndarray] = {
+        p + "embed_tokens.weight": np.asarray(backbone["wte"]["embedding"]),
+        p + "norm.weight": np.asarray(backbone["ln_f"]["scale"]),
+    }
+    if cfg.tie_word_embeddings:
+        sd["lm_head.weight"] = sd[p + "embed_tokens.weight"]
+    else:
+        sd["lm_head.weight"] = _t(np.asarray(backbone["lm_head"]["kernel"]))
+    for i in range(cfg.num_layers):
+        lp = f"{p}layers.{i}."
+        h = backbone[f"h_{i}"]
+        sd[lp + "input_layernorm.weight"] = np.asarray(h["ln_attn"]["scale"])
+        sd[lp + "post_attention_layernorm.weight"] = np.asarray(h["ln_mlp"]["scale"])
+        for ours, theirs in (
+            ("q_proj", "self_attn.q_proj"),
+            ("k_proj", "self_attn.k_proj"),
+            ("v_proj", "self_attn.v_proj"),
+            ("o_proj", "self_attn.o_proj"),
+        ):
+            _put_linear(sd, lp + theirs, h["attn"][ours])
+        for ours, theirs in (
+            ("gate_proj", "mlp.gate_proj"),
+            ("up_proj", "mlp.up_proj"),
+            ("down_proj", "mlp.down_proj"),
+        ):
+            _put_linear(sd, lp + theirs, h["mlp"][ours])
+    return sd
+
+
+def export_gptneox(backbone: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
+    p = "gpt_neox."
+    sd: Dict[str, np.ndarray] = {
+        p + "embed_in.weight": np.asarray(backbone["wte"]["embedding"]),
+        "embed_out.weight": _t(np.asarray(backbone["lm_head"]["kernel"])),
+    }
+    _put_ln(sd, p + "final_layer_norm", backbone["ln_f"])
+    for i in range(cfg.num_layers):
+        lp = f"{p}layers.{i}."
+        h = backbone[f"h_{i}"]
+        _put_ln(sd, lp + "input_layernorm", h["ln_attn"])
+        _put_ln(sd, lp + "post_attention_layernorm", h["ln_mlp"])
+        w, b = _fuse_headmajor_qkv(h["attn"], cfg.num_heads, cfg.dims_per_head)
+        sd[lp + "attention.query_key_value.weight"] = w
+        if b is not None:
+            sd[lp + "attention.query_key_value.bias"] = b
+        _put_linear(sd, lp + "attention.dense", h["attn"]["o_proj"])
+        _put_linear(sd, lp + "mlp.dense_h_to_4h", h["mlp"]["up_proj"])
+        _put_linear(sd, lp + "mlp.dense_4h_to_h", h["mlp"]["down_proj"])
+    return sd
+
+
+def export_gptj(backbone: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
+    p = "transformer."
+    sd: Dict[str, np.ndarray] = {
+        p + "wte.weight": np.asarray(backbone["wte"]["embedding"]),
+        "lm_head.weight": _t(np.asarray(backbone["lm_head"]["kernel"])),
+        "lm_head.bias": np.asarray(backbone["lm_head"]["bias"]),
+    }
+    _put_ln(sd, p + "ln_f", backbone["ln_f"])
+    for i in range(cfg.num_layers):
+        lp = f"{p}h.{i}."
+        h = backbone[f"h_{i}"]
+        _put_ln(sd, lp + "ln_1", h["ln_attn"])
+        for ours, theirs in (
+            ("q_proj", "attn.q_proj"),
+            ("k_proj", "attn.k_proj"),
+            ("v_proj", "attn.v_proj"),
+            ("o_proj", "attn.out_proj"),
+        ):
+            _put_linear(sd, lp + theirs, h["attn"][ours])
+        _put_linear(sd, lp + "mlp.fc_in", h["mlp"]["up_proj"])
+        _put_linear(sd, lp + "mlp.fc_out", h["mlp"]["down_proj"])
+    return sd
+
+
+def export_opt(backbone: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
+    p = "model.decoder."
+    sd: Dict[str, np.ndarray] = {
+        p + "embed_tokens.weight": np.asarray(backbone["wte"]["embedding"]),
+        p + "embed_positions.weight": np.asarray(backbone["wpe"]["embedding"]),
+        "lm_head.weight": np.asarray(backbone["wte"]["embedding"]),  # tied
+    }
+    _put_ln(sd, p + "final_layer_norm", backbone["ln_f"])
+    for i in range(cfg.num_layers):
+        lp = f"{p}layers.{i}."
+        h = backbone[f"h_{i}"]
+        _put_ln(sd, lp + "self_attn_layer_norm", h["ln_attn"])
+        _put_ln(sd, lp + "final_layer_norm", h["ln_mlp"])
+        for ours, theirs in (
+            ("q_proj", "self_attn.q_proj"),
+            ("k_proj", "self_attn.k_proj"),
+            ("v_proj", "self_attn.v_proj"),
+            ("o_proj", "self_attn.out_proj"),
+        ):
+            _put_linear(sd, lp + theirs, h["attn"][ours])
+        _put_linear(sd, lp + "fc1", h["mlp"]["up_proj"])
+        _put_linear(sd, lp + "fc2", h["mlp"]["down_proj"])
+    return sd
+
+
+def export_bloom(backbone: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
+    p = "transformer."
+    sd: Dict[str, np.ndarray] = {
+        p + "word_embeddings.weight": np.asarray(backbone["wte"]["embedding"]),
+        "lm_head.weight": np.asarray(backbone["wte"]["embedding"]),  # tied
+    }
+    _put_ln(sd, p + "word_embeddings_layernorm", backbone["emb_ln"])
+    _put_ln(sd, p + "ln_f", backbone["ln_f"])
+    for i in range(cfg.num_layers):
+        lp = f"{p}h.{i}."
+        h = backbone[f"h_{i}"]
+        _put_ln(sd, lp + "input_layernorm", h["ln_attn"])
+        _put_ln(sd, lp + "post_attention_layernorm", h["ln_mlp"])
+        w, b = _fuse_headmajor_qkv(h["attn"], cfg.num_heads, cfg.dims_per_head)
+        sd[lp + "self_attention.query_key_value.weight"] = w
+        if b is not None:
+            sd[lp + "self_attention.query_key_value.bias"] = b
+        _put_linear(sd, lp + "self_attention.dense", h["attn"]["o_proj"])
+        _put_linear(sd, lp + "mlp.dense_h_to_4h", h["mlp"]["up_proj"])
+        _put_linear(sd, lp + "mlp.dense_4h_to_h", h["mlp"]["down_proj"])
+    return sd
+
+
+EXPORTERS: Dict[str, Callable] = {
+    "gpt2": export_gpt2,
+    "llama": export_llama,
+    "gpt_neox": export_gptneox,
+    "gptj": export_gptj,
+    "opt": export_opt,
+    "bloom": export_bloom,
+}
+
+
+def _export_mlp_head(sd: Dict[str, np.ndarray], prefix: str, head: Dict[str, Any]) -> None:
+    """MLPHead → reference ``make_head`` Sequential(Linear, ReLU, Linear)
+    torch names: ``{prefix}.0.*`` / ``{prefix}.2.*``."""
+    _put_linear(sd, f"{prefix}.0", head["in_proj"])
+    _put_linear(sd, f"{prefix}.2", head["out_proj"])
+
+
+def merge_heads_into_state_dict(sd: Dict[str, np.ndarray], params: Dict[str, Any]) -> None:
+    """Fold value/ILQL head params into ``sd`` under the reference's key
+    names (``modeling_ppo.py:306-328``, ``modeling_ilql.py:322-344``)."""
+    if "v_head" in params:
+        _export_mlp_head(sd, "v_head", params["v_head"])
+    if "ilql_heads" in params:
+        heads = params["ilql_heads"]
+        _export_mlp_head(sd, "ilql_heads.heads.v_head", heads["v_head"])
+        for name, tree in sorted(heads.items()):
+            if name.startswith("q_head_"):
+                i = int(name[len("q_head_") :])
+                _export_mlp_head(sd, f"ilql_heads.heads.q_heads.{i}", tree)
+            elif name.startswith("target_q_head_"):
+                i = int(name[len("target_q_head_") :])
+                _export_mlp_head(sd, f"ilql_heads.heads.target_q_heads.{i}", tree)
+
+
+def hf_config_from_transformer(cfg):
+    """Inverse of :func:`config_from_hf`: TransformerConfig → transformers
+    config object for the family in ``cfg.model_type``."""
+    import transformers as tf
+
+    mt = cfg.model_type
+    if mt == "gpt2":
+        return tf.GPT2Config(
+            vocab_size=cfg.vocab_size,
+            n_positions=cfg.max_position_embeddings,
+            n_embd=cfg.hidden_size,
+            n_layer=cfg.num_layers,
+            n_head=cfg.num_heads,
+            n_inner=cfg.intermediate_size,
+            layer_norm_epsilon=cfg.layer_norm_epsilon,
+        )
+    if mt == "llama":
+        return tf.LlamaConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_heads,
+            num_key_value_heads=cfg.kv_heads,
+            intermediate_size=cfg.intermediate_size,
+            max_position_embeddings=cfg.max_position_embeddings,
+            rms_norm_eps=cfg.layer_norm_epsilon,
+            rope_theta=cfg.rope_theta,
+            tie_word_embeddings=cfg.tie_word_embeddings,
+        )
+    if mt == "gpt_neox":
+        return tf.GPTNeoXConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_heads,
+            intermediate_size=cfg.intermediate_size,
+            max_position_embeddings=cfg.max_position_embeddings,
+            rotary_pct=(cfg.rotary_dim or cfg.dims_per_head) / cfg.dims_per_head,
+            rotary_emb_base=cfg.rope_theta,
+            use_parallel_residual=cfg.parallel_residual,
+            layer_norm_eps=cfg.layer_norm_epsilon,
+            tie_word_embeddings=False,
+        )
+    if mt == "gptj":
+        return tf.GPTJConfig(
+            vocab_size=cfg.vocab_size,
+            n_positions=cfg.max_position_embeddings,
+            n_embd=cfg.hidden_size,
+            n_layer=cfg.num_layers,
+            n_head=cfg.num_heads,
+            n_inner=cfg.intermediate_size,
+            rotary_dim=cfg.rotary_dim,
+            layer_norm_epsilon=cfg.layer_norm_epsilon,
+            tie_word_embeddings=False,
+        )
+    if mt == "opt":
+        return tf.OPTConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_heads,
+            ffn_dim=cfg.intermediate_size,
+            max_position_embeddings=cfg.max_position_embeddings,
+            activation_function=cfg.activation,
+            word_embed_proj_dim=cfg.hidden_size,
+            do_layer_norm_before=True,
+        )
+    if mt == "bloom":
+        return tf.BloomConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            n_layer=cfg.num_layers,
+            n_head=cfg.num_heads,
+            layer_norm_epsilon=cfg.layer_norm_epsilon,
+        )
+    raise ValueError(
+        f"No HF export mapping for model_type={mt!r} "
+        "(set TransformerConfig.model_type to an HF family)"
+    )
+
+
+def params_to_hf_state_dict(params: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
+    """Full param tree (backbone + any heads) → HF torch-layout state dict.
+
+    Handles the scan_layers stacked layout and folds trained LoRA adapters
+    into their base kernels (reference exports merged weights too — OpenDelta
+    merges on save).
+    """
+    from trlx_tpu.models.builder import merge_lora_params
+    from trlx_tpu.models.transformer import unstack_layer_params
+
+    if cfg.model_type not in EXPORTERS:
+        raise ValueError(
+            f"No HF exporter for model_type={cfg.model_type!r}; known: {sorted(EXPORTERS)}"
+        )
+    backbone = params.get("backbone", params)
+    backbone = unstack_layer_params(backbone)
+    backbone = merge_lora_params(backbone, cfg)
+    sd = EXPORTERS[cfg.model_type](backbone, cfg)
+    if "backbone" in params:
+        merge_heads_into_state_dict(sd, params)
+    return sd
+
+
+def save_pretrained_hf(
+    directory: str,
+    params: Dict[str, Any],
+    cfg,
+    tokenizer_path: Optional[str] = None,
+) -> None:
+    """Write a transformers-loadable checkpoint directory:
+    ``pytorch_model.bin`` (fp32 torch tensors, heads merged under their
+    reference prefixes) + ``config.json``; tokenizer files are copied when
+    ``tokenizer_path`` is a local directory. The reference's
+    ``save_pretrained`` contract (``accelerate_base_trainer.py:256-272``)."""
+    import os
+    import shutil
+
+    import torch
+
+    os.makedirs(directory, exist_ok=True)
+    sd = params_to_hf_state_dict(params, cfg)
+    tensors = {
+        k: torch.tensor(np.asarray(v, dtype=np.float32)) for k, v in sd.items()
+    }
+    torch.save(tensors, os.path.join(directory, "pytorch_model.bin"))
+    hf_config_from_transformer(cfg).save_pretrained(directory)
+    if tokenizer_path and os.path.isdir(tokenizer_path):
+        for name in os.listdir(tokenizer_path):
+            if "token" in name or name in ("vocab.json", "merges.txt", "special_tokens_map.json"):
+                shutil.copy(os.path.join(tokenizer_path, name), directory)

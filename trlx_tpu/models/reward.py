@@ -134,5 +134,10 @@ def build_reward_model(model_config, parallel=None, seed: int = 0):
         from trlx_tpu.models.hf_interop import load_pretrained
 
         hf_params, _ = load_pretrained(hf_path)
-        params = _import_hf_backbone(params, "reward", hf_params["backbone"], tcfg.param_dtype)
+        backbone = hf_params["backbone"]
+        if tcfg.scan_layers:
+            from trlx_tpu.models.transformer import stack_layer_params
+
+            backbone = stack_layer_params(backbone, tcfg.num_layers)
+        params = _import_hf_backbone(params, "reward", backbone, tcfg.param_dtype)
     return module, params, tcfg

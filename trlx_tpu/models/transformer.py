@@ -40,9 +40,9 @@ def param_with_axes(init: Callable, axes: Tuple[str, ...]) -> Callable:
 
 def _maybe_ring_mesh(T: int):
     """The global mesh, iff its ``sequence`` axis should carry this pass
-    (full self-attention forward; ring doesn't apply to cache decode and the
-    ALiBi ring path is not implemented — plain flash handles those, with
-    GSPMD gathering K/V if activations are sequence-sharded)."""
+    (full self-attention forwards, ALiBi included; ring doesn't apply to
+    cache decode — plain flash handles that, with GSPMD gathering K/V if
+    activations are sequence-sharded)."""
     from trlx_tpu.parallel.mesh import get_global_mesh
 
     try:
@@ -74,6 +74,10 @@ class TransformerConfig:
     max_position_embeddings: int = 2048
     num_kv_heads: Optional[int] = None  # < num_heads → grouped-query attention
     head_dim: Optional[int] = None
+
+    # HF family tag ("gpt2", "llama", "gpt_neox", "gptj", "opt", "bloom");
+    # selects the import/export converter pair in hf_interop
+    model_type: Optional[str] = None
 
     position_scheme: str = "learned"  # learned | rotary | alibi
     pos_offset: int = 0  # OPT stores positions with an offset of 2
@@ -139,6 +143,7 @@ class TransformerConfig:
         return _make_preset(
             dims,
             overrides,
+            model_type="gpt2",
             position_scheme="learned",
             norm="layernorm",
             activation="gelu_new",
@@ -156,6 +161,7 @@ class TransformerConfig:
         return _make_preset(
             dims,
             overrides,
+            model_type="llama",
             position_scheme="rotary",
             norm="rmsnorm",
             layer_norm_epsilon=1e-6,
@@ -174,6 +180,7 @@ class TransformerConfig:
         return _make_preset(
             dims,
             overrides,
+            model_type="gptj",
             position_scheme="rotary",
             rotary_dim=64 if size != "test" else 8,
             norm="layernorm",
@@ -199,6 +206,7 @@ class TransformerConfig:
         return _make_preset(
             dims,
             overrides,
+            model_type="gpt_neox",
             position_scheme="rotary",
             rotary_dim=(dims["hidden_size"] // dims["num_heads"]) // 4 if size != "test" else 4,
             norm="layernorm",
@@ -220,6 +228,7 @@ class TransformerConfig:
         return _make_preset(
             dims,
             overrides,
+            model_type="opt",
             position_scheme="learned",
             pos_offset=2,
             norm="layernorm",
@@ -236,6 +245,7 @@ class TransformerConfig:
         return _make_preset(
             dims,
             overrides,
+            model_type="bloom",
             position_scheme="alibi",
             norm="layernorm",
             activation="gelu",
@@ -429,16 +439,21 @@ class Attention(nn.Module):
             new_cache = {"k": k_cache, "v": v_cache}
 
         ring_mesh = None
-        if flash_args is not None and cache is None and cfg.position_scheme != "alibi":
+        if flash_args is not None and cache is None:
             ring_mesh = _maybe_ring_mesh(T)
         if ring_mesh is not None:
             # sequence-parallel exact attention: K/V chunks rotate around the
-            # mesh's ``sequence`` ring (context parallelism; beyond the
-            # reference, which caps seq_length instead — SURVEY.md §5)
+            # mesh's ``sequence`` ring with zigzag causal placement (context
+            # parallelism; beyond the reference, which caps seq_length
+            # instead — SURVEY.md §5). ALiBi rides the ring as true token
+            # positions.
             from trlx_tpu.parallel.ring_attention import ring_flash_attention
 
             out = ring_flash_attention(
-                q, k, v, flash_args["key_mask"], ring_mesh
+                q, k, v, flash_args["key_mask"], ring_mesh,
+                q_positions=flash_args.get("q_positions"),
+                k_positions=flash_args.get("k_positions"),
+                alibi_slopes=flash_args.get("alibi_slopes"),
             ).reshape(B, T, H * D)
         elif flash_args is not None:
             # fused flash-attention kernel; masking semantics identical to the
@@ -503,6 +518,48 @@ class Block(nn.Module):
         return x, new_cache
 
 
+def _remat_policy(cfg: TransformerConfig):
+    """Rematerialisation policy per ``cfg.remat``:
+
+    - ``full``: save nothing — recompute the whole block in the backward
+      (max memory saving, ~1/3 extra FLOPs; NeMo's ``activations_checkpoint
+      _granularity: full``, ``megatron_20b.yaml:77-79``);
+    - ``minimal``: save matmul outputs with batch dims (the MXU-expensive
+      results), recompute cheap elementwise/norm ops only — NeMo's
+      ``selective`` granularity.
+    """
+    if cfg.remat == "minimal":
+        return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    return None  # full: save nothing
+
+
+def _block_cls(cfg: TransformerConfig):
+    if cfg.remat in ("full", "minimal"):
+        return nn.remat(Block, policy=_remat_policy(cfg))
+    return Block
+
+
+class _ScanBlockBody(nn.Module):
+    """``nn.scan`` body: one Block step over the layer axis.
+
+    Carry = (hidden states, branch-input buffer). ``branch_at`` is the layer
+    index whose *input* activations feed the hydra reference branch (−1 =
+    never); captured via ``where`` since scan has no data-dependent exits.
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, carry, cache_layer, layer_idx, attention_bias, positions, cache_index, flash_args, branch_at):
+        x, branch_input = carry
+        x_new, new_cache = _block_cls(self.config)(self.config, name="block")(
+            x, attention_bias, positions, cache_layer, cache_index, flash_args
+        )
+        if branch_input is not None:  # static: only hydra passes pay for it
+            branch_input = jnp.where(layer_idx == branch_at, x, branch_input)
+        return (x_new, branch_input), new_cache
+
+
 class CausalTransformer(nn.Module):
     """Decoder-only LM. Methods:
 
@@ -537,10 +594,25 @@ class CausalTransformer(nn.Module):
             )
         if cfg.embedding_layernorm:
             self.emb_ln = Norm(cfg, name="emb_ln")
-        block = Block
-        if cfg.remat == "full":
-            block = nn.remat(Block, static_argnums=())
-        self.blocks = [block(cfg, name=f"h_{i}") for i in range(cfg.num_layers)]
+        if cfg.scan_layers:
+            # roll all blocks into one lax.scan over stacked params — one
+            # traced/compiled block instead of L, O(1) compile time and
+            # program size in depth (the 20B+ scale path; the reference
+            # leans on NeMo/Megatron for this regime,
+            # ``trlx/models/modeling_nemo_ilql.py:253+``)
+            scan_cls = nn.scan(
+                _ScanBlockBody,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(0, 0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+                out_axes=0,
+                length=cfg.num_layers,
+            )
+            self.scan_blocks = scan_cls(cfg, name="h_scan")
+            self.blocks = []
+        else:
+            block = _block_cls(cfg)
+            self.blocks = [block(cfg, name=f"h_{i}") for i in range(cfg.num_layers)]
         if cfg.final_norm:
             self.ln_f = Norm(cfg, name="ln_f")
         if not cfg.tie_word_embeddings:
@@ -640,14 +712,30 @@ class CausalTransformer(nn.Module):
             bias = self._attention_bias(attention_mask, query_slots, positions)
 
         branch_input = None
-        new_cache = [] if cache is not None else None
-        for i, block in enumerate(self.blocks):
-            if branch_layer is not None and i == len(self.blocks) - branch_layer:
-                branch_input = x
-            layer_cache = cache[i] if cache is not None else None
-            x, updated = block(x, bias, positions, layer_cache, cache_index, flash_args)
-            if cache is not None:
-                new_cache.append(updated)
+        if cfg.scan_layers:
+            branch_at = cfg.num_layers - branch_layer if branch_layer is not None else -1
+            branch_buf0 = jnp.zeros_like(x) if branch_layer is not None else None
+            (x, branch_buf), new_cache = self.scan_blocks(
+                (x, branch_buf0),
+                cache,  # stacked {"k": [L,B,S,KV,D], "v": ...} or None
+                jnp.arange(cfg.num_layers),
+                bias,
+                positions,
+                cache_index,
+                flash_args,
+                jnp.asarray(branch_at),
+            )
+            if branch_layer is not None:
+                branch_input = branch_buf
+        else:
+            new_cache = [] if cache is not None else None
+            for i, block in enumerate(self.blocks):
+                if branch_layer is not None and i == len(self.blocks) - branch_layer:
+                    branch_input = x
+                layer_cache = cache[i] if cache is not None else None
+                x, updated = block(x, bias, positions, layer_cache, cache_index, flash_args)
+                if cache is not None:
+                    new_cache.append(updated)
 
         if cfg.final_norm:
             h = self.ln_f(x)
@@ -687,8 +775,29 @@ class CausalTransformer(nn.Module):
         else:
             bias, flash_args = self._attention_bias(attention_mask, query_slots, positions), None
         x = hidden_states
-        for block in self.blocks[len(self.blocks) - branch_layer :]:
-            x, _ = block(x, bias, positions, flash_args=flash_args)
+        if cfg.scan_layers:
+            # scan over the top `branch_layer` rows of the stacked params —
+            # the bound tree holds either a pre-sliced branch snapshot
+            # (builder.hydra_ref_params) or the full stack
+            stacked = self.variables["params"]["h_scan"]["block"]
+            n_avail = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+            sliced = jax.tree_util.tree_map(lambda p: p[n_avail - branch_layer :], stacked)
+            # parent=None: a detached functional Block (not a submodule —
+            # its params come from the scanned stack, not this scope)
+            body_block = Block(cfg, parent=None)
+
+            def body(h, layer_params):
+                out, _ = body_block.apply(
+                    {"params": layer_params}, h, bias, positions, flash_args=flash_args
+                )
+                return out, None
+
+            if cfg.remat in ("full", "minimal"):
+                body = jax.checkpoint(body, policy=_remat_policy(cfg))
+            x, _ = jax.lax.scan(body, x, sliced)
+        else:
+            for block in self.blocks[len(self.blocks) - branch_layer :]:
+                x, _ = block(x, bias, positions, flash_args=flash_args)
         h = self.ln_f(x) if cfg.final_norm else x
         return {"logits": self._logits(h), "hidden_states": h}
 
@@ -699,16 +808,50 @@ class CausalTransformer(nn.Module):
 
 def make_kv_cache(
     cfg: TransformerConfig, batch_size: int, max_length: int, dtype=None
-) -> List[Dict[str, jax.Array]]:
-    """All-zeros KV cache pytree for ``cfg`` (usable outside module ``apply``)."""
+) -> Any:
+    """All-zeros KV cache pytree for ``cfg`` (usable outside module ``apply``).
+
+    Layout follows the block layout: a per-layer list of ``{"k", "v"}`` dicts,
+    or one stacked dict with a leading layer dim when ``cfg.scan_layers``.
+    """
     dtype = dtype or cfg.dtype
-    return [
-        {
-            "k": jnp.zeros((batch_size, max_length, cfg.kv_heads, cfg.dims_per_head), dtype),
-            "v": jnp.zeros((batch_size, max_length, cfg.kv_heads, cfg.dims_per_head), dtype),
+    shape = (batch_size, max_length, cfg.kv_heads, cfg.dims_per_head)
+    if cfg.scan_layers:
+        return {
+            "k": jnp.zeros((cfg.num_layers,) + shape, dtype),
+            "v": jnp.zeros((cfg.num_layers,) + shape, dtype),
         }
+    return [
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
         for _ in range(cfg.num_layers)
     ]
+
+
+def stack_layer_params(backbone: Dict[str, Any], num_layers: int, prefix: str = "h_") -> Dict[str, Any]:
+    """Per-layer ``h_i`` subtrees → one stacked ``h_scan/block`` subtree
+    (leading layer dim). Converts HF-imported / unscanned param trees into the
+    ``scan_layers`` layout."""
+    out = {
+        k: v
+        for k, v in backbone.items()
+        if not (k.startswith(prefix) and k[len(prefix) :].isdigit())
+    }
+    layers = [backbone[f"{prefix}{i}"] for i in range(num_layers)]
+    out["h_scan"] = {"block": jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)}
+    return out
+
+
+def unstack_layer_params(backbone: Dict[str, Any], prefix: str = "h_") -> Dict[str, Any]:
+    """Inverse of :func:`stack_layer_params` — for HF-format export and
+    checkpoint interop with unscanned layouts."""
+    if "h_scan" not in backbone:
+        return backbone
+    out = {k: v for k, v in backbone.items() if k != "h_scan"}
+    stacked = backbone["h_scan"]["block"]
+    num_layers = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    for i in range(num_layers):
+        out[f"{prefix}{i}"] = jax.tree_util.tree_map(lambda p: p[i], stacked)
+    return out
 
 
 BUILTIN_SPECS = {

@@ -145,93 +145,11 @@ def _fwd_kernel(
 
 
 # ---------------------------------------------------------------------------
-# backward
+# backward (fused: dq + dk + dv in one kernel)
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(
-    qoff_ref,
-    koff_ref,
-    q_ref,  # (1, 1, bQ, D)
-    k_ref,  # (1, 1, Sp, D)
-    v_ref,  # (1, 1, Sp, D)
-    kmask_ref,  # (1, 1, Sp)
-    qpos_ref,
-    kpos_ref,
-    slopes_ref,
-    lse_ref,  # (1, 1, bQ, LANES)
-    delta_ref,  # (1, 1, bQ, LANES)
-    do_ref,  # (1, 1, bQ, D)
-    dq_ref,  # (1, 1, bQ, D)
-    *,
-    sm_scale: float,
-    causal: bool,
-    alibi: bool,
-    block_k: int,
-    seq_k: int,
-    block_q: int,
-):
-    iq = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, 0:1]
-    delta = delta_ref[0, 0, :, 0:1]
-    qoff = qoff_ref[0]
-    koff = koff_ref[0]
-    q_slots = qoff + iq * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
-    if alibi:
-        q_pos = qpos_ref[0, 0].astype(jnp.float32).reshape(block_q, 1)
-        slope = slopes_ref[pl.program_id(1)]
-
-    n_k = seq_k // block_k
-    if causal:
-        hi = jnp.clip(
-            (qoff + (iq + 1) * block_q - koff + block_k - 1) // block_k, 0, n_k
-        )
-    else:
-        hi = n_k
-
-    def body(ik, dq):
-        k = k_ref[0, 0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
-        kmask = kmask_ref[0, 0, pl.ds(ik * block_k, block_k)].reshape(1, block_k)
-
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        k_slots = (
-            koff
-            + ik * block_k
-            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        )
-        visible = kmask > 0.5
-        if causal:
-            visible = visible & (k_slots <= q_slots)
-        if alibi:
-            k_pos = kpos_ref[0, 0, pl.ds(ik * block_k, block_k)].astype(
-                jnp.float32
-            ).reshape(1, block_k)
-            s = s + slope * (k_pos - q_pos)
-        p = jnp.exp(jnp.where(visible, s, NEG_INF) - lse) * visible.astype(
-            jnp.float32
-        )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta)
-        dq_blk = jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return dq + dq_blk
-
-    d = q_ref.shape[-1]
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0, 0] = (dq * sm_scale).astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(
+def _bwd_fused_kernel(
     qoff_ref,
     koff_ref,
     q_ref,  # (1, 1, Tp, D)  full queries
@@ -244,6 +162,7 @@ def _bwd_dkv_kernel(
     lse_ref,  # (1, 1, Tp, LANES)
     delta_ref,  # (1, 1, Tp, LANES)
     do_ref,  # (1, 1, Tp, D)
+    dq_ref,  # (1, 1, Tp, D) f32, accumulated across the k-block grid dim
     dk_ref,  # (1, 1, bK, D)
     dv_ref,  # (1, 1, bK, D)
     *,
@@ -254,7 +173,17 @@ def _bwd_dkv_kernel(
     seq_q: int,
     block_k: int,
 ):
+    """Fused backward: one pass over (k-block × q-blocks) produces dk/dv for
+    the k block AND accumulates dq into its full-sequence buffer — the TPU
+    grid is sequential per (b, h), so the dq window persists in VMEM across
+    k-block steps. Versus the split dq/dkv kernels this computes the s / p /
+    dp matmul chain once instead of twice (5 MXU ops per tile pair vs 7)."""
     ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
     k = k_ref[0, 0].astype(jnp.float32)  # (bK, D)
     v = v_ref[0, 0].astype(jnp.float32)
     kmask = kmask_ref[0, 0].reshape(1, block_k)
@@ -269,8 +198,6 @@ def _bwd_dkv_kernel(
 
     n_q = seq_q // block_q
     if causal:
-        # first q block that can see this k block: q_slot >= k_slot
-        # ⇔ qoff + t >= koff + ik*bK
         lo = jnp.clip((koff + ik * block_k - qoff) // block_q, 0, n_q)
     else:
         lo = 0
@@ -309,6 +236,11 @@ def _bwd_dkv_kernel(
         dk_blk = jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # (bK, D)
+        dq_blk = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bQ, D)
+        cur = dq_ref[0, 0, pl.ds(iq * block_q, block_q), :]
+        dq_ref[0, 0, pl.ds(iq * block_q, block_q), :] = cur + dq_blk * sm_scale
         return dk + dk_blk, dv + dv_blk
 
     d = q_ref.shape[-1]
@@ -423,61 +355,18 @@ def _flash_fwd_rule(
     return out, res
 
 
-def _bwd_dq_call(
+def _bwd_fused_call(
     qoff, koff, q, k, v, kmask, qpos, kpos, slopes, lse, delta, do,
     sm_scale, causal, alibi, block_q, block_k, interpret,
 ):
-    """dq pallas call on kernel-layout padded inputs (lse/delta lane-replicated)."""
+    """Single fused pallas call producing (dq, dk, dv) on kernel-layout
+    padded inputs. dq accumulates in f32 across the sequential k-block grid
+    (``sm_scale`` applied in-kernel); GQA partials are group-summed here."""
     B, H, T, D = q.shape
     KV, S = k.shape[1], k.shape[2]
     group = H // KV
-    dq_kernel = functools.partial(
-        _bwd_dq_kernel,
-        sm_scale=sm_scale,
-        causal=causal,
-        alibi=alibi,
-        block_k=block_k,
-        seq_k=S,
-        block_q=block_q,
-    )
-    dq = pl.pallas_call(
-        dq_kernel,
-        grid=(B, H, T // block_q),
-        in_specs=[
-            _smem_spec(),
-            _smem_spec(),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // group, 0, 0)),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // group, 0, 0)),
-            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
-            _smem_spec(),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
-        interpret=interpret,
-    )(qoff, koff, q, k, v, kmask, qpos, kpos, slopes, lse, delta, do)
-    return dq
-
-
-def _bwd_dkv_call(
-    qoff, koff, q, k, v, kmask, qpos, kpos, slopes, lse, delta, do,
-    sm_scale, causal, alibi, block_q, block_k, interpret,
-):
-    """dk/dv pallas call on kernel-layout padded inputs.
-
-    With GQA the per-q-head partials (B, H, S, D) are summed over each kv
-    group before returning, so callers always get grads shaped like k/v.
-    """
-    B, H, T, D = q.shape
-    KV, S = k.shape[1], k.shape[2]
-    group = H // KV
-    dkv_kernel = functools.partial(
-        _bwd_dkv_kernel,
+    kernel = functools.partial(
+        _bwd_fused_kernel,
         sm_scale=sm_scale,
         causal=causal,
         alibi=alibi,
@@ -485,8 +374,8 @@ def _bwd_dkv_call(
         seq_q=T,
         block_k=block_k,
     )
-    dk, dv = pl.pallas_call(
-        dkv_kernel,
+    dq, dk, dv = pl.pallas_call(
+        kernel,
         grid=(B, H, S // block_k),
         in_specs=[
             _smem_spec(),
@@ -503,10 +392,12 @@ def _bwd_dkv_call(
             pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0)),
         ],
         out_specs=[
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), jnp.float32),
             jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
             jax.ShapeDtypeStruct((B, H, S, D), v.dtype),
         ],
@@ -515,7 +406,7 @@ def _bwd_dkv_call(
     if group > 1:
         dk = dk.reshape(B, KV, group, S, D).sum(axis=2)
         dv = dv.reshape(B, KV, group, S, D).sum(axis=2)
-    return dk, dv
+    return dq.astype(q.dtype), dk, dv
 
 
 def _flash_bwd_rule(
@@ -531,8 +422,7 @@ def _flash_bwd_rule(
 
     args = (qoff, koff, q, k, v, kmask, qpos, kpos, slopes, lse, delta, do)
     opts = (sm_scale, causal, alibi, block_q, block_k, interpret)
-    dq = _bwd_dq_call(*args, *opts)
-    dk, dv = _bwd_dkv_call(*args, *opts)
+    dq, dk, dv = _bwd_fused_call(*args, *opts)
 
     zeros_like = jax.tree_util.tree_map(jnp.zeros_like, (kmask, qpos, kpos, slopes, offsets))
     return (dq, dk, dv) + zeros_like
@@ -554,6 +444,9 @@ def flash_attention_bwd_chunk(
     sm_scale: Optional[float] = None,
     q_offset=0,
     k_offset=0,
+    q_positions: Optional[jax.Array] = None,  # (B, T) for alibi
+    k_positions: Optional[jax.Array] = None,  # (B, S) for alibi
+    alibi_slopes: Optional[jax.Array] = None,  # (H,)
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
@@ -563,7 +456,8 @@ def flash_attention_bwd_chunk(
     With the *global* ``lse``/``delta``, summing these terms over all kv
     chunks (rotating around the ring) reproduces the exact monolithic
     backward — this is the building block of the ring-attention VJP
-    (``trlx_tpu/parallel/ring_attention.py``).
+    (``trlx_tpu/parallel/ring_attention.py``). One fused kernel call
+    produces all three grads.
     """
     if interpret is None:
         interpret = _default_interpret()
@@ -571,6 +465,7 @@ def flash_attention_bwd_chunk(
     S = k.shape[1]
     if sm_scale is None:
         sm_scale = 1.0 / (D ** 0.5)
+    alibi = alibi_slopes is not None
     if interpret:
         block_q = min(block_q, max(T, 8))
         block_k = min(block_k, max(S, 8))
@@ -581,13 +476,23 @@ def flash_attention_bwd_chunk(
     dot = _pad_to(do.transpose(0, 2, 1, 3), block_q, 2)
     Tp, Sp = qt.shape[2], kt.shape[2]
     kmask = _pad_to(key_mask.astype(jnp.float32), block_k, 1).reshape(B, 1, Sp)
-    qpos = jnp.zeros((B, 1, Tp), jnp.int32)
-    kpos = jnp.zeros((B, 1, Sp), jnp.int32)
-    slopes = jnp.zeros((H,), jnp.float32)
-    # padded query rows: lse sentinel keeps p = exp(NEG_INF - NEG_INF)*0 = 0
+    if q_positions is None:
+        q_positions = jnp.zeros((B, T), jnp.int32)
+    if k_positions is None:
+        k_positions = jnp.zeros((B, S), jnp.int32)
+    qpos = _pad_to(q_positions.astype(jnp.int32), block_q, 1).reshape(B, 1, Tp)
+    kpos = _pad_to(k_positions.astype(jnp.int32), block_k, 1).reshape(B, 1, Sp)
+    slopes = (
+        alibi_slopes.astype(jnp.float32).reshape(H)
+        if alibi
+        else jnp.zeros((H,), jnp.float32)
+    )
+    # padded query rows: a +inf-like lse sentinel drives p = exp(s - 1e30) to
+    # zero regardless of which keys the padded slots would "see" (a NEG_INF
+    # sentinel would instead overflow p to inf for visible pairs)
     lse_p = _pad_to(lse, block_q, 2)
     lse_p = jnp.where(
-        jnp.arange(Tp)[None, None, :] < T, lse_p, NEG_INF
+        jnp.arange(Tp)[None, None, :] < T, lse_p, -NEG_INF
     )
     lse_p = jnp.broadcast_to(lse_p[..., None], (B, H, Tp, LANES))
     delta_p = jnp.broadcast_to(_pad_to(delta, block_q, 2)[..., None], (B, H, Tp, LANES))
@@ -597,9 +502,8 @@ def flash_attention_bwd_chunk(
     )
 
     args = (offsets[0], offsets[1], qt, kt, vt, kmask, qpos, kpos, slopes, lse_p, delta_p, dot)
-    opts = (sm_scale, causal, False, block_q, block_k, interpret)
-    dq = _bwd_dq_call(*args, *opts)
-    dk, dv = _bwd_dkv_call(*args, *opts)
+    opts = (sm_scale, causal, alibi, block_q, block_k, interpret)
+    dq, dk, dv = _bwd_fused_call(*args, *opts)
     return (
         dq[:, :, :T, :].transpose(0, 2, 1, 3),
         dk[:, :, :S, :].transpose(0, 2, 1, 3),

@@ -67,7 +67,12 @@ def param_spec_for_path(
     for pattern, spec in _RULES:
         if re.match(pattern, path):
             break
-    partitions = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+    partitions = tuple(spec)
+    if "/h_scan/" in path or path.startswith("h_scan/"):
+        # scan_layers layout: a leading layer dim precedes every rule's dims
+        # (stacked blocks); the layer axis itself stays unsharded
+        partitions = (None,) + partitions
+    partitions = partitions + (None,) * (len(shape) - len(partitions))
     partitions = partitions[: len(shape)]
     if mesh is not None:
         partitions = tuple(

@@ -97,6 +97,11 @@ class TPUBaseTrainer(BaseRLTrainer):
         **kwargs,
     ):
         super().__init__(config, reward_fn, metric_fn, stop_sequences, **kwargs)
+        if config.train.batch_size % max(1, config.train.grad_accum) != 0:
+            raise ValueError(
+                f"train.batch_size ({config.train.batch_size}) must be divisible "
+                f"by train.grad_accum ({config.train.grad_accum})"
+            )
         self.mesh = make_mesh(config.parallel)
         set_global_mesh(self.mesh)  # model code reads this for sequence-parallel ops
         # NOTE: the global mesh is process-wide; entry points re-assert it so
@@ -208,12 +213,54 @@ class TPUBaseTrainer(BaseRLTrainer):
     def _build_train_step(self) -> Callable:
         optimizer = self.optimizer
         schedule = self.schedule
+        accum = max(1, int(getattr(self.config.train, "grad_accum", 1)))
+
+        def grads_of(params, batch, rng):
+            return jax.value_and_grad(self.loss_fn, has_aux=True)(params, batch, rng)
+
+        def accumulated_grads(params, batch, step_rng):
+            """lax.scan over ``accum`` microbatches; grads and stats averaged.
+
+            Whitening/running statistics inside ``loss_fn`` see one
+            microbatch at a time (same as the reference under DeepSpeed
+            accumulation, where each micro forward is independent).
+            """
+            micro = jax.tree_util.tree_map(
+                lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
+                batch,
+            )
+            rngs = jax.random.split(step_rng, accum)
+            # zero-init the carry from eval_shape so the model's fwd+bwd is
+            # traced exactly once (inside the scan body) — peeling the first
+            # microbatch would duplicate the whole HLO graph
+            first = jax.tree_util.tree_map(lambda x: x[0], micro)
+            (_, stats_sh), grads_sh = jax.eval_shape(grads_of, params, first, rngs[0])
+            zeros = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+                lambda s: jnp.zeros(s.shape, s.dtype), tree
+            )
+
+            def body(carry, xs):
+                grads_acc, stats_acc = carry
+                mb, r = xs
+                (_, stats_i), grads_i = grads_of(params, mb, r)
+                grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads_i)
+                stats_acc = jax.tree_util.tree_map(jnp.add, stats_acc, stats_i)
+                return (grads_acc, stats_acc), None
+
+            (grads, stats), _ = jax.lax.scan(
+                body, (zeros(grads_sh), zeros(stats_sh)), (micro, rngs)
+            )
+            grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+            stats = jax.tree_util.tree_map(lambda s: s / accum, stats)
+            # per-trainer loss key varies; callers only consume stats
+            return (jnp.zeros(()), stats), grads
 
         def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
             rng, step_rng = jax.random.split(state.rng)
-            (loss, stats), grads = jax.value_and_grad(self.loss_fn, has_aux=True)(
-                state.params, batch, step_rng
-            )
+            if accum == 1:
+                (loss, stats), grads = grads_of(state.params, batch, step_rng)
+            else:
+                (loss, stats), grads = accumulated_grads(state.params, batch, step_rng)
             updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             stats = dict(stats)
@@ -479,6 +526,23 @@ class TPUBaseTrainer(BaseRLTrainer):
         self.nth_evaluation += 1
         return stats
 
+    def _report_sweep(self, stats: Dict[str, Any]) -> None:
+        """Write the latest eval stats to ``$TRLX_TPU_SWEEP_RESULT`` for the
+        sweep runner — the subprocess analogue of the reference's Ray
+        ``session.report`` (``accelerate_base_trainer.py:510-511``), written
+        at every evaluation so interrupted trials still report."""
+        path = os.environ.get("TRLX_TPU_SWEEP_RESULT")
+        if not path or jax.process_index() != 0:
+            return
+        payload = {
+            "iter_count": self.iter_count,
+            "stats": filter_non_scalars(to_host(stats)),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+
     # ------------------------------------------------------------------
     # the learn loop
     # ------------------------------------------------------------------
@@ -493,6 +557,7 @@ class TPUBaseTrainer(BaseRLTrainer):
 
         results = self.evaluate()
         self.tracker.log(results, step=self.iter_count)
+        self._report_sweep(results)
         best_reward = -float("inf")
         clock = Clock()
 
@@ -526,6 +591,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                     if self.iter_count % self.config.train.eval_interval == 0:
                         results = self.evaluate()
                         stats.update(results)
+                        self._report_sweep(stats)
                         if self.config.train.save_best:
                             reward = stats.get(
                                 "reward/mean", stats.get("metrics/reward", -float("inf"))
@@ -550,6 +616,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                         results = self.evaluate()
                         stats.update(results)
                         self.tracker.log(stats, step=self.iter_count)
+                        self._report_sweep(stats)
                         subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
                         self.save(os.path.join(self.config.train.checkpoint_dir, subfolder))
                         tbar.close()

@@ -9,6 +9,7 @@ are preserved exactly:
 - ``metric_fn(samples, prompts, outputs) -> Dict[str, List[float]]``
 """
 
+import os
 import warnings
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -19,6 +20,67 @@ from trlx_tpu.data.default_configs import (
     default_sft_config,
 )
 from trlx_tpu.utils import set_seed
+
+_runtime_initialized = False
+
+
+def initialize_runtime() -> None:
+    """Process-level JAX runtime setup, driven by environment variables.
+
+    Called once at the top of :func:`train` (idempotent). Two concerns:
+
+    - **Platform override** — ``TRLX_TPU_PLATFORM=cpu|tpu`` forces the JAX
+      platform via ``jax.config`` (stronger than ``JAX_PLATFORMS``, which
+      container boot shims can override).
+    - **Multi-host initialization** — the TPU-native equivalent of the
+      reference's ``torchrun``/NCCL process-group setup (SURVEY.md §2.3
+      "Distributed communication backend"). On a TPU pod, launch the same
+      script on every host with ``TRLX_TPU_MULTIHOST=1`` and
+      ``jax.distributed.initialize()`` auto-detects coordinator/process
+      topology from the TPU metadata; elsewhere (CPU/GPU clusters, tests)
+      set ``TRLX_TPU_COORDINATOR=host:port``, ``TRLX_TPU_NUM_PROCESSES``,
+      and ``TRLX_TPU_PROCESS_ID`` explicitly. After initialization every
+      host runs the same SPMD program over one global mesh; host-local code
+      (trackers, checkpoint writes, reward fns) is already gated on
+      ``jax.process_index() == 0`` throughout the trainers.
+
+    v4 pod launch sketch::
+
+        # on every host of a v4-32 (4 hosts × 4 chips):
+        TRLX_TPU_MULTIHOST=1 python examples/ppo_sentiments.py
+    """
+    global _runtime_initialized
+    if _runtime_initialized:
+        return
+    _runtime_initialized = True
+
+    platform = os.environ.get("TRLX_TPU_PLATFORM")
+    if platform:
+        import jax
+
+        os.environ["JAX_PLATFORMS"] = platform
+        try:
+            jax.config.update("jax_platforms", platform)
+        except Exception as e:
+            from trlx_tpu.utils import logging
+
+            logging.get_logger(__name__).warning(
+                f"TRLX_TPU_PLATFORM={platform} could not be applied "
+                f"(backend already initialized? {e})"
+            )
+
+    coordinator = os.environ.get("TRLX_TPU_COORDINATOR")
+    if os.environ.get("TRLX_TPU_MULTIHOST") or coordinator:
+        import jax
+
+        kwargs = {}
+        if coordinator:
+            kwargs = dict(
+                coordinator_address=coordinator,
+                num_processes=int(os.environ["TRLX_TPU_NUM_PROCESSES"]),
+                process_id=int(os.environ["TRLX_TPU_PROCESS_ID"]),
+            )
+        jax.distributed.initialize(**kwargs)
 
 
 def train(  # noqa: C901
@@ -52,6 +114,8 @@ def train(  # noqa: C901
     """
     # Import for registration side effects (trainers/pipelines register here).
     import importlib
+
+    initialize_runtime()
 
     for module in (
         "trlx_tpu.pipeline.offline_pipeline",

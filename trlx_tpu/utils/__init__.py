@@ -207,6 +207,26 @@ def get_scheduler(
     raise ValueError(f"Unknown scheduler {name}")
 
 
+def _layerwise_freeze(vector: np.ndarray) -> optax.GradientTransformation:
+    """Multiply updates by a per-layer 0/1 vector broadcast over the leading
+    (stacked-layer) dim of every leaf. Used on both sides of the inner
+    optimizer for ``scan_layers`` partial freezing: zeroing incoming grads
+    keeps the moments clean, zeroing outgoing updates kills weight decay on
+    frozen layers."""
+    vec = jnp.asarray(vector)
+
+    def init_fn(params):
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        def mask_leaf(u):
+            return u * vec.reshape((-1,) + (1,) * (u.ndim - 1)).astype(u.dtype)
+
+        return jax.tree_util.tree_map(mask_leaf, updates), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def get_optimizer(
     name: str,
     kwargs: Dict[str, Any],
@@ -215,9 +235,11 @@ def get_optimizer(
 ) -> optax.GradientTransformation:
     """Build an optax optimizer from a config name + kwargs.
 
-    ``mask`` (a pytree of bools matching params) freezes parameters the way
-    the reference does with ``requires_grad_`` (``trlx/utils/modeling.py:34-66``)
-    — masked-out leaves get ``optax.set_to_zero``.
+    ``mask`` (a pytree matching params) freezes parameters the way the
+    reference does with ``requires_grad_`` (``trlx/utils/modeling.py:34-66``).
+    Leaves are bools (fully trainable / fully frozen → ``optax.set_to_zero``)
+    or per-layer 0/1 vectors for ``scan_layers`` stacked blocks, which get
+    the inner optimizer wrapped in a layer-wise freeze.
     """
     name = OptimizerName(name.lower())
     kwargs = dict(kwargs)
@@ -256,8 +278,26 @@ def get_optimizer(
         raise ValueError(f"Unknown optimizer {name}")
 
     if mask is not None:
-        opt = optax.multi_transform(
-            {True: opt, False: optax.set_to_zero()},
-            jax.tree_util.tree_map(bool, mask),
-        )
+        transforms: Dict[Any, optax.GradientTransformation] = {
+            "train": opt,
+            "freeze": optax.set_to_zero(),
+        }
+        vectors: Dict[Tuple, str] = {}
+
+        def to_label(leaf):
+            if isinstance(leaf, (bool, np.bool_)):
+                return "train" if leaf else "freeze"
+            key = tuple(np.asarray(leaf).tolist())
+            if key not in vectors:
+                label = f"partial_{len(vectors)}"
+                vectors[key] = label
+                transforms[label] = optax.chain(
+                    _layerwise_freeze(np.asarray(leaf)),
+                    opt,
+                    _layerwise_freeze(np.asarray(leaf)),
+                )
+            return vectors[key]
+
+        labels = jax.tree_util.tree_map(to_label, mask)
+        opt = optax.multi_transform(transforms, labels)
     return opt

@@ -71,8 +71,11 @@ def save_pretrained(
     tokenizer_path: Optional[str] = None,
 ) -> None:
     """Export model weights + architecture config in an interoperable layout:
-    ``flax_model.msgpack`` (full param tree, host-gathered, fp32-preserving)
-    and ``config.json`` (the TransformerConfig fields)."""
+    ``flax_model.msgpack`` (full param tree, host-gathered, fp32-preserving),
+    ``trlx_tpu_config.json`` (the TransformerConfig fields), and — for
+    architectures with an HF family mapping — a transformers-loadable
+    ``pytorch_model.bin`` + ``config.json`` with heads merged under their
+    reference prefixes (``accelerate_base_trainer.py:256-272``)."""
     import dataclasses
 
     from flax import serialization
@@ -88,8 +91,26 @@ def save_pretrained(
     cfg["framework"] = "trlx_tpu"
     if tokenizer_path is not None:
         cfg["tokenizer_path"] = tokenizer_path
-    with open(os.path.join(directory, "config.json"), "w") as f:
+    with open(os.path.join(directory, "trlx_tpu_config.json"), "w") as f:
         json.dump(cfg, f, indent=2)
+
+    # HF torch export (reference save_pretrained contract) whenever the
+    # architecture maps to a transformers family; writes pytorch_model.bin +
+    # config.json with value/Q heads merged under their reference prefixes.
+    # torch/transformers are optional deps — the native msgpack export above
+    # must survive without them.
+    if getattr(transformer_config, "model_type", None) is not None:
+        try:
+            from trlx_tpu.models.hf_interop import save_pretrained_hf
+
+            save_pretrained_hf(directory, host_params, transformer_config, tokenizer_path)
+        except ImportError as e:
+            from trlx_tpu.utils import logging
+
+            logging.get_logger(__name__).warning(
+                f"Skipping HF-format export (torch/transformers unavailable: {e}); "
+                f"flax_model.msgpack was written"
+            )
 
 
 def load_pretrained_params(directory: str, template: Any) -> Any:
