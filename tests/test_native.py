@@ -1,0 +1,60 @@
+"""Native host-runtime collator (C++/ctypes) vs the numpy fallback: exact
+behavioral equality over ragged/truncated/empty inputs, both dtypes and pad
+sides. The reference gets its native collation from torch's C++ data
+machinery (SURVEY.md §2.4); here it is in-repo.
+"""
+
+import numpy as np
+import pytest
+
+from trlx_tpu import native
+from trlx_tpu.pipeline.offline_pipeline import pad_rows
+
+
+def _python_pad_rows(rows, pad_value, side, length, dtype):
+    out = np.full((len(rows), length), pad_value, dtype=dtype)
+    mask = np.zeros((len(rows), length), dtype=np.int32)
+    for i, row in enumerate(rows):
+        row = list(row)
+        if len(row) > length:
+            row = row[-length:] if side == "left" else row[:length]
+        if side == "left":
+            out[i, length - len(row) :] = row
+            mask[i, length - len(row) :] = 1
+        else:
+            out[i, : len(row)] = row
+            mask[i, : len(row)] = 1
+    return out, mask
+
+
+def test_native_compiles_and_loads():
+    assert native.available(), "g++ toolchain is in the image; native must build"
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+@pytest.mark.parametrize("dtype", [np.int32, np.float32])
+def test_native_matches_python(side, dtype):
+    rng = np.random.RandomState(0)
+    rows = [
+        np.asarray(rng.randint(0, 100, size=n), dtype)
+        for n in [0, 1, 3, 8, 17, 31, 5]
+    ]
+    for length in (8, 16, 4):  # incl. truncation (4 < longest row)
+        got = native.pad_rows_native(rows, 7, side, length, dtype)
+        assert got is not None
+        want = _python_pad_rows(rows, 7, side, length, dtype)
+        np.testing.assert_array_equal(got[0], want[0])
+        np.testing.assert_array_equal(got[1], want[1])
+
+
+def test_pad_rows_dispatches_native():
+    rows = [np.asarray([1, 2, 3], np.int32), np.asarray([4], np.int32)]
+    out, mask = pad_rows(rows, 0, side="left", pad_multiple=4)
+    np.testing.assert_array_equal(out, [[0, 1, 2, 3], [0, 0, 0, 4]])
+    np.testing.assert_array_equal(mask, [[0, 1, 1, 1], [0, 0, 0, 1]])
+
+
+def test_pad_rows_accepts_plain_lists():
+    out, mask = pad_rows([[1, 2], [3]], 9, side="right", pad_multiple=2)
+    np.testing.assert_array_equal(out, [[1, 2], [3, 9]])
+    np.testing.assert_array_equal(mask, [[1, 1], [1, 0]])

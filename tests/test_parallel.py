@@ -46,17 +46,19 @@ def test_param_spec_rules():
     # row-parallel o_proj: [H*D, E] → (model, fsdp)
     assert param_spec_for_path("backbone/h_0/attn/o_proj/kernel", (64, 64), mesh) == P("model", "fsdp")
     assert param_spec_for_path("backbone/h_0/attn/o_proj/bias", (64,), mesh) == P(None)
-    # vocab-parallel embedding
-    assert param_spec_for_path("backbone/wte/embedding", (256, 64), mesh) == P("model", "fsdp")
+    # vocab-parallel embedding over the combined model×fsdp axes, embed
+    # replicated (clean batch-sharded lookup outputs)
+    assert param_spec_for_path("backbone/wte/embedding", (256, 64), mesh) == P(("model", "fsdp"), None)
     # norms replicate
     assert param_spec_for_path("backbone/ln_f/scale", (64,), mesh) == P(None)
 
 
 def test_param_spec_divisibility_fallback():
     mesh = make_mesh(ParallelConfig(data=2, fsdp=2, model=2))
-    # 259 (byte vocab) is not divisible by 2 → vocab axis drops to replicated
+    # 259 (byte vocab) is not divisible by model×fsdp=4 → vocab axis drops
+    # to replicated (embed stays replicated by rule)
     spec = param_spec_for_path("backbone/wte/embedding", (259, 64), mesh)
-    assert spec == P(None, "fsdp")
+    assert spec == P(None, None)
 
 
 def test_shard_params_and_forward():

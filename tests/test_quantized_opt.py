@@ -1,0 +1,168 @@
+"""8-bit AdamW (blockwise-int8 moments) — the TPU-native replacement for the
+reference's bitsandbytes option (``trlx/utils/__init__.py:99-118``): tracks
+fp32 AdamW closely, quarters the moment memory, and composes with the
+trainable-mask machinery through ``get_optimizer``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from trlx_tpu.utils import get_optimizer
+from trlx_tpu.utils.quantized_opt import (
+    BLOCK,
+    _dequantize,
+    _quantize,
+    adamw_8bit,
+)
+
+
+def test_quantize_roundtrip():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(3, 5000).astype(np.float32) * 3.0)
+    q = _quantize(x)
+    assert q.codes.dtype == jnp.int8 and q.codes.shape[1] == BLOCK
+    back = _dequantize(q, x.shape)
+    # blockwise absmax int8: ~1% relative error at block scale
+    assert float(jnp.max(jnp.abs(back - x))) <= float(jnp.max(jnp.abs(x))) / 127.0 + 1e-6
+
+
+def test_tracks_fp32_adamw():
+    rng = np.random.RandomState(1)
+    params = {
+        "w": jnp.asarray(rng.randn(64, 128).astype(np.float32) * 0.1),  # quantized
+        "b": jnp.asarray(rng.randn(32).astype(np.float32) * 0.1),  # small → fp32
+    }
+    opt8 = adamw_8bit(1e-2, weight_decay=0.01)
+    opt32 = optax.adamw(1e-2, weight_decay=0.01)
+    s8, s32 = opt8.init(params), opt32.init(params)
+    p8 = p32 = params
+
+    def grad_of(p, step):
+        return jax.tree_util.tree_map(
+            lambda x: jnp.cos(x + step * 0.1) * 0.5, p
+        )
+
+    for step in range(10):
+        g8, g32 = grad_of(p8, step), grad_of(p32, step)
+        u8, s8 = opt8.update(g8, s8, p8)
+        u32, s32 = opt32.update(g32, s32, p32)
+        p8 = optax.apply_updates(p8, u8)
+        p32 = optax.apply_updates(p32, u32)
+
+    for key in params:
+        a, b = np.asarray(p8[key]), np.asarray(p32[key])
+        drift = np.abs(a - b).max()
+        moved = np.abs(b - np.asarray(params[key])).max()
+        assert drift < 0.05 * max(moved, 1e-3), (key, drift, moved)
+
+
+def test_moment_memory_is_quartered():
+    params = {"w": jnp.zeros((512, 1024), jnp.float32)}
+    state = adamw_8bit(1e-3).init(params)
+
+    def nbytes(tree):
+        return sum(l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(tree))
+
+    fp32_state = optax.adamw(1e-3).init(params)
+    assert nbytes((state.mu, state.nu)) < 0.3 * nbytes(
+        (fp32_state[0].mu, fp32_state[0].nu)
+    )
+
+
+def test_get_optimizer_dispatch_and_masking():
+    params = {
+        "big": jnp.ones((128, 64), jnp.float32),
+        "frozen": jnp.ones((128, 64), jnp.float32),
+    }
+    mask = {"big": True, "frozen": False}
+    for name in ("adamw_8bit", "adamw_8bit_bnb"):
+        opt = get_optimizer(name, {"lr": 1e-2, "betas": (0.9, 0.95)}, mask=mask)
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        updates, _ = opt.update(grads, state, params)
+        new = optax.apply_updates(params, updates)
+        assert np.abs(np.asarray(new["big"]) - 1.0).max() > 1e-4
+        np.testing.assert_array_equal(np.asarray(new["frozen"]), 1.0)
+
+
+def test_sft_trains_with_8bit_optimizer(tmp_path):
+    """End-to-end: a trainer built with optimizer=adamw_8bit_bnb learns."""
+    from trlx_tpu.data.default_configs import default_sft_config
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.sft  # noqa: F401
+
+    cfg = default_sft_config().evolve(
+        train=dict(
+            seq_length=32, batch_size=8, total_steps=2, eval_interval=100,
+            checkpoint_interval=100, epochs=1,
+            checkpoint_dir=str(tmp_path / "ck"), tracker=None,
+        ),
+        model=dict(model_path="builtin:gpt2-test"),
+        optimizer=dict(name="adamw_8bit_bnb", kwargs=dict(lr=1e-3, weight_decay=1e-6)),
+    )
+    trainer = get_trainer(cfg.train.trainer)(
+        config=cfg, reward_fn=None, metric_fn=None, stop_sequences=[]
+    )
+    toks = np.random.RandomState(0).randint(5, 100, size=(8, 16)).astype(np.int32)
+    batch = {"input_ids": toks, "attention_mask": np.ones_like(toks), "labels": toks}
+    l0 = float(np.asarray(trainer.train_step(dict(batch))["losses/loss"]))
+    for _ in range(4):
+        stats = trainer.train_step(dict(batch))
+    l1 = float(np.asarray(stats["losses/loss"]))
+    assert np.isfinite(l1) and l1 < l0
+
+
+def test_opt_state_shardings_structural(tmp_path):
+    """Moment tensors take their param's sharding via path matching (not
+    shape matching — GPT-2's square o_proj would collide), and quantized
+    int8 moments shard their block dim instead of replicating."""
+    from trlx_tpu.data.default_configs import default_sft_config
+    from trlx_tpu.data.configs import ParallelConfig
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.sft  # noqa: F401
+
+    cfg = default_sft_config().evolve(
+        train=dict(
+            seq_length=32, batch_size=8, total_steps=1, eval_interval=100,
+            checkpoint_interval=100, epochs=1,
+            checkpoint_dir=str(tmp_path / "ck"), tracker=None,
+        ),
+        # gpt2-test has H*D == E: square attn kernels catch shape collisions
+        model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=-1),
+        parallel=dict(data=2, fsdp=2, model=2),
+        optimizer=dict(name="adamw_8bit", kwargs=dict(lr=1e-3)),
+    )
+    trainer = get_trainer(cfg.train.trainer)(
+        config=cfg, reward_fn=None, metric_fn=None, stop_sequences=[]
+    )
+    flat = {
+        "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        ): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            trainer.state.opt_state
+        )[0]
+    }
+    # large quantized moments shard their block dim whenever it divides an
+    # fsdp/model axis combination (odd block counts — e.g. the 259-vocab
+    # embedding — legitimately replicate)
+    big_codes = [
+        (p, l) for p, l in flat.items() if p.endswith("codes") and l.size > 4096
+    ]
+    assert big_codes
+    sharded = 0
+    for p, l in big_codes:
+        assert len(l.sharding.device_set) == 8, p
+        spec = tuple(l.sharding.spec)
+        if l.shape[0] % 2 == 0:
+            assert spec and spec[0] is not None, (p, spec)
+            sharded += 1
+    # at most the odd-block embedding's mu and nu replicate
+    assert sharded >= len(big_codes) - 2
+
+    # param-mirrored fp32 moments (small leaves) follow their param sharding:
+    # check a norm scale moment replicates while... all small are fp32; check
+    # that at least the structure produced mesh-wide placements everywhere
+    assert all(len(l.sharding.device_set) == 8 for l in flat.values())

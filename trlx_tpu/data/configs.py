@@ -201,6 +201,19 @@ class TrainConfig:
     # micro-vs-global batch, ``megatron_20b.yaml:51-52``).
     grad_accum: int = 1
 
+    # When set, a jax.profiler trace of optimization steps 2-5 (XLA ops,
+    # device timelines; viewable in XProf/TensorBoard) is written here — the
+    # TPU-native counterpart of the reference's Nsight hooks
+    # (``megatron_20b.yaml:127-132``; SURVEY.md §5 tracing).
+    profile_dir: Optional[str] = None
+
+    # Crash/preemption recovery: when True, learn() restores the newest
+    # interval checkpoint under checkpoint_dir (full TrainState + iteration
+    # counter) before training — relaunch the same command and the run
+    # continues (reference analogues: Ray session restore,
+    # ``accelerate_base_trainer.py:452-460``; NeMo ``resume_if_exists``).
+    resume_from_checkpoint: bool = False
+
     from_dict = classmethod(_strict_from_dict)
 
 

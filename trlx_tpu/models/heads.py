@@ -64,6 +64,7 @@ class CausalLMWithValueHead(nn.Module):
         cache=None,
         cache_index=None,
         branch_layer: Optional[int] = None,
+        logits_span: Optional[Tuple[int, int]] = None,
     ) -> Dict[str, Any]:
         out = self.backbone(
             input_ids,
@@ -72,12 +73,17 @@ class CausalLMWithValueHead(nn.Module):
             cache=cache,
             cache_index=cache_index,
             branch_layer=branch_layer,
+            logits_span=logits_span,
         )
         out["value"] = self.v_head(out["hidden_states"])[..., 0]
         return out
 
-    def forward_branch(self, hidden_states, branch_layer, attention_mask=None, positions=None):
-        return self.backbone.forward_branch(hidden_states, branch_layer, attention_mask, positions)
+    def forward_branch(
+        self, hidden_states, branch_layer, attention_mask=None, positions=None, logits_span=None
+    ):
+        return self.backbone.forward_branch(
+            hidden_states, branch_layer, attention_mask, positions, logits_span
+        )
 
     def init_cache(self, batch_size, max_length, dtype=None):
         return self.backbone.init_cache(batch_size, max_length, dtype)
@@ -130,11 +136,17 @@ class CausalLMWithILQLHeads(nn.Module):
         positions: Optional[jax.Array] = None,
         cache=None,
         cache_index=None,
+        logits_span: Optional[Tuple[int, int]] = None,
     ) -> Dict[str, Any]:
         out = self.backbone(
-            input_ids, attention_mask=attention_mask, positions=positions, cache=cache, cache_index=cache_index
+            input_ids, attention_mask=attention_mask, positions=positions,
+            cache=cache, cache_index=cache_index, logits_span=logits_span,
         )
-        qs, target_qs, vs = self.ilql_heads(out["hidden_states"])
+        # the vocab-sized Q heads are as expensive as the lm head — restrict
+        # them to the same span (V stays full: values are per-state scalars)
+        hs = out["hidden_states"]
+        hs_q = hs if logits_span is None else hs[:, logits_span[0] : logits_span[1]]
+        qs, target_qs, vs = self.ilql_heads.heads_on(hs_q, hs)
         out.update(qs=qs, target_qs=target_qs, vs=vs)
         return out
 

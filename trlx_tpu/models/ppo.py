@@ -216,3 +216,23 @@ def kl_penalty_rewards(
     mean_kl = jnp.mean(k3)  # per-token mean (controller input)
     mean_kl_per_seq = jnp.mean(jnp.sum(k3 * mask, axis=1))
     return rewards * mask, (mean_kl, mean_kl_per_seq)
+
+
+def kl_penalty_rewards_np(logprobs, ref_logprobs, response_mask, scores, kl_coef):
+    """Host (numpy) twin of :func:`kl_penalty_rewards` — same math on the
+    already-fetched [B, R] arrays. The reward assembly depends on the
+    host-side ``reward_fn`` scores, so computing it here lets the scoring
+    forward be dispatched *before* the host scores exist, collapsing the
+    rollout loop to a single device→host sync per batch (the sync dominates
+    wall time on tunneled/remote TPU setups)."""
+    import numpy as np
+
+    mask = np.asarray(response_mask, np.float32)
+    log_ratio = (np.asarray(logprobs) - np.asarray(ref_logprobs)) * mask
+    rewards = -float(kl_coef) * log_ratio
+    ends = np.maximum(mask.sum(axis=1).astype(np.int32) - 1, 0)
+    rewards[np.arange(rewards.shape[0]), ends] += np.asarray(scores, np.float32)
+    k3 = (np.exp(log_ratio) - 1) - log_ratio
+    mean_kl = float(k3.mean())
+    mean_kl_per_seq = float((k3 * mask).sum(axis=1).mean())
+    return rewards * mask, (mean_kl, mean_kl_per_seq)

@@ -677,6 +677,10 @@ class CausalTransformer(nn.Module):
         cache: Optional[List[Dict[str, jax.Array]]] = None,
         cache_index: Optional[jax.Array] = None,
         branch_layer: Optional[int] = None,
+        logits_span: Optional[Tuple[int, int]] = None,  # static [a, b): lm-head
+        # projection restricted to these positions — the vocab matmul is the
+        # single biggest op in PPO scoring/training forwards and only the
+        # response span is consumed there
     ) -> Dict[str, Any]:
         cfg = self.config
         B, T = input_ids.shape
@@ -741,7 +745,7 @@ class CausalTransformer(nn.Module):
             h = self.ln_f(x)
         else:
             h = x
-        logits = self._logits(h)
+        logits = self._logits(h if logits_span is None else h[:, logits_span[0] : logits_span[1]])
         return {
             "logits": logits,
             "hidden_states": h,
@@ -756,6 +760,7 @@ class CausalTransformer(nn.Module):
         branch_layer: int,
         attention_mask: Optional[jax.Array] = None,
         positions: Optional[jax.Array] = None,
+        logits_span: Optional[Tuple[int, int]] = None,
     ) -> Dict[str, Any]:
         """Run the top ``branch_layer`` blocks + final norm + lm head.
 
@@ -799,7 +804,8 @@ class CausalTransformer(nn.Module):
             for block in self.blocks[len(self.blocks) - branch_layer :]:
                 x, _ = block(x, bias, positions, flash_args=flash_args)
         h = self.ln_f(x) if cfg.final_norm else x
-        return {"logits": self._logits(h), "hidden_states": h}
+        logits = self._logits(h if logits_span is None else h[:, logits_span[0] : logits_span[1]])
+        return {"logits": logits, "hidden_states": h}
 
     def init_cache(self, batch_size: int, max_length: int, dtype=None) -> List[Dict[str, jax.Array]]:
         """Allocate an all-zeros KV cache pytree."""

@@ -1,0 +1,100 @@
+"""ctypes bindings for the native host runtime (``host_runtime.cpp``).
+
+Compiled lazily with g++ on first use (content-hashed cache under
+``$TRLX_TPU_NATIVE_CACHE`` or the system temp dir) and loaded via ctypes —
+the image ships no pybind11, and a 2-function C ABI needs none. Every
+call-site must tolerate :func:`available` being False (no compiler /
+sandboxed FS) and fall back to the numpy path.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_LOAD_FAILED = False
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "host_runtime.cpp")
+
+_I64P = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_I32P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_F32P = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _LOAD_FAILED
+    if _LIB is not None or _LOAD_FAILED:
+        return _LIB
+    try:
+        cache_dir = os.environ.get(
+            "TRLX_TPU_NATIVE_CACHE",
+            os.path.join(tempfile.gettempdir(), "trlx_tpu_native"),
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        tag = hashlib.sha1(open(_SRC, "rb").read()).hexdigest()[:12]
+        so_path = os.path.join(cache_dir, f"host_runtime_{tag}.so")
+        if not os.path.exists(so_path):
+            tmp = f"{so_path}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, so_path)
+        lib = ctypes.CDLL(so_path)
+        lib.pad_rows_i32.argtypes = [
+            _I32P, _I64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int, _I32P, _I32P,
+        ]
+        lib.pad_rows_f32.argtypes = [
+            _F32P, _I64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_float,
+            ctypes.c_int, _F32P, _I32P,
+        ]
+        _LIB = lib
+    except Exception:
+        _LOAD_FAILED = True
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def pad_rows_native(
+    rows: Sequence[np.ndarray],
+    pad_value,
+    side: str,
+    length: int,
+    dtype,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Ragged rows → ([B, length] padded, [B, length] int32 mask), or None
+    when the native library is unavailable (caller falls back to numpy)."""
+    lib = _load()
+    if lib is None:
+        return None
+    dtype = np.dtype(dtype)
+    if dtype == np.int32:
+        fn, ctype = lib.pad_rows_i32, np.int32
+    elif dtype == np.float32:
+        fn, ctype = lib.pad_rows_f32, np.float32
+    else:
+        return None
+    n = len(rows)
+    arrays = [np.ascontiguousarray(np.asarray(r, ctype).reshape(-1)) for r in rows]
+    lengths = np.asarray([a.shape[0] for a in arrays], np.int64)
+    flat = (
+        np.concatenate(arrays)
+        if arrays
+        else np.zeros((0,), ctype)
+    )
+    if flat.size == 0:
+        flat = np.zeros((1,), ctype)  # valid pointer for the empty case
+    out = np.empty((n, length), ctype)
+    mask = np.empty((n, length), np.int32)
+    fn(flat, lengths, n, length, pad_value, 1 if side == "left" else 0, out, mask)
+    return out, mask

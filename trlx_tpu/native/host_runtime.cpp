@@ -1,0 +1,57 @@
+// Native host runtime: the hot host-side data-path primitives.
+//
+// The reference delegates its host data path to native code in dependencies
+// (torch's C++ DataLoader/collate machinery; SURVEY.md §2.4). Here the
+// equivalent is explicit: ragged→padded batch collation (every rollout store
+// and pipeline funnels through it, once per training batch) implemented in
+// C++ and bound via ctypes (no pybind11 in the image). The Python fallback
+// in trlx_tpu/pipeline/offline_pipeline.py stays behaviorally identical.
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 (driven by trlx_tpu/native/__init__.py).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+template <typename T>
+void pad_rows_impl(const T* flat, const int64_t* lengths, int64_t n_rows,
+                   int64_t length, T pad_value, int left, T* out,
+                   int32_t* mask) {
+  int64_t offset = 0;
+  for (int64_t i = 0; i < n_rows; ++i) {
+    const int64_t len = lengths[i];
+    const T* src = flat + offset;
+    offset += len;
+    const int64_t keep = std::min(len, length);
+    // truncation keeps the side adjacent to the content: left-padding keeps
+    // the END of the row, right-padding keeps the start
+    const T* kept = left ? src + (len - keep) : src;
+    T* orow = out + i * length;
+    int32_t* mrow = mask + i * length;
+    std::fill(orow, orow + length, pad_value);
+    std::fill(mrow, mrow + length, 0);
+    const int64_t start = left ? (length - keep) : 0;
+    std::memcpy(orow + start, kept, sizeof(T) * static_cast<size_t>(keep));
+    std::fill(mrow + start, mrow + start + keep, 1);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void pad_rows_i32(const int32_t* flat, const int64_t* lengths, int64_t n_rows,
+                  int64_t length, int32_t pad_value, int left, int32_t* out,
+                  int32_t* mask) {
+  pad_rows_impl<int32_t>(flat, lengths, n_rows, length, pad_value, left, out, mask);
+}
+
+void pad_rows_f32(const float* flat, const int64_t* lengths, int64_t n_rows,
+                  int64_t length, float pad_value, int left, float* out,
+                  int32_t* mask) {
+  pad_rows_impl<float>(flat, lengths, n_rows, length, pad_value, left, out, mask);
+}
+
+}  // extern "C"

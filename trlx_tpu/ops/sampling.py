@@ -156,6 +156,8 @@ def generate(
     )
 
     # ---- prefill ----
+    # only the last position's logits seed the sampler: restrict the vocab
+    # projection to it (the full-span projection is the prefill's biggest op)
     prefill_out = apply_fn(
         params,
         input_ids,
@@ -163,6 +165,7 @@ def generate(
         positions=None,
         cache=cache,
         cache_index=jnp.asarray(0, jnp.int32),
+        logits_span=(P - 1, P),
     )
     cache = prefill_out["cache"]
     last_logits = prefill_out["logits"][:, -1, :]  # [B, V]

@@ -33,9 +33,12 @@ _RULES: Tuple[Tuple[str, P], ...] = (
     # row-parallel (input features on `model`); bias replicated
     (r".*/(o_proj|down_proj)/kernel$", P("model", "fsdp")),
     (r".*/(o_proj|down_proj)/bias$", P(None)),
-    # vocab-parallel embedding and lm head
-    (r".*/wte/embedding$", P("model", "fsdp")),
-    (r".*/wpe/embedding$", P(None, "fsdp")),
+    # vocab-parallel embedding (Megatron-style: vocab over model×fsdp, embed
+    # replicated — lookups then yield cleanly batch-sharded activations; an
+    # embed-dim-sharded table instead forces a replicate-and-repartition on
+    # every lookup output) and lm head
+    (r".*/wte/embedding$", P(("model", "fsdp"), None)),
+    (r".*/wpe/embedding$", P(None, None)),
     (r".*/lm_head/kernel$", P("fsdp", "model")),
     (r".*/lm_head/bias$", P("model")),
     # MLP heads (value / Q): column-parallel in, row-parallel out
@@ -48,9 +51,14 @@ _RULES: Tuple[Tuple[str, P], ...] = (
 )
 
 
-def _axis_size(mesh: Mesh, name: Optional[str]) -> int:
+def _axis_size(mesh: Mesh, name) -> int:
     if name is None:
         return 1
+    if isinstance(name, tuple):  # combined axes, e.g. ("model", "fsdp")
+        size = 1
+        for axis in name:
+            size *= mesh.shape[axis]
+        return size
     return mesh.shape[name]
 
 

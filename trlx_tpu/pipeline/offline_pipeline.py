@@ -48,9 +48,21 @@ def pad_rows(
     fixed_length: Optional[int] = None,
     dtype=np.int32,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Stack ragged rows into a [B, L] array + mask, L bucketed or fixed."""
+    """Stack ragged rows into a [B, L] array + mask, L bucketed or fixed.
+
+    Dispatches to the C++ host runtime (``trlx_tpu/native``) when compiled —
+    collation runs once per training batch on the host critical path — with
+    this numpy loop as the behaviorally-identical fallback.
+    """
     longest = max((len(r) for r in rows), default=1)
     length = fixed_length if fixed_length is not None else round_up(longest, pad_multiple)
+
+    from trlx_tpu import native
+
+    native_out = native.pad_rows_native(rows, pad_value, side, length, dtype)
+    if native_out is not None:
+        return native_out
+
     out = np.full((len(rows), length), pad_value, dtype=dtype)
     mask = np.zeros((len(rows), length), dtype=np.int32)
     for i, row in enumerate(rows):

@@ -71,6 +71,49 @@ class TrainState:
     rng: jax.Array
 
 
+def _path_keys(path) -> Tuple[str, ...]:
+    return tuple(
+        str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k)))) for k in path
+    )
+
+
+def _optimizer_state_shardings(mesh, params: Any, abstract_opt: Any) -> Any:
+    """Sharding pytree for an optimizer state, matched *structurally*: optax
+    moment trees mirror the params pytree, so an opt-state leaf whose path
+    suffix is a param path (and whose shape agrees) takes that param's
+    sharding. Blockwise-quantized int8 moments (``codes``/``scales`` under a
+    param path) shard their block dim over the largest dividing combination
+    of the fsdp/model axes; everything else (counts, schedules) replicates.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    replicated = NamedSharding(mesh, PartitionSpec())
+    param_by_path = {
+        _path_keys(path): (leaf.shape, leaf.sharding)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+
+    def leaf_sharding(path, leaf):
+        keys = _path_keys(path)
+        # longest suffix first: the full opt path carries wrapper prefixes
+        # (inner_states/<label>/0/mu/...) before the mirrored param path
+        for start in range(len(keys)):
+            hit = param_by_path.get(keys[start:])
+            if hit is not None and hit[0] == leaf.shape:
+                return hit[1]
+        if keys and keys[-1] in ("codes", "scales") and len(leaf.shape) == 2:
+            for axes in (("fsdp", "model"), ("fsdp",), ("model",)):
+                size = 1
+                for a in axes:
+                    size *= mesh.shape[a]
+                if size > 1 and leaf.shape[0] % size == 0:
+                    spec = axes if len(axes) > 1 else axes[0]
+                    return NamedSharding(mesh, PartitionSpec(spec, None))
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, abstract_opt)
+
+
 class TPUBaseTrainer(BaseRLTrainer):
     """Shared learn-loop trainer over a global device mesh.
 
@@ -148,14 +191,26 @@ class TPUBaseTrainer(BaseRLTrainer):
             schedule=self.schedule,
             mask=self.param_mask,
         )
-        opt_state = jax.jit(self.optimizer.init)(params)
+        # Optimizer state gets *explicit* shardings: moment tensors follow
+        # their parameter's sharding (FSDP: ZeRO-sharded optimizer state),
+        # quantized int8 moments shard their block dim, scalars/bookkeeping
+        # replicate. Without out_shardings the compiler may leave the whole
+        # state on one device — and checkpoint restore then commits that
+        # placement, breaking later steps.
+        opt_shardings = _optimizer_state_shardings(
+            self.mesh, params, jax.eval_shape(self.optimizer.init, params)
+        )
+        opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        replicated = NamedSharding(self.mesh, PartitionSpec())
         rng = jax.random.PRNGKey(config.train.seed)
         rollout_rng, state_rng = jax.random.split(rng)
         self.state = TrainState(
             params=params,
             opt_state=opt_state,
-            step=jnp.zeros((), jnp.int32),
-            rng=state_rng,
+            step=jax.device_put(jnp.zeros((), jnp.int32), replicated),
+            rng=jax.device_put(state_rng, replicated),
         )
         self._rollout_rng = rollout_rng
 
@@ -554,6 +609,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         set_global_mesh(self.mesh)
         logger.info("Starting training")
         self.prepare_learning()
+        self._maybe_resume()
 
         results = self.evaluate()
         self.tracker.log(results, step=self.iter_count)
@@ -569,9 +625,17 @@ class TPUBaseTrainer(BaseRLTrainer):
             leave=True,
         )
 
+        profile_dir = getattr(self.config.train, "profile_dir", None)
+        profiling = False
         for _ in range(self.config.train.epochs):
             for batch in self.train_dataloader:
                 for _ in range(self.n_updates_per_batch):
+                    if profile_dir and self.iter_count == 1 and not profiling:
+                        jax.profiler.start_trace(profile_dir)
+                        profiling = True
+                    if profiling and self.iter_count >= 5:
+                        jax.profiler.stop_trace()
+                        profiling = False
                     forward_time = time()
                     device_stats = self.train_step(batch)
                     stats = filter_non_scalars(to_host(device_stats))
@@ -613,6 +677,9 @@ class TPUBaseTrainer(BaseRLTrainer):
                     tbar.update()
 
                     if self.iter_count >= self.total_steps:
+                        if profiling:
+                            jax.profiler.stop_trace()
+                            profiling = False
                         results = self.evaluate()
                         stats.update(results)
                         self.tracker.log(stats, step=self.iter_count)
@@ -626,12 +693,49 @@ class TPUBaseTrainer(BaseRLTrainer):
 
                 self.post_backward_callback()
             self.post_epoch_callback()
+        if profiling:
+            jax.profiler.stop_trace()
         tbar.close()
         return results
 
     # ------------------------------------------------------------------
     # checkpointing
     # ------------------------------------------------------------------
+
+    def _maybe_resume(self) -> None:
+        """Restore the newest interval checkpoint when
+        ``train.resume_from_checkpoint`` is set — relaunching a crashed or
+        preempted run picks up where it left off (reference: Ray session
+        restore ``accelerate_base_trainer.py:452-460``; NeMo
+        ``resume_if_exists``)."""
+        if not getattr(self.config.train, "resume_from_checkpoint", False):
+            return
+        root = self.config.train.checkpoint_dir
+        if not os.path.isdir(root):
+            return
+        def step_of(name: str) -> int:
+            try:
+                return int(name.rsplit("_", 1)[1])
+            except ValueError:
+                return -1
+
+        # numeric sort: zero-padding width follows total_steps, so a resumed
+        # run with a different total_steps would break a lexicographic sort
+        candidates = sorted(
+            (
+                d
+                for d in os.listdir(root)
+                if d.startswith("checkpoint_")
+                and step_of(d) >= 0
+                and os.path.isdir(os.path.join(root, d, "state"))
+            ),
+            key=step_of,
+        )
+        if not candidates:
+            return
+        path = os.path.join(root, candidates[-1])
+        logger.info(f"Resuming training state from {path}")
+        self.load(path)
 
     def save(self, directory: Optional[str] = None, **kwargs) -> None:
         """Checkpoint full training state (params, opt state, step, RNG)."""

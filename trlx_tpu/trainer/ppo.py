@@ -30,7 +30,7 @@ import numpy as np
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.ppo_types import PPORLElement
 from trlx_tpu.models.builder import hydra_ref_params
-from trlx_tpu.models.ppo import PPOConfig, kl_penalty_rewards
+from trlx_tpu.models.ppo import PPOConfig, kl_penalty_rewards_np
 from trlx_tpu.models.transformer import CausalTransformer
 from trlx_tpu.parallel import shard_batch
 from trlx_tpu.pipeline import BasePipeline
@@ -122,9 +122,15 @@ class PPOTrainer(TPUBaseTrainer):
 
     def _get_score_fn(self, batch_shape: Tuple[int, int, int]):
         """Jitted scoring program for a (B, P, N) shape bucket: one policy
-        forward (logits + values + trunk activations), one frozen-reference
-        forward (hydra branch replay or full copy), per-token KL-penalty
-        rewards."""
+        forward (logits + values + trunk activations) and one frozen-reference
+        forward (hydra branch replay or full copy), returning per-token
+        logprobs / ref logprobs / values.
+
+        Deliberately score-free: it is dispatched the moment generation
+        finishes and its outputs copy to host asynchronously, so the device
+        scoring forward + transfer genuinely overlap the host-side string
+        decode and ``reward_fn``; the KL-penalty reward assembly then runs
+        on host (:func:`trlx_tpu.models.ppo.kl_penalty_rewards_np`)."""
         if batch_shape in self._score_fns:
             return self._score_fns[batch_shape]
 
@@ -137,7 +143,7 @@ class PPOTrainer(TPUBaseTrainer):
             start_id = self.tcfg.decoder_start_token_id
 
             def score_fn(params, ref_params, sequences, prompt_mask, response_tokens,
-                         response_mask, scores, kl_coef):
+                         response_mask):
                 # encoder side: the prompt; decoder side: teacher-forced
                 # responses shifted right behind the start token (reference
                 # seq2seq scoring, ``accelerate_ppo_trainer.py:369-398``)
@@ -180,16 +186,10 @@ class PPOTrainer(TPUBaseTrainer):
                         decoder_attention_mask=dec_mask,
                     )
                 ref_logprobs = logprobs_of_labels(ref_out["logits"], response_tokens)
-
-                rewards, (mean_kl, mean_kl_per_seq) = kl_penalty_rewards(
-                    logprobs, ref_logprobs, response_mask, scores, kl_coef
-                )
                 return {
                     "logprobs": logprobs,
                     "values": values,
-                    "rewards": rewards,
-                    "mean_kl": mean_kl,
-                    "mean_kl_per_seq": mean_kl_per_seq,
+                    "ref_logprobs": ref_logprobs,
                 }
 
             fn = jax.jit(score_fn)
@@ -197,18 +197,20 @@ class PPOTrainer(TPUBaseTrainer):
             return fn
 
         def score_fn(params, ref_params, sequences, prompt_mask, response_tokens,
-                     response_mask, scores, kl_coef):
+                     response_mask):
             full_mask = jnp.concatenate([prompt_mask, response_mask], axis=1)
+            # logits at t predict token t+1: response token i lives at column
+            # P+i, so its logprob/value come from position P-1+i; the vocab
+            # projection is restricted to exactly that span (logits_span)
+            span = (P - 1, P + N - 1)
             out = module.apply(
                 {"params": params},
                 sequences,
                 attention_mask=full_mask,
                 branch_layer=nlu if nlu > 0 else None,
+                logits_span=span,
             )
-            # logits at t predict token t+1: response token i lives at column
-            # P+i, so its logprob/value come from position P-1+i
-            logits = out["logits"][:, P - 1 : P + N - 1, :]
-            logprobs = logprobs_of_labels(logits, response_tokens)
+            logprobs = logprobs_of_labels(out["logits"], response_tokens)
             values = out["value"][:, P - 1 : P + N - 1]
 
             if nlu > 0:
@@ -217,24 +219,20 @@ class PPOTrainer(TPUBaseTrainer):
                     out["branch_input"],
                     nlu,
                     full_mask,
+                    None,
+                    span,
                     method=type(module).forward_branch,
                 )
             else:
                 ref_out = ref_module.apply(
-                    {"params": ref_params}, sequences, attention_mask=full_mask
+                    {"params": ref_params}, sequences, attention_mask=full_mask,
+                    logits_span=span,
                 )
-            ref_logits = ref_out["logits"][:, P - 1 : P + N - 1, :]
-            ref_logprobs = logprobs_of_labels(ref_logits, response_tokens)
-
-            rewards, (mean_kl, mean_kl_per_seq) = kl_penalty_rewards(
-                logprobs, ref_logprobs, response_mask, scores, kl_coef
-            )
+            ref_logprobs = logprobs_of_labels(ref_out["logits"], response_tokens)
             return {
                 "logprobs": logprobs,
                 "values": values,
-                "rewards": rewards,
-                "mean_kl": mean_kl,
-                "mean_kl_per_seq": mean_kl_per_seq,
+                "ref_logprobs": ref_logprobs,
             }
 
         fn = jax.jit(score_fn)
@@ -260,8 +258,37 @@ class PPOTrainer(TPUBaseTrainer):
 
             gen_time = time()
             gen_out = self.generate(prompt_ids, prompt_mask)
-            response_tokens = to_host(gen_out.response_tokens)
-            response_mask = to_host(gen_out.response_mask)
+
+            # dispatch the scoring forward immediately on the generation's
+            # device arrays — it needs nothing from the host, so it runs
+            # while the host decodes strings and calls reward_fn below
+            B, P = prompt_ids.shape
+            N = int(gen_out.response_tokens.shape[1])
+            score_fn = self._get_score_fn((B, P, N))
+            score_out = score_fn(
+                self.state.params,
+                self.ref_params,
+                gen_out.sequences,
+                shard_batch({"prompt_mask": prompt_mask}, self.mesh)["prompt_mask"],
+                gen_out.response_tokens,
+                gen_out.response_mask,
+            )
+
+            # start the device→host copies of the scoring outputs without
+            # blocking, then fetch the (already finished) generation outputs;
+            # the string decode + reward_fn below genuinely overlap the
+            # scoring forward and its transfer
+            for leaf in jax.tree_util.tree_leaves(score_out):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+            host_gen = to_host(
+                {
+                    "response_tokens": gen_out.response_tokens,
+                    "response_mask": gen_out.response_mask,
+                }
+            )
+            response_tokens = np.asarray(host_gen["response_tokens"])
+            response_mask = np.asarray(host_gen["response_mask"])
             stats["time/exp_generate"] = time() - gen_time
 
             samples, prompts, outputs = self.decode(
@@ -274,6 +301,7 @@ class PPOTrainer(TPUBaseTrainer):
                 dtype=np.float32,
             )
             stats["time/exp_score"] = time() - score_time
+            host = to_host(score_out)  # usually landed already (async copy)
 
             # reward scaling/clipping (reference :350-366)
             scores_mean, scores_std = self.running_moments.update(scores)
@@ -289,34 +317,15 @@ class PPOTrainer(TPUBaseTrainer):
             if clip:
                 scores = np.clip(scores, -clip, clip)
 
-            B, P = prompt_ids.shape
-            N = response_tokens.shape[1]
-            score_fn = self._get_score_fn((B, P, N))
-            device_batch = shard_batch(
-                {
-                    "sequences": np.asarray(to_host(gen_out.sequences), np.int32),
-                    "prompt_mask": prompt_mask,
-                    "response_tokens": response_tokens,
-                    "response_mask": response_mask,
-                    "scores": scores,
-                },
-                self.mesh,
+            # KL-penalty reward assembly on host (numpy twin of the device
+            # math; [B, N] arrays — microseconds)
+            rewards, (mean_kl, mean_kl_per_seq) = kl_penalty_rewards_np(
+                host["logprobs"], host["ref_logprobs"], response_mask,
+                scores, self.kl_ctl.value,
             )
-            out = to_host(
-                score_fn(
-                    self.state.params,
-                    self.ref_params,
-                    device_batch["sequences"],
-                    device_batch["prompt_mask"],
-                    device_batch["response_tokens"],
-                    device_batch["response_mask"],
-                    device_batch["scores"],
-                    jnp.float32(self.kl_ctl.value),
-                )
-            )
-            kl_sum += float(out["mean_kl"])
+            kl_sum += mean_kl
             kl_batches += 1
-            stats["policy/sqrt_kl"] = float(np.sqrt(max(out["mean_kl"], 0.0)))
+            stats["policy/sqrt_kl"] = float(np.sqrt(max(mean_kl, 0.0)))
 
             for i in range(B):
                 n_i = int(response_mask[i].sum())
@@ -327,9 +336,9 @@ class PPOTrainer(TPUBaseTrainer):
                     PPORLElement(
                         query_tensor=query,
                         response_tensor=response_tokens[i, :n_i],
-                        logprobs=out["logprobs"][i, :n_i],
-                        values=out["values"][i, :n_i],
-                        rewards=out["rewards"][i, :n_i],
+                        logprobs=np.asarray(host["logprobs"][i, :n_i]),
+                        values=np.asarray(host["values"][i, :n_i]),
+                        rewards=rewards[i, :n_i],
                     )
                 )
 
@@ -401,10 +410,10 @@ class PPOTrainer(TPUBaseTrainer):
             [query_mask, batch["response_mask"]], axis=1
         )
         out = self.module.apply(
-            {"params": params}, input_ids, attention_mask=attention_mask
+            {"params": params}, input_ids, attention_mask=attention_mask,
+            logits_span=(Q - 1, Q + R - 1),
         )
-        logits = out["logits"][:, Q - 1 : Q + R - 1, :]
-        logprobs = logprobs_of_labels(logits, responses)
+        logprobs = logprobs_of_labels(out["logits"], responses)
         values_pred = out["value"][:, Q - 1 : Q + R - 1]
 
         return method.loss(

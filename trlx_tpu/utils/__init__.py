@@ -136,8 +136,9 @@ class OptimizerName(str, Enum):
     ADAFACTOR = "adafactor"
     LION = "lion"
     SGD = "sgd"
-    # accepted for config compatibility with the reference's bnb option;
-    # maps to plain adamw (there is no 8-bit optimizer state on TPU yet)
+    # int8 blockwise-quantized moments (trlx_tpu/utils/quantized_opt.py);
+    # the bnb-suffixed name is accepted for reference config compatibility
+    ADAMW_8BIT = "adamw_8bit"
     ADAMW_8BIT_BNB = "adamw_8bit_bnb"
 
 
@@ -251,14 +252,19 @@ def get_optimizer(
     if betas is not None and name in (
         OptimizerName.ADAM,
         OptimizerName.ADAMW,
+        OptimizerName.ADAMW_8BIT,
         OptimizerName.ADAMW_8BIT_BNB,
         OptimizerName.LION,
     ):
         kwargs.setdefault("b1", betas[0])
         kwargs.setdefault("b2", betas[1])
 
-    if name in (OptimizerName.ADAMW, OptimizerName.ADAMW_8BIT_BNB):
+    if name == OptimizerName.ADAMW:
         opt = optax.adamw(learning_rate, **kwargs)
+    elif name in (OptimizerName.ADAMW_8BIT, OptimizerName.ADAMW_8BIT_BNB):
+        from trlx_tpu.utils.quantized_opt import adamw_8bit
+
+        opt = adamw_8bit(learning_rate, **kwargs)
     elif name == OptimizerName.ADAM:
         kwargs.pop("weight_decay", None)
         opt = optax.adam(learning_rate, **kwargs)

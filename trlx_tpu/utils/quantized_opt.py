@@ -1,0 +1,123 @@
+"""8-bit AdamW: blockwise-quantized optimizer moments in pure JAX.
+
+The reference exposes bitsandbytes' ``adamw_8bit_bnb`` (CUDA kernels,
+``trlx/utils/__init__.py:99-118``) to halve-ish optimizer memory; this is the
+TPU-native equivalent: both Adam moments are stored as int8 with per-block
+fp32 scales (dynamic blockwise absmax quantization, the same scheme bnb
+uses), dequantized/requantized inside the jitted update. For a parameter
+tensor of n elements the optimizer state is 2·n bytes + 2·n/block fp32
+scales instead of 8·n bytes — a 4× reduction, which at 20B params is ~120GB
+of HBM back.
+
+Everything is elementwise + reshapes, so XLA fuses the (de)quantization into
+the update loop; there is no kernel to hand-write.
+
+Numerics: absmax int8 quantization of ``exp_avg`` (signed) and sqrt-space
+quantization of ``exp_avg_sq`` (non-negative; storing sqrt halves the
+relative error where it matters, near the Adam denominator). Tiny tensors
+(≤ one block) stay fp32 — same policy as bnb's ``min_8bit_size``.
+"""
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+BLOCK = 2048
+MIN_8BIT_SIZE = 4096  # tensors smaller than this keep fp32 moments
+
+
+class _Quantized(NamedTuple):
+    """Blockwise-quantized tensor: int8 codes + per-block fp32 absmax."""
+
+    codes: jax.Array  # int8 [n_blocks, BLOCK] (padded)
+    scales: jax.Array  # f32 [n_blocks, 1]
+
+
+def _quantize(x: jax.Array) -> _Quantized:
+    flat = x.reshape(-1)
+    pad = (-flat.size) % BLOCK
+    flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(-1, BLOCK)
+    scales = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    safe = jnp.where(scales > 0, scales, 1.0)
+    codes = jnp.clip(jnp.round(blocks / safe * 127.0), -127, 127).astype(jnp.int8)
+    return _Quantized(codes, scales)
+
+
+def _dequantize(q: _Quantized, shape) -> jax.Array:
+    blocks = q.codes.astype(jnp.float32) / 127.0 * q.scales
+    n = 1
+    for d in shape:
+        n *= d
+    return blocks.reshape(-1)[:n].reshape(shape)
+
+
+class Adam8bitState(NamedTuple):
+    count: jax.Array
+    mu: Any  # pytree of _Quantized | f32 arrays (small leaves)
+    nu: Any  # pytree of _Quantized (sqrt-space) | f32 arrays
+
+
+def adamw_8bit(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """AdamW with int8 blockwise-quantized moments (reference:
+    bitsandbytes ``AdamW8bit``; here the quantization is fused by XLA)."""
+
+    def is_small(p) -> bool:
+        return p.size < MIN_8BIT_SIZE
+
+    def init_fn(params):
+        def init_mu(p):
+            if is_small(p):
+                return jnp.zeros_like(p, jnp.float32)
+            return _quantize(jnp.zeros(p.shape, jnp.float32))
+
+        mu = jax.tree_util.tree_map(init_mu, params)
+        nu = jax.tree_util.tree_map(init_mu, params)
+        return Adam8bitState(jnp.zeros((), jnp.int32), mu, nu)
+
+    def update_fn(updates, state, params):
+        if params is None:
+            raise ValueError("adamw_8bit requires params (for weight decay)")
+        count = state.count + 1
+        b1c = 1.0 - b1 ** count.astype(jnp.float32)
+        b2c = 1.0 - b2 ** count.astype(jnp.float32)
+        lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
+
+        def leaf(g, mu_q, nu_q, p):
+            g = g.astype(jnp.float32)
+            if is_small(p):
+                mu = b1 * mu_q + (1 - b1) * g
+                nu = b2 * nu_q + (1 - b2) * g * g
+                new_mu, new_nu = mu, nu
+            else:
+                mu = b1 * _dequantize(mu_q, g.shape) + (1 - b1) * g
+                # nu stored in sqrt space: nu = (stored)^2
+                nu_prev = _dequantize(nu_q, g.shape) ** 2
+                nu = b2 * nu_prev + (1 - b2) * g * g
+                new_mu, new_nu = _quantize(mu), _quantize(jnp.sqrt(nu))
+            m_hat = mu / b1c
+            v_hat = nu / b2c
+            step = m_hat / (jnp.sqrt(v_hat) + eps)
+            if weight_decay:
+                step = step + weight_decay * p.astype(jnp.float32)
+            return (-lr * step).astype(p.dtype), new_mu, new_nu
+
+        flat_u, treedef = jax.tree_util.tree_flatten(updates)
+        flat_mu = treedef.flatten_up_to(state.mu)
+        flat_nu = treedef.flatten_up_to(state.nu)
+        flat_p = treedef.flatten_up_to(params)
+        outs = [leaf(g, m, n, p) for g, m, n, p in zip(flat_u, flat_mu, flat_nu, flat_p)]
+        new_updates = treedef.unflatten([o[0] for o in outs])
+        new_mu = treedef.unflatten([o[1] for o in outs])
+        new_nu = treedef.unflatten([o[2] for o in outs])
+        return new_updates, Adam8bitState(count, new_mu, new_nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
