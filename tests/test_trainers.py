@@ -267,3 +267,38 @@ def test_auto_resume_from_checkpoint(tmp_path):
     t2.learn()
     # resumed at 2, ran to 4 — and the restored params match t1's final state
     assert t2.iter_count == 4
+
+
+def test_ppo_resume_restores_controller_state(tmp_path):
+    """PPO resume must restore the adaptive KL coefficient and reward
+    running-moments (host-side controller state) and must restore the
+    policy BEFORE the first rollout collection via trlx.train()."""
+    import numpy as np
+
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.ppo  # noqa: F401
+
+    config = ppo_config(tmp_path).evolve(
+        train=dict(resume_from_checkpoint=True, checkpoint_interval=2, total_steps=2)
+    )
+    t1 = get_trainer(config.train.trainer)(
+        config=config, reward_fn=letter_reward, metric_fn=None, stop_sequences=[]
+    )
+    # drift the host-side controller state, then checkpoint
+    t1.kl_ctl.value = 0.123
+    t1.running_moments.update(np.asarray([1.0, 3.0, 5.0, 9.0]))
+    t1.iter_count = 2
+    t1.save(str(tmp_path / "ckpts" / "checkpoint_02"))
+
+    t2 = get_trainer(config.train.trainer)(
+        config=config, reward_fn=letter_reward, metric_fn=None, stop_sequences=[]
+    )
+    t2.maybe_resume()
+    assert t2.iter_count == 2
+    assert abs(t2.kl_ctl.value - 0.123) < 1e-9
+    assert abs(t2.running_moments.mean - t1.running_moments.mean) < 1e-9
+    assert abs(t2.running_moments.std - t1.running_moments.std) < 1e-9
+    # idempotent: a second call must not re-restore or reset anything
+    t2.kl_ctl.value = 0.5
+    t2.maybe_resume()
+    assert t2.kl_ctl.value == 0.5

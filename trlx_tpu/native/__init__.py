@@ -31,11 +31,15 @@ def _load() -> Optional[ctypes.CDLL]:
     if _LIB is not None or _LOAD_FAILED:
         return _LIB
     try:
+        # per-user, mode-0700 cache: a world-writable shared path would let
+        # another local user pre-plant a library at the predictable name
+        # that ctypes would then load into the training process
         cache_dir = os.environ.get(
             "TRLX_TPU_NATIVE_CACHE",
-            os.path.join(tempfile.gettempdir(), "trlx_tpu_native"),
+            os.path.join(tempfile.gettempdir(), f"trlx_tpu_native_{os.getuid()}"),
         )
         os.makedirs(cache_dir, exist_ok=True)
+        os.chmod(cache_dir, 0o700)
         tag = hashlib.sha1(open(_SRC, "rb").read()).hexdigest()[:12]
         so_path = os.path.join(cache_dir, f"host_runtime_{tag}.so")
         if not os.path.exists(so_path):
@@ -46,6 +50,8 @@ def _load() -> Optional[ctypes.CDLL]:
                 capture_output=True,
             )
             os.replace(tmp, so_path)
+        if os.stat(so_path).st_uid != os.getuid():
+            raise RuntimeError(f"refusing to load {so_path}: not owned by this user")
         lib = ctypes.CDLL(so_path)
         lib.pad_rows_i32.argtypes = [
             _I32P, _I64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,

@@ -35,8 +35,13 @@ _RULES: Tuple[Tuple[str, P], ...] = (
     (r".*/(o_proj|down_proj)/bias$", P(None)),
     # vocab-parallel embedding (Megatron-style: vocab over model×fsdp, embed
     # replicated — lookups then yield cleanly batch-sharded activations; an
-    # embed-dim-sharded table instead forces a replicate-and-repartition on
-    # every lookup output) and lm head
+    # embed-dim-sharded table instead forces a GSPMD involuntary
+    # replicate-and-repartition on every lookup output). Deliberate
+    # trade-off: when the vocab doesn't divide the axes (gpt2's prime-ish
+    # 50257) the table replicates rather than falling back to embed-dim
+    # sharding — the indivisible-vocab families top out ~1.5B params
+    # (≤0.5GB table), where replication is cheap and the lookup-layout win
+    # is measured; every 6B+ family (llama/neox/bloom/opt/gptj) divides.
     (r".*/wte/embedding$", P(("model", "fsdp"), None)),
     (r".*/wpe/embedding$", P(None, None)),
     (r".*/lm_head/kernel$", P("fsdp", "model")),
@@ -90,16 +95,24 @@ def param_spec_for_path(
     return P(*partitions)
 
 
-def _path_str(key_path) -> str:
+def path_keys(key_path) -> Tuple[str, ...]:
+    """jax key-path → tuple of key strings (shared by the rule matcher here
+    and the structural optimizer-state matcher in ``trainer/base.py``)."""
     parts = []
     for k in key_path:
         if hasattr(k, "key"):
             parts.append(str(k.key))
         elif hasattr(k, "idx"):
             parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
         else:
             parts.append(str(k))
-    return "/".join(parts)
+    return tuple(parts)
+
+
+def _path_str(key_path) -> str:
+    return "/".join(path_keys(key_path))
 
 
 def param_specs(params: Any, mesh: Optional[Mesh] = None) -> Any:

@@ -71,12 +71,6 @@ class TrainState:
     rng: jax.Array
 
 
-def _path_keys(path) -> Tuple[str, ...]:
-    return tuple(
-        str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k)))) for k in path
-    )
-
-
 def _optimizer_state_shardings(mesh, params: Any, abstract_opt: Any) -> Any:
     """Sharding pytree for an optimizer state, matched *structurally*: optax
     moment trees mirror the params pytree, so an opt-state leaf whose path
@@ -87,14 +81,16 @@ def _optimizer_state_shardings(mesh, params: Any, abstract_opt: Any) -> Any:
     """
     from jax.sharding import NamedSharding, PartitionSpec
 
+    from trlx_tpu.parallel.sharding import _axis_size, path_keys
+
     replicated = NamedSharding(mesh, PartitionSpec())
     param_by_path = {
-        _path_keys(path): (leaf.shape, leaf.sharding)
+        path_keys(path): (leaf.shape, leaf.sharding)
         for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
     }
 
     def leaf_sharding(path, leaf):
-        keys = _path_keys(path)
+        keys = path_keys(path)
         # longest suffix first: the full opt path carries wrapper prefixes
         # (inner_states/<label>/0/mu/...) before the mirrored param path
         for start in range(len(keys)):
@@ -103,9 +99,7 @@ def _optimizer_state_shardings(mesh, params: Any, abstract_opt: Any) -> Any:
                 return hit[1]
         if keys and keys[-1] in ("codes", "scales") and len(leaf.shape) == 2:
             for axes in (("fsdp", "model"), ("fsdp",), ("model",)):
-                size = 1
-                for a in axes:
-                    size *= mesh.shape[a]
+                size = _axis_size(mesh, axes)
                 if size > 1 and leaf.shape[0] % size == 0:
                     spec = axes if len(axes) > 1 else axes[0]
                     return NamedSharding(mesh, PartitionSpec(spec, None))
@@ -609,7 +603,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         set_global_mesh(self.mesh)
         logger.info("Starting training")
         self.prepare_learning()
-        self._maybe_resume()
+        self.maybe_resume()
 
         results = self.evaluate()
         self.tracker.log(results, step=self.iter_count)
@@ -702,12 +696,20 @@ class TPUBaseTrainer(BaseRLTrainer):
     # checkpointing
     # ------------------------------------------------------------------
 
-    def _maybe_resume(self) -> None:
+    def maybe_resume(self) -> None:
         """Restore the newest interval checkpoint when
         ``train.resume_from_checkpoint`` is set — relaunching a crashed or
         preempted run picks up where it left off (reference: Ray session
         restore ``accelerate_base_trainer.py:452-460``; NeMo
-        ``resume_if_exists``)."""
+        ``resume_if_exists``).
+
+        Idempotent; ``train()`` invokes it *before* the initial PPO rollout
+        collection (rollout behavior-logprobs must come from the restored
+        policy, not the fresh one), and ``learn()`` again as a fallback for
+        direct-trainer use."""
+        if getattr(self, "_resume_done", False):
+            return
+        self._resume_done = True
         if not getattr(self.config.train, "resume_from_checkpoint", False):
             return
         root = self.config.train.checkpoint_dir
@@ -737,15 +739,28 @@ class TPUBaseTrainer(BaseRLTrainer):
         logger.info(f"Resuming training state from {path}")
         self.load(path)
 
+    def _extra_checkpoint_state(self) -> Dict[str, Any]:
+        """Host-side scalar state to persist beyond the TrainState (trainers
+        override; e.g. PPO's KL controller and reward running moments —
+        without them a resumed run diverges from an uninterrupted one)."""
+        return {}
+
+    def _restore_extra_checkpoint_state(self, extra: Dict[str, Any]) -> None:
+        pass
+
     def save(self, directory: Optional[str] = None, **kwargs) -> None:
         """Checkpoint full training state (params, opt state, step, RNG)."""
         directory = directory or self.config.train.checkpoint_dir
-        save_state(directory, self.state, extra={"iter_count": self.iter_count})
+        extra = {"iter_count": self.iter_count}
+        extra.update(self._extra_checkpoint_state())
+        save_state(directory, self.state, extra=extra)
 
     def load(self, directory: Optional[str] = None, **kwargs) -> None:
         directory = directory or self.config.train.checkpoint_dir
         self.state = restore_state(directory, self.state)
-        self.iter_count = int(read_extra(directory).get("iter_count", 0))
+        extra = read_extra(directory)
+        self.iter_count = int(extra.get("iter_count", 0))
+        self._restore_extra_checkpoint_state(extra)
 
     def save_pretrained(self, directory: Optional[str] = None, **kwargs) -> None:
         directory = directory or f"{self.config.train.checkpoint_dir}/hf_model"

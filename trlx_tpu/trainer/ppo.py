@@ -113,6 +113,27 @@ class PPOTrainer(TPUBaseTrainer):
         )
         self.prompt_iterator = infinite_loader(loader)
 
+    def _extra_checkpoint_state(self) -> Dict[str, Any]:
+        return {
+            "kl_ctl_value": float(self.kl_ctl.value),
+            "running_moments": {
+                "mean": self.running_moments.mean,
+                "std": self.running_moments.std,
+                "var": self.running_moments.var,
+                "count": self.running_moments.count,
+            },
+        }
+
+    def _restore_extra_checkpoint_state(self, extra: Dict[str, Any]) -> None:
+        if "kl_ctl_value" in extra:
+            self.kl_ctl.value = float(extra["kl_ctl_value"])
+        rm = extra.get("running_moments")
+        if rm:
+            self.running_moments.mean = rm["mean"]
+            self.running_moments.std = rm["std"]
+            self.running_moments.var = rm["var"]
+            self.running_moments.count = rm["count"]
+
     def setup_rollout_logging(self, config: TRLConfig) -> None:
         import os
 

@@ -169,6 +169,10 @@ def train(  # noqa: C901
             prompts, max_prompt_length, trainer.tokenizer
         )
         trainer.add_prompt_pipeline(pipeline)
+        # restore BEFORE collecting rollouts: PPO behavior logprobs must come
+        # from the restored policy, not the freshly initialized one
+        if hasattr(trainer, "maybe_resume"):
+            trainer.maybe_resume()
         trainer.make_experience(config.method.num_rollouts)
     elif samples:
         if rewards is not None and len(samples) != len(rewards):
