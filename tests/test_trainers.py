@@ -302,3 +302,58 @@ def test_ppo_resume_restores_controller_state(tmp_path):
     t2.kl_ctl.value = 0.5
     t2.maybe_resume()
     assert t2.kl_ctl.value == 0.5
+
+
+
+def test_logit_mask_constrains_generation(tmp_path):
+    """The trainer-level logit_mask (reference BaseRLTrainer contract,
+    consumed by ILQL generate) restricts every sampled transition:
+    mask[last, next] == False ⇒ next token unsampleable."""
+    import numpy as np
+
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.ppo  # noqa: F401
+
+    # only transition allowed from token t is (t + 1) % 8
+    V = 8
+    mask = np.zeros((V, V), bool)
+    for t in range(V):
+        mask[t, (t + 1) % V] = True
+
+    config = ppo_config(tmp_path)
+    trainer = get_trainer(config.train.trainer)(
+        config=config, reward_fn=letter_reward, metric_fn=None,
+        stop_sequences=[], logit_mask=mask,
+    )
+    prompts = np.asarray([[2], [5], [7], [1]], np.int32)
+    out = trainer.generate(prompts, np.ones_like(prompts))
+    toks = np.asarray(out.response_tokens)
+    resp_mask = np.asarray(out.response_mask)
+    assert resp_mask.sum() > 0
+    for b in range(toks.shape[0]):
+        last = prompts[b, -1]
+        for j in range(toks.shape[1]):
+            if not resp_mask[b, j]:
+                break
+            assert toks[b, j] == (last + 1) % V, (b, j, toks[b])
+            last = toks[b, j]
+
+
+def test_logit_mask_wider_than_vocab(tmp_path):
+    """A mask over a padded vocab larger than the model's must truncate, not
+    crash (review regression)."""
+    import numpy as np
+
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.ppo  # noqa: F401
+
+    V_model = 259  # gpt2-test vocab
+    mask = np.ones((V_model + 13, V_model + 13), bool)
+    config = ppo_config(tmp_path)
+    trainer = get_trainer(config.train.trainer)(
+        config=config, reward_fn=letter_reward, metric_fn=None,
+        stop_sequences=[], logit_mask=mask,
+    )
+    prompts = np.asarray([[2], [5], [7], [1]], np.int32)
+    out = trainer.generate(prompts, np.ones_like(prompts))
+    assert np.asarray(out.response_mask).sum() > 0

@@ -31,15 +31,20 @@ def _load() -> Optional[ctypes.CDLL]:
     if _LIB is not None or _LOAD_FAILED:
         return _LIB
     try:
-        # per-user, mode-0700 cache: a world-writable shared path would let
-        # another local user pre-plant a library at the predictable name
-        # that ctypes would then load into the training process
-        cache_dir = os.environ.get(
-            "TRLX_TPU_NATIVE_CACHE",
-            os.path.join(tempfile.gettempdir(), f"trlx_tpu_native_{os.getuid()}"),
-        )
-        os.makedirs(cache_dir, exist_ok=True)
-        os.chmod(cache_dir, 0o700)
+        # per-user, mode-0700 cache by default: a world-writable shared path
+        # would let another local user pre-plant a library at the predictable
+        # name that ctypes would then load into the training process. An
+        # explicit TRLX_TPU_NATIVE_CACHE is taken as-is (it may deliberately
+        # be a group-shared build cache — don't rewrite its permissions).
+        cache_dir = os.environ.get("TRLX_TPU_NATIVE_CACHE")
+        if cache_dir is None:
+            cache_dir = os.path.join(
+                tempfile.gettempdir(), f"trlx_tpu_native_{os.getuid()}"
+            )
+            os.makedirs(cache_dir, exist_ok=True)
+            os.chmod(cache_dir, 0o700)
+        else:
+            os.makedirs(cache_dir, exist_ok=True)
         tag = hashlib.sha1(open(_SRC, "rb").read()).hexdigest()[:12]
         so_path = os.path.join(cache_dir, f"host_runtime_{tag}.so")
         if not os.path.exists(so_path):

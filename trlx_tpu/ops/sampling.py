@@ -224,7 +224,7 @@ def generate(
             slot_mask=slot_mask,
             cache=out["cache"],
             logits=out["logits"][:, -1, :],
-            step_out=last_step_info(out),
+            step_out={**last_step_info(out), "last_tokens": next_token},
             done=done,
             step=carry.step + 1,
             rng=rng,
@@ -247,7 +247,7 @@ def generate(
         slot_mask=slot_mask,
         cache=cache,
         logits=last_logits,
-        step_out=last_step_info(prefill_out),
+        step_out={**last_step_info(prefill_out), "last_tokens": input_ids[:, -1]},
         done=jnp.zeros((B,), bool),
         step=jnp.asarray(0, jnp.int32),
         rng=rng,
@@ -340,7 +340,7 @@ def generate_seq2seq(
             mask=mask,
             cache=out["cache"],
             logits=out["logits"][:, -1, :],
-            step_out=last_step_info(out),
+            step_out={**last_step_info(out), "last_tokens": next_token},
             done=done,
             step=carry.step + 1,
             rng=rng,
@@ -356,7 +356,7 @@ def generate_seq2seq(
         mask=jnp.zeros((B, N), jnp.int32),
         cache=out0["cache"],
         logits=out0["logits"][:, -1, :],
-        step_out=last_step_info(out0),
+        step_out={**last_step_info(out0), "last_tokens": start[:, 0]},
         done=jnp.zeros((B,), bool),
         step=jnp.asarray(0, jnp.int32),
         rng=rng,

@@ -59,12 +59,19 @@ class BaseRLTrainer:
         reward_fn: Optional[Callable] = None,
         metric_fn: Optional[Callable] = None,
         stop_sequences: Optional[List[str]] = None,
+        logit_mask=None,
         **kwargs,
     ):
         self.config = config
         self.reward_fn = reward_fn
         self.metric_fn = metric_fn
         self.stop_sequences = stop_sequences or []
+        # [V, V] bool: logit_mask[last_token, next_token] = allowed — applied
+        # to sampling logits during generation (reference contract:
+        # ``trlx/trainer/__init__.py:41-50``, consumed by ILQL generate
+        # ``modeling_ilql.py:297-298``; here it applies to every trainer's
+        # decode loop). Pass via ``train.trainer_kwargs`` or the constructor.
+        self.logit_mask = logit_mask
 
     @abstractmethod
     def learn(self):

@@ -351,12 +351,35 @@ class TPUBaseTrainer(BaseRLTrainer):
 
         return apply_fn
 
+    def _compose_logit_mask(self, adjust: Optional[Callable]) -> Optional[Callable]:
+        """Chain the trainer's transition ``logit_mask`` after any algorithm
+        logit reshaping: tokens whose ``mask[last_token, next_token]`` is
+        False sample with −inf logits. Masks smaller than the vocab disallow
+        all out-of-range tokens."""
+        if self.logit_mask is None:
+            return adjust
+        mask = jnp.asarray(np.asarray(self.logit_mask), bool)
+
+        def fn(step_out: Dict[str, Any], logits: jax.Array) -> jax.Array:
+            if adjust is not None:
+                logits = adjust(step_out, logits)
+            last = jnp.clip(step_out["last_tokens"], 0, mask.shape[0] - 1)
+            sel = mask[last]  # [B, mask_vocab]
+            V = logits.shape[-1]
+            if mask.shape[1] >= V:  # mask over a padded/larger vocab: truncate
+                allowed = sel[:, :V]
+            else:  # mask narrower than vocab: out-of-range tokens disallowed
+                allowed = jnp.zeros(logits.shape, bool).at[:, : mask.shape[1]].set(sel)
+            return jnp.where(allowed, logits, -1e10)
+
+        return fn
+
     def _get_generate_fn(
         self, gen_config: GenerationConfig, extra_kwargs: Tuple[Tuple[str, Any], ...] = ()
     ) -> Callable:
         key = (gen_config, extra_kwargs)
         if key not in self._generate_fns:
-            adjust = self.adjust_logits_fn(dict(extra_kwargs))
+            adjust = self._compose_logit_mask(self.adjust_logits_fn(dict(extra_kwargs)))
             if self.is_seq2seq:
                 module = self.module
                 start_id = self.tcfg.decoder_start_token_id

@@ -94,6 +94,7 @@ def train(  # noqa: C901
     metric_fn: Optional[Callable[[List[str], List[str], List[str]], Dict[str, List[float]]]] = None,
     config: Optional[TRLConfig] = None,
     stop_sequences: Optional[List[str]] = None,
+    init_trainer_hook: Optional[Callable] = None,
 ):
     """Dispatch online RL, offline RL, or supervised fine-tuning.
 
@@ -111,6 +112,10 @@ def train(  # noqa: C901
         config: a :class:`TRLConfig`; a method-appropriate default is used
             (with a warning) when omitted.
         stop_sequences: strings at which generations are trimmed.
+        init_trainer_hook: called with the constructed trainer before any
+            rollout collection or training — e.g. to transplant warm-start
+            weights into the policy and its frozen KL reference (the offline
+            analogue of starting from a pretrained checkpoint).
     """
     # Import for registration side effects (trainers/pipelines register here).
     import importlib
@@ -155,6 +160,8 @@ def train(  # noqa: C901
         stop_sequences=stop_sequences or [],
         **config.train.trainer_kwargs,
     )
+    if init_trainer_hook is not None:
+        init_trainer_hook(trainer)
 
     batch_size = config.train.batch_size
     max_prompt_length = config.train.seq_length - config.method.gen_kwargs["max_new_tokens"]
