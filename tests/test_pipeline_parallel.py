@@ -1,0 +1,216 @@
+"""Pipeline parallelism (`pipe` mesh axis) on the 8-device virtual CPU mesh.
+
+The reference's pipeline engine is Apex/Megatron inside the NeMo backend:
+layers partitioned across PP ranks, a microbatch schedule over NCCL p2p
+(``trlx/models/modeling_nemo_ilql.py:426-442``; PP=4 for 65B,
+``configs/nemo_configs/megatron_65b.yaml:50``). The reference has no tests
+for it at all (SURVEY.md §4 — "the NeMo path is untested except by example
+scripts"); here the GSPMD schedule (``trlx_tpu/parallel/pipeline.py``) is
+checked for exact behavioral parity with the unpipelined execution: logits,
+hydra branch capture, KV-cache decode, and gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.data.configs import ModelConfig, ParallelConfig
+from trlx_tpu.models.builder import build_causal_lm
+from trlx_tpu.models.transformer import make_kv_cache
+from trlx_tpu.ops.sampling import GenerationConfig, generate
+from trlx_tpu.parallel.mesh import make_mesh, set_global_mesh
+from trlx_tpu.parallel.pipeline import pick_microbatches
+from trlx_tpu.parallel.sharding import shard_batch, shard_params
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    set_global_mesh(None)
+
+
+def _model(num_layers=4, **extra):
+    mc = ModelConfig(
+        model_path="builtin:gpt2-test",
+        model_extra_kwargs=dict(scan_layers=True, num_layers=num_layers, **extra),
+    )
+    return build_causal_lm(mc, head="value")
+
+
+def _batch(rng, B=8, T=16, pad_rows=2, pad_len=5):
+    ids = rng.randint(1, 259, (B, T)).astype(np.int32)
+    mask = np.ones((B, T), np.int32)
+    mask[:pad_rows, :pad_len] = 0  # left padding
+    return ids, mask
+
+
+def test_pick_microbatches():
+    assert pick_microbatches(8, 2) == 2
+    assert pick_microbatches(8, 2, requested=4) == 4
+    assert pick_microbatches(8, 4, requested=0) == 4
+    assert pick_microbatches(6, 4) == 3  # largest divisor of 6 below 4
+    assert pick_microbatches(2, 4) == 2  # capped at batch
+    assert pick_microbatches(7, 4) == 1  # prime batch
+
+
+@pytest.mark.parametrize(
+    "pp_axes, micro",
+    [
+        (dict(data=1, pipe=2, fsdp=2, model=2), 0),
+        (dict(data=2, pipe=4, fsdp=1, model=1), 4),
+    ],
+)
+def test_pipeline_forward_parity(pp_axes, micro):
+    """Pipelined logits + hydra branch capture exactly match the unpipelined
+    scan execution, under combined pipe×fsdp×model meshes."""
+    module, params, tcfg = _model(pipe_microbatches=micro)
+    ids, mask = _batch(np.random.RandomState(0))
+
+    set_global_mesh(None)
+    ref = module.apply(
+        {"params": params}, jnp.asarray(ids), attention_mask=jnp.asarray(mask), branch_layer=2
+    )
+
+    mesh = make_mesh(ParallelConfig(**pp_axes))
+    set_global_mesh(mesh)
+    p = shard_params(params, mesh)
+    b = shard_batch({"ids": ids, "mask": mask}, mesh)
+
+    @jax.jit
+    def fwd(p, ids, mask):
+        return module.apply({"params": p}, ids, attention_mask=mask, branch_layer=2)
+
+    out = fwd(p, b["ids"], b["mask"])
+    for key in ("logits", "branch_input", "hidden_states"):
+        np.testing.assert_allclose(
+            np.asarray(ref[key], np.float32),
+            np.asarray(out[key], np.float32),
+            atol=3e-2,
+            rtol=3e-2,
+        )
+
+
+def test_pipeline_decode_parity():
+    """The jitted KV-cache decode loop (prefill + while_loop) produces the
+    same greedy tokens and logprobs through the pipeline schedule — the
+    reference generates through its Megatron pipeline the same way
+    (``modeling_nemo_ilql.py:768``)."""
+    module, params, tcfg = _model()
+    ids, mask = _batch(np.random.RandomState(1), T=10, pad_rows=3, pad_len=4)
+    gcfg = GenerationConfig(max_new_tokens=6, do_sample=False, eos_token_id=None)
+
+    def apply_fn(p, input_ids, attention_mask=None, positions=None, cache=None,
+                 cache_index=None, logits_span=None):
+        return module.apply(
+            {"params": p}, input_ids, attention_mask=attention_mask,
+            positions=positions, cache=cache, cache_index=cache_index,
+            logits_span=logits_span,
+        )
+
+    def run(p, ids, mask):
+        return generate(
+            apply_fn, p, lambda B, S: make_kv_cache(tcfg, B, S), ids, mask,
+            jax.random.PRNGKey(1), gcfg,
+        )
+
+    set_global_mesh(None)
+    ref = jax.jit(run)(params, jnp.asarray(ids), jnp.asarray(mask))
+
+    mesh = make_mesh(ParallelConfig(data=1, pipe=2, fsdp=2, model=2))
+    set_global_mesh(mesh)
+    p = shard_params(params, mesh)
+    b = shard_batch({"ids": ids, "mask": mask}, mesh)
+    out = jax.jit(run)(p, b["ids"], b["mask"])
+
+    tok_ref = np.asarray(ref.response_tokens)
+    tok_pp = np.asarray(out.response_tokens)
+    # greedy decode: bf16 reduction-order ties may flip the odd argmax
+    assert (tok_ref == tok_pp).mean() > 0.9, (tok_ref, tok_pp)
+    match = tok_ref == tok_pp
+    np.testing.assert_allclose(
+        np.asarray(ref.response_logprobs)[match],
+        np.asarray(out.response_logprobs)[match],
+        atol=3e-2,
+    )
+
+
+def test_pipeline_grad_parity():
+    """Autodiff through the schedule (XLA reverses the stage permutes) matches
+    unpipelined gradients on every leaf — the reference needs Apex's
+    hand-written fwd_bwd_function for this (``modeling_nemo_ilql.py:426``)."""
+    module, params, _ = _model()
+    ids, mask = _batch(np.random.RandomState(2))
+
+    def loss_fn(p, ids, mask):
+        out = module.apply({"params": p}, ids, attention_mask=mask)
+        return jnp.mean(out["logits"].astype(jnp.float32) ** 2)
+
+    set_global_mesh(None)
+    gref = jax.grad(loss_fn)(params, jnp.asarray(ids), jnp.asarray(mask))
+
+    mesh = make_mesh(ParallelConfig(data=1, pipe=2, fsdp=2, model=2))
+    set_global_mesh(mesh)
+    p = shard_params(params, mesh)
+    b = shard_batch({"ids": ids, "mask": mask}, mesh)
+    gpp = jax.device_get(jax.jit(jax.grad(loss_fn))(p, b["ids"], b["mask"]))
+
+    flat_r = jax.tree_util.tree_leaves_with_path(gref)
+    flat_p = jax.tree_util.tree_leaves_with_path(gpp)
+    assert len(flat_r) == len(flat_p)
+    for (kr, vr), (kp, vp) in zip(flat_r, flat_p):
+        np.testing.assert_allclose(
+            np.asarray(vr, np.float32), np.asarray(vp, np.float32),
+            atol=5e-2, rtol=5e-2, err_msg=jax.tree_util.keystr(kr),
+        )
+
+
+def test_pipeline_requires_scan_layers():
+    mc = ModelConfig(model_path="builtin:gpt2-test", model_extra_kwargs=dict(num_layers=4))
+    module, params, _ = build_causal_lm(mc)
+    mesh = make_mesh(ParallelConfig(data=1, pipe=2, fsdp=2, model=2))
+    set_global_mesh(mesh)
+    with pytest.raises(ValueError, match="scan_layers"):
+        module.apply({"params": params}, jnp.ones((4, 8), jnp.int32))
+
+
+def test_pipeline_indivisible_layers():
+    module, params, _ = _model(num_layers=3)
+    mesh = make_mesh(ParallelConfig(data=1, pipe=2, fsdp=2, model=2))
+    set_global_mesh(mesh)
+    with pytest.raises(ValueError, match="divisible"):
+        module.apply({"params": params}, jnp.ones((4, 8), jnp.int32))
+
+
+@pytest.mark.slow
+def test_pipeline_ppo_train_step_e2e():
+    """Full PPO cycle (rollout collection + train step) over a
+    data×pipe×fsdp×model mesh — the dryrun shape with PP on."""
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401
+    import trlx_tpu.trainer.ppo  # noqa: F401
+
+    import __graft_entry__ as ge
+
+    config = ge._tiny_ppo_config(
+        dict(data=2, pipe=2, fsdp=1, model=2, pipe_microbatches=2)
+    )
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        return [float(len(o)) for o in outputs]
+
+    trainer = get_trainer(config.train.trainer)(
+        config=config, reward_fn=reward_fn, metric_fn=None, stop_sequences=[]
+    )
+    assert trainer.mesh.shape["pipe"] == 2
+
+    pipeline = get_pipeline(config.train.pipeline)(
+        ["hello world", "foo bar", "baz qux", "lorem ipsum"] * 2, 16, trainer.tokenizer
+    )
+    trainer.add_prompt_pipeline(pipeline)
+    trainer.make_experience(config.method.num_rollouts)
+    loader = trainer.store.create_loader(config.train.batch_size, shuffle=True)
+    stats = trainer.train_step(next(iter(loader)))
+    loss = float(np.asarray(jax.device_get(stats["losses/total_loss"])))
+    assert np.isfinite(loss)

@@ -197,7 +197,8 @@ def test_scan_sharding_specs_prepend_layer_dim():
     spec = param_spec_for_path(
         "backbone/h_scan/block/attn/q_proj/kernel", (2, 64, 64)
     )
-    assert tuple(spec) == (None, "fsdp", "model")
+    # the layer dim rides the `pipe` axis (size 1 unless PP is on)
+    assert tuple(spec) == ("pipe", "fsdp", "model")
     spec = param_spec_for_path("backbone/h_0/attn/q_proj/kernel", (64, 64))
     assert tuple(spec) == ("fsdp", "model")
 
@@ -241,7 +242,7 @@ def test_6b_scan_config_partitions():
     # the stacked qkv/mlp kernels dominate; they must actually shard 8-way
     assert per_device < total / 6, f"per-device {per_device:.2e} vs total {total:.2e}"
     stacked_spec = specs["backbone"]["h_scan"]["block"]["attn"]["q_proj"]["kernel"]
-    assert tuple(stacked_spec) == (None, "fsdp", "model")
+    assert tuple(stacked_spec) == ("pipe", "fsdp", "model")
 
 
 @pytest.mark.slow
@@ -268,7 +269,7 @@ def test_20b_scan_config_partitions():
     mesh = make_mesh(ParallelConfig(data=1, fsdp=2, model=4))
     specs = param_specs(shapes, mesh)
     qkv = specs["backbone"]["h_scan"]["block"]["attn"]["q_proj"]["kernel"]
-    assert tuple(qkv) == (None, "fsdp", "model")
+    assert tuple(qkv) == ("pipe", "fsdp", "model")
     # vocab 50432 divides 8: the embedding really is vocab-parallel
     wte = specs["backbone"]["wte"]["embedding"]
     assert tuple(wte) == (("model", "fsdp"), None)

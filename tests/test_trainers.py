@@ -357,3 +357,39 @@ def test_logit_mask_wider_than_vocab(tmp_path):
     prompts = np.asarray([[2], [5], [7], [1]], np.int32)
     out = trainer.generate(prompts, np.ones_like(prompts))
     assert np.asarray(out.response_mask).sum() > 0
+
+
+def test_logit_mask_narrow_rows_unconstrained(tmp_path):
+    """A mask with fewer rows than the vocab must leave out-of-range *last*
+    tokens unconstrained instead of borrowing the final row's transitions
+    (review regression): prompts ending beyond the mask sample freely; those
+    within it still obey their row."""
+    import numpy as np
+
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.ppo  # noqa: F401
+
+    # rows 0..7 allow only the self-transition; tokens >= 8 have no row
+    V = 8
+    mask = np.zeros((V, V), bool)
+    np.fill_diagonal(mask, True)
+    config = ppo_config(tmp_path)
+    trainer = get_trainer(config.train.trainer)(
+        config=config, reward_fn=letter_reward, metric_fn=None,
+        stop_sequences=[], logit_mask=mask,
+    )
+    # first two prompts end in-range (must self-loop); last two end at
+    # out-of-range tokens (vocab 259) and must NOT be forced into row 7
+    prompts = np.asarray([[3], [6], [200], [120]], np.int32)
+    out = trainer.generate(prompts, np.ones_like(prompts))
+    toks = np.asarray(out.response_tokens)
+    resp_mask = np.asarray(out.response_mask)
+    for b, last in enumerate((3, 6)):
+        for j in range(toks.shape[1]):
+            if not resp_mask[b, j]:
+                break
+            assert toks[b, j] == last, (b, toks[b])
+    # out-of-range rows: sampling is unconstrained — over 2 samples x N steps
+    # at least one token outside the forced row-7 column set must appear
+    free = toks[2:][resp_mask[2:] > 0]
+    assert (free != 7).any()

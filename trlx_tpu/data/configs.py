@@ -131,9 +131,19 @@ class ParallelConfig:
     :param data: pure data-parallel replicas (DDP analogue).
     :param fsdp: parameter/optimizer sharding axis (ZeRO-3/FSDP analogue —
         falls out of GSPMD sharding, no runtime machinery needed).
+    :param pipe: pipeline-parallel stages (the reference's Apex/Megatron
+        pipeline engine, ``trlx/models/modeling_nemo_ilql.py:426-442``,
+        PP=4 for 65B ``configs/nemo_configs/megatron_65b.yaml:50``). Requires
+        ``scan_layers``: the stacked block params shard their layer dim over
+        this axis and a GPipe microbatch schedule rotates activations
+        through the stages (``trlx_tpu/parallel/pipeline.py``).
     :param model: tensor-parallel axis (Megatron TP analogue).
     :param sequence: context/sequence-parallel axis for ring attention over
         long sequences (beyond the reference, which has only Megatron SP).
+    :param pipe_microbatches: microbatches per pipeline round (GPipe schedule
+        fill; the reference's NeMo micro-vs-global batch split,
+        ``megatron_20b.yaml:51-52``). 0 = auto (one per stage, capped at the
+        batch size).
 
     :param param_dtype: storage dtype of parameters.
     :param compute_dtype: activation/matmul dtype (bf16 keeps the MXU busy).
@@ -148,8 +158,10 @@ class ParallelConfig:
 
     data: int = -1
     fsdp: int = 1
+    pipe: int = 1
     model: int = 1
     sequence: int = 1
+    pipe_microbatches: int = 0
 
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"

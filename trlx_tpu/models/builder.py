@@ -113,7 +113,8 @@ def _assemble_overrides(
         overrides.setdefault("dtype", DTYPES[parallel.compute_dtype])
         overrides.setdefault("remat", parallel.remat)
         if scan_layers_supported:
-            overrides.setdefault("scan_layers", parallel.scan_layers)
+            overrides.setdefault("scan_layers", parallel.scan_layers or parallel.pipe > 1)
+            overrides.setdefault("pipe_microbatches", parallel.pipe_microbatches)
     return overrides
 
 
@@ -334,6 +335,12 @@ def resolve_seq2seq_config(
 
     from trlx_tpu.models.seq2seq import Seq2SeqConfig
 
+    if parallel is not None and parallel.pipe > 1:
+        raise ValueError(
+            "pipeline parallelism (parallel.pipe > 1) is not supported for "
+            "seq2seq models — the pipe schedule runs over the causal "
+            "scan_layers block stack; use fsdp/model/data axes for T5"
+        )
     path = model_config.model_path
     overrides = _assemble_overrides(model_config, parallel, scan_layers_supported=False)
 

@@ -38,6 +38,28 @@ def param_with_axes(init: Callable, axes: Tuple[str, ...]) -> Callable:
     return init
 
 
+def _maybe_pipeline_mesh(cfg: "TransformerConfig"):
+    """The global mesh, iff its ``pipe`` axis should pipeline this model's
+    block stack (requires ``scan_layers``: the stacked params are what shards
+    across stages)."""
+    from trlx_tpu.parallel.mesh import get_global_mesh
+
+    mesh = get_global_mesh()
+    if mesh is None or mesh.shape.get("pipe", 1) <= 1:
+        return None
+    if not cfg.scan_layers:
+        raise ValueError(
+            "pipeline parallelism (mesh pipe>1) requires scan_layers=True — "
+            "the stacked block params are what shards across stages"
+        )
+    if cfg.num_layers % mesh.shape["pipe"]:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by pipe stages "
+            f"{mesh.shape['pipe']}"
+        )
+    return mesh
+
+
 def _maybe_ring_mesh(T: int):
     """The global mesh, iff its ``sequence`` axis should carry this pass
     (full self-attention forwards, ALiBi included; ring doesn't apply to
@@ -115,6 +137,10 @@ class TransformerConfig:
     lora_r: int = 0
     lora_alpha: float = 16.0
     lora_targets: Tuple[str, ...] = ()
+
+    # pipeline parallelism: microbatches per GPipe round when the mesh has a
+    # pipe axis > 1 (0 = auto: one per stage). See parallel/pipeline.py.
+    pipe_microbatches: int = 0
 
     def resolved_attention_impl(self) -> str:
         if self.attention_impl == "auto":
@@ -657,6 +683,19 @@ class CausalTransformer(nn.Module):
             bias = bias + jnp.where(visible[:, None, :, :], alibi, 0.0)
         return bias
 
+    def _attn_inputs(
+        self, key_mask, positions, q_offset, use_flash
+    ) -> Tuple[Optional[jax.Array], Optional[Dict[str, Any]]]:
+        """``(bias, flash_args)`` for one forward — the single definition of
+        the masking semantics, shared by the unpipelined path, the hydra
+        branch replay, and each pipeline stage. Queries occupy slots
+        ``[q_offset, q_offset + T)`` (0 for full passes)."""
+        if use_flash:
+            return None, self._flash_args(key_mask, positions, q_offset=q_offset)
+        B, T = positions.shape
+        query_slots = q_offset + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        return self._attention_bias(key_mask, query_slots, positions), None
+
     def _flash_args(self, key_mask, query_positions, q_offset=0) -> Dict[str, Any]:
         """Inputs for the pallas flash-attention path: same masking semantics
         as ``_attention_bias`` but resolved inside the kernel (no [B,1,T,S]
@@ -688,32 +727,32 @@ class CausalTransformer(nn.Module):
             attention_mask = jnp.ones((B, T), jnp.int32)
         if cache is None:
             # full pass: key slots are the input sequence itself
-            query_slots = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
             if positions is None:
                 positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
         else:
             # attention_mask is the [B, S] slot mask over the whole cache;
             # queries occupy slots [cache_index, cache_index + T)
-            offset = cache_index if cache_index is not None else 0
-            query_slots = offset + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
             if positions is None:
+                offset = cache_index if cache_index is not None else 0
+                query_slots = offset + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
                 key_pos = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
                 positions = jax.vmap(lambda kp, qs: kp[qs])(key_pos, query_slots)
 
         x = self._embed(input_ids, positions)
         use_flash = cfg.resolved_attention_impl() == "pallas" and T > 1
-        if use_flash:
-            bias = None
-            flash_args = self._flash_args(
-                attention_mask,
-                positions,
-                q_offset=(
-                    cache_index if cache is not None and cache_index is not None else 0
-                ),
+        pipe_mesh = None if self.is_initializing() else _maybe_pipeline_mesh(cfg)
+        if pipe_mesh is not None:
+            x, branch_input, new_cache = self._pipelined_blocks(
+                pipe_mesh, x, attention_mask, positions, use_flash,
+                cache, cache_index, branch_layer,
             )
-        else:
-            flash_args = None
-            bias = self._attention_bias(attention_mask, query_slots, positions)
+            return self._epilogue(x, branch_input, new_cache, logits_span)
+        bias, flash_args = self._attn_inputs(
+            attention_mask,
+            positions,
+            cache_index if cache is not None and cache_index is not None else 0,
+            use_flash,
+        )
 
         branch_input = None
         if cfg.scan_layers:
@@ -741,10 +780,11 @@ class CausalTransformer(nn.Module):
                 if cache is not None:
                     new_cache.append(updated)
 
-        if cfg.final_norm:
-            h = self.ln_f(x)
-        else:
-            h = x
+        return self._epilogue(x, branch_input, new_cache, logits_span)
+
+    def _epilogue(self, x, branch_input, new_cache, logits_span):
+        """Shared forward tail: final norm + (span-restricted) lm head."""
+        h = self.ln_f(x) if self.config.final_norm else x
         logits = self._logits(h if logits_span is None else h[:, logits_span[0] : logits_span[1]])
         return {
             "logits": logits,
@@ -753,6 +793,53 @@ class CausalTransformer(nn.Module):
             "branch_input": branch_input,
             "cache": new_cache,
         }
+
+    def _pipelined_blocks(
+        self, mesh, x, attention_mask, positions, use_flash, cache, cache_index, branch_layer
+    ):
+        """Run the stacked blocks through the GPipe schedule over the mesh's
+        ``pipe`` axis (``parallel/pipeline.py``) — the reference's Megatron
+        pipeline engine (``modeling_nemo_ilql.py:426-442``), here one jitted
+        program with compiler-inserted stage handoffs. Attention inputs
+        (bias/flash args) are rebuilt per microbatch inside each stage, since
+        different stages hold different microbatches at any tick."""
+        cfg = self.config
+        from trlx_tpu.parallel.pipeline import pick_microbatches, pipeline_blocks
+
+        B = x.shape[0]
+        num_stages = mesh.shape["pipe"]
+        num_micro = pick_microbatches(B, num_stages, cfg.pipe_microbatches)
+        branch_at = cfg.num_layers - branch_layer if branch_layer is not None else -1
+        body_block = Block(cfg, parent=None)
+        in_decode = cache is not None and cache_index is not None
+        q_offset = cache_index if in_decode else 0
+
+        def make_attn_inputs(mask_mb, pos_mb):
+            return self._attn_inputs(mask_mb, pos_mb, q_offset, use_flash) + (pos_mb,)
+
+        def apply_block(layer_params, h, aux, cache_layer, cidx):
+            bias_mb, flash_mb, pos_mb = aux
+            return body_block.apply(
+                {"params": layer_params}, h, bias_mb, pos_mb, cache_layer, cidx, flash_mb
+            )
+
+        if cfg.remat in ("full", "minimal"):
+            apply_block = jax.checkpoint(apply_block, policy=_remat_policy(cfg))
+
+        return pipeline_blocks(
+            self.variables["params"]["h_scan"]["block"],
+            x,
+            attention_mask.astype(jnp.int32),
+            positions,
+            num_stages=num_stages,
+            num_microbatches=num_micro,
+            make_attn_inputs=make_attn_inputs,
+            apply_block=apply_block,
+            cache=cache,
+            cache_index=cache_index,
+            branch_at=branch_at,
+            mesh=mesh,
+        )
 
     def forward_branch(
         self,
@@ -774,11 +861,12 @@ class CausalTransformer(nn.Module):
             attention_mask = jnp.ones((B, T), jnp.int32)
         if positions is None:
             positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
-        query_slots = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-        if cfg.resolved_attention_impl() == "pallas" and T > 1:
-            bias, flash_args = None, self._flash_args(attention_mask, positions)
-        else:
-            bias, flash_args = self._attention_bias(attention_mask, query_slots, positions), None
+        bias, flash_args = self._attn_inputs(
+            attention_mask,
+            positions,
+            0,
+            cfg.resolved_attention_impl() == "pallas" and T > 1,
+        )
         x = hidden_states
         if cfg.scan_layers:
             # scan over the top `branch_layer` rows of the stacked params —

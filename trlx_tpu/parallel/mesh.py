@@ -7,6 +7,11 @@ Axes:
 - ``fsdp``     — parameter/optimizer-state sharding (the reference's DeepSpeed
   ZeRO-2/3, ``configs/accelerate/zero*.yaml``). Also acts as a data axis for
   the batch: FSDP = DP + sharded state.
+- ``pipe``     — pipeline-parallel stages (the reference's Apex/Megatron
+  pipeline engine, ``trlx/models/modeling_nemo_ilql.py:426-442``). Placed
+  outside model/sequence in the axis order: stage handoffs move one
+  microbatch of activations per tick (low bandwidth), so they can ride the
+  slower links while TP/ring collectives keep the fastest ICI.
 - ``model``    — tensor parallelism (the reference's Megatron TP,
   ``configs/nemo_configs/megatron_20b.yaml:53``).
 - ``sequence`` — context parallelism for long sequences (ring attention);
@@ -26,7 +31,7 @@ from jax.sharding import Mesh
 
 from trlx_tpu.data.configs import ParallelConfig
 
-MESH_AXES = ("data", "fsdp", "model", "sequence")
+MESH_AXES = ("data", "pipe", "fsdp", "model", "sequence")
 
 # The process-wide mesh, set by trainers at construction. Model code reads it
 # (``get_global_mesh``) to decide whether sequence-parallel ops (ring
@@ -46,10 +51,16 @@ def get_global_mesh() -> Optional[Mesh]:
 
 def mesh_shape_from_config(
     parallel: ParallelConfig, device_count: Optional[int] = None
-) -> Tuple[int, int, int, int]:
-    """Resolve the 4-axis mesh shape; a single ``-1`` axis is inferred."""
+) -> Tuple[int, int, int, int, int]:
+    """Resolve the 5-axis mesh shape; a single ``-1`` axis is inferred."""
     n = device_count if device_count is not None else jax.device_count()
-    sizes = [parallel.data, parallel.fsdp, parallel.model, parallel.sequence]
+    sizes = [
+        parallel.data,
+        parallel.pipe,
+        parallel.fsdp,
+        parallel.model,
+        parallel.sequence,
+    ]
     if sizes.count(-1) > 1:
         raise ValueError(f"At most one mesh axis may be -1, got {sizes}")
     if -1 in sizes:
@@ -92,7 +103,7 @@ def make_mesh(
                 f"data axis {shape[0]} not divisible by dcn_data_parallelism {dcn}"
             )
         ici_shape = (shape[0] // dcn,) + shape[1:]
-        dcn_shape = (dcn, 1, 1, 1)
+        dcn_shape = (dcn,) + (1,) * (len(shape) - 1)
         device_array = mesh_utils.create_hybrid_device_mesh(
             ici_shape, dcn_shape, devices=devices
         )

@@ -83,8 +83,11 @@ def param_spec_for_path(
     partitions = tuple(spec)
     if "/h_scan/" in path or path.startswith("h_scan/"):
         # scan_layers layout: a leading layer dim precedes every rule's dims
-        # (stacked blocks); the layer axis itself stays unsharded
-        partitions = (None,) + partitions
+        # (stacked blocks); the layer axis shards over `pipe` — with PP>1
+        # each stage's devices hold only their own blocks (the reference's
+        # per-stage Megatron partitions, ``modeling_nemo_ilql.py:219-250``);
+        # at pipe=1 the axis is size 1 and the spec is a no-op
+        partitions = ("pipe",) + partitions
     partitions = partitions + (None,) * (len(shape) - len(partitions))
     partitions = partitions[: len(shape)]
     if mesh is not None:

@@ -355,7 +355,9 @@ class TPUBaseTrainer(BaseRLTrainer):
         """Chain the trainer's transition ``logit_mask`` after any algorithm
         logit reshaping: tokens whose ``mask[last_token, next_token]`` is
         False sample with −inf logits. Masks smaller than the vocab disallow
-        all out-of-range tokens."""
+        out-of-range *next* tokens; out-of-range *last* tokens (no transition
+        row exists for them) sample unconstrained rather than borrowing an
+        unrelated row's constraints."""
         if self.logit_mask is None:
             return adjust
         mask = jnp.asarray(np.asarray(self.logit_mask), bool)
@@ -363,13 +365,16 @@ class TPUBaseTrainer(BaseRLTrainer):
         def fn(step_out: Dict[str, Any], logits: jax.Array) -> jax.Array:
             if adjust is not None:
                 logits = adjust(step_out, logits)
-            last = jnp.clip(step_out["last_tokens"], 0, mask.shape[0] - 1)
+            last_tokens = step_out["last_tokens"]
+            last = jnp.clip(last_tokens, 0, mask.shape[0] - 1)
             sel = mask[last]  # [B, mask_vocab]
             V = logits.shape[-1]
             if mask.shape[1] >= V:  # mask over a padded/larger vocab: truncate
                 allowed = sel[:, :V]
             else:  # mask narrower than vocab: out-of-range tokens disallowed
                 allowed = jnp.zeros(logits.shape, bool).at[:, : mask.shape[1]].set(sel)
+            row_known = (last_tokens >= 0) & (last_tokens < mask.shape[0])
+            allowed = allowed | ~row_known[:, None]
             return jnp.where(allowed, logits, -1e10)
 
         return fn
