@@ -2,9 +2,10 @@
 
 Provides the capabilities of trlx (reference: ``trlx/trlx.py``) — online PPO
 against a user reward function, offline ILQL from reward-labeled samples, and
-SFT — re-designed TPU-first: Flax models sharded over a ``(data, fsdp, model)``
-mesh, jitted KV-cached rollout generation with on-device KL-to-reference, and
-fused pure-function losses inside a pjit'd train step.
+SFT — re-designed TPU-first: Flax models sharded over a
+``(data, pipe, fsdp, model, sequence)`` mesh, jitted KV-cached rollout
+generation with on-device KL-to-reference, and fused pure-function losses
+inside a pjit'd train step.
 """
 
 __version__ = "0.1.0"

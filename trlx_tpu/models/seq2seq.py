@@ -9,7 +9,7 @@ built on the same conventions as ``CausalTransformer``:
 
 - identical parameter naming (``q_proj``/``o_proj``/``up_proj``/``wte``/…) so
   the one sharding rule table (``trlx_tpu/parallel/sharding.py``) maps the
-  whole model onto the ``(data, fsdp, model, sequence)`` mesh;
+  whole model onto the ``(data, pipe, fsdp, model, sequence)`` mesh;
 - explicit functional KV cache for the decoder (self-attn K/V written at
   ``cache_index``; cross-attn K/V computed once at prefill), so seq2seq
   generation is one compiled ``lax.while_loop`` program;

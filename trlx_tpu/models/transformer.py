@@ -8,7 +8,7 @@ scheme, norm type, activation, parallel-residual, biases, GQA).
 TPU-first design decisions:
 - every weight carries **logical axis names** (``nn.with_logical_partitioning``)
   so one set of sharding rules (``trlx_tpu/parallel``) maps the whole model
-  onto a ``(data, fsdp, model, sequence)`` mesh — the GSPMD equivalent of
+  onto a ``(data, pipe, fsdp, model, sequence)`` mesh — the GSPMD equivalent of
   Megatron TP/SP in the reference's NeMo backend;
 - **explicit functional KV cache** (a pytree threaded through the decode
   loop) instead of stateful modules, so generation is one compiled

@@ -4,7 +4,7 @@ The TPU-native replacement for the reference's *three* distributed stacks
 (Accelerate/DeepSpeed ZeRO, ``configs/accelerate/*.yaml``; NeMo Megatron
 TP/PP/SP, ``trlx/models/modeling_nemo_ilql.py``; raw torch.distributed/NCCL
 calls, ``trlx/utils/modeling.py:190-202``): one logical program over a
-``jax.sharding.Mesh`` with axes ``(data, fsdp, model, sequence)``. XLA inserts
+``jax.sharding.Mesh`` with axes ``(data, pipe, fsdp, model, sequence)``. XLA inserts
 the collectives (all-gather / reduce-scatter / psum) over ICI/DCN — no
 hand-written communication.
 """

@@ -1,7 +1,7 @@
 """Path-based parameter sharding rules (t5x/maxtext style).
 
 One ordered rule table maps every parameter path in the model tree to a
-``PartitionSpec`` over the ``(data, fsdp, model, sequence)`` mesh:
+``PartitionSpec`` over the ``(data, pipe, fsdp, model, sequence)`` mesh:
 
 - the **model** axis carries Megatron-style tensor parallelism — qkv/mlp-up
   kernels shard their *output* features, o/mlp-down kernels their *input*

@@ -20,11 +20,17 @@ trainer's learn loop writes at every evaluation (so early-stopped or crashed
 trials still report their last metric).
 
 Search algorithms: ``random`` (reference default), ``grid`` (via
-``grid`` strategies), and ``quasirandom`` (scrambled Halton — lower
-discrepancy coverage than random at small trial counts; beyond the
-reference). ``bayesopt``/``bohb`` required external libs in the reference
-and are not supported here; ``scheduler`` only accepts ``fifo`` (Ray's
-early-stopping schedulers don't map to subprocess trials).
+``grid`` strategies), ``quasirandom`` (Halton — lower discrepancy coverage
+than random at small trial counts; beyond the reference), and ``bayesopt``
+(alias ``tpe``): an in-repo Tree-structured Parzen Estimator — the
+reference's adaptive-search capability (``trlx/sweep.py:103-133``, Ray's
+``BayesOptSearch``/``TuneBOHB``) without the external dependency. Every
+strategy is a deterministic map from a unit coordinate ``u`` ∈ [0,1), so
+all three samplers share one space: random draws u uniformly, quasirandom
+from a Halton sequence, and TPE models completed trials' u-vectors with
+good/bad Parzen mixtures and proposes the candidate maximizing their
+density ratio. ``scheduler`` only accepts ``fifo`` (Ray's early-stopping
+schedulers don't map to subprocess trials).
 """
 
 import argparse
@@ -48,6 +54,13 @@ logger = logging.get_logger(__name__)
 _PRIMES = (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47, 53)
 
 
+def _norm_inv_cdf(u: float) -> float:
+    """Standard-normal inverse CDF (stdlib; keeps randn strategies u-driven)."""
+    from statistics import NormalDist
+
+    return NormalDist().inv_cdf(min(max(u, 1e-9), 1 - 1e-9))
+
+
 def _halton(index: int, base: int) -> float:
     """Van der Corput radical inverse of ``index`` in ``base`` ∈ (0, 1)."""
     result, f = 0.0, 1.0
@@ -67,9 +80,12 @@ class ParamDef:
     strategy: str
     values: List[Any]
 
-    def sample(self, u: float, rng: np.random.RandomState) -> Any:
-        """Draw a value; ``u`` ∈ [0,1) drives continuous strategies (uniform
-        or quasirandom position), ``rng`` drives discrete ones."""
+    def sample(self, u: float, rng: Optional[np.random.RandomState] = None) -> Any:
+        """Map a unit coordinate ``u`` ∈ [0,1) to a value. Every strategy is
+        a deterministic function of ``u`` so random, quasirandom, and TPE
+        sampling all operate in one shared unit cube (``rng`` is accepted
+        for backward compatibility and unused)."""
+        del rng
         s, v = self.strategy, self.values
         if s == "uniform":
             return float(v[0] + u * (v[1] - v[0]))
@@ -84,10 +100,10 @@ class ParamDef:
             return float(np.round(np.exp(lo + u * (hi - lo)) / q) * q)
         if s == "randn":
             mean, sd = v
-            return float(mean + sd * rng.randn())
+            return float(mean + sd * _norm_inv_cdf(u))
         if s == "qrandn":
             mean, sd, q = v
-            return float(np.round((mean + sd * rng.randn()) / q) * q)
+            return float(np.round((mean + sd * _norm_inv_cdf(u)) / q) * q)
         if s == "randint":
             return int(v[0] + int(u * (v[1] - v[0])))
         if s == "qrandint":
@@ -100,7 +116,7 @@ class ParamDef:
             lo, hi, q = np.log(v[0]), np.log(v[1]), v[3]
             return int(np.round(np.exp(lo + u * (hi - lo)) / q) * q)
         if s == "choice":
-            return v[rng.randint(len(v))]
+            return v[min(int(u * len(v)), len(v) - 1)]
         raise ValueError(f"Unknown strategy '{s}' for {self.key}")
 
 
@@ -127,32 +143,135 @@ class SweepSpace:
             (space.grid if pd.strategy == "grid" else space.sampled).append(pd)
         return space
 
+    def grid_points(self) -> List[Dict[str, Any]]:
+        """Cartesian product of the grid-strategy params (``[{}]`` if none)."""
+        if not self.grid:
+            return [{}]
+        grid_axes = [[(p.key, v) for v in p.values] for p in self.grid]
+        return [dict(combo) for combo in itertools.product(*grid_axes)]
+
+    def realize(self, point: Dict[str, Any], us: np.ndarray) -> Dict[str, Any]:
+        """One grid point + a unit-cube coordinate vector → hparam dict."""
+        hp = dict(point)
+        for j, p in enumerate(self.sampled):
+            hp[p.key] = p.sample(float(us[j]))
+        return hp
+
     def trials(self, num_samples: int, seed: int = 0, search_alg: str = "random") -> Iterator[Dict[str, Any]]:
         """Yield hparam dicts: the cartesian grid × ``num_samples`` draws of
-        the sampled params."""
-        if search_alg not in ("random", "quasirandom"):
+        the sampled params (non-adaptive algorithms only — ``bayesopt``
+        needs trial feedback and runs through :func:`run_sweep`)."""
+        searcher = Searcher(len(self.sampled), search_alg, seed)
+        if searcher.adaptive:
             raise ValueError(
-                f"search_alg '{search_alg}' not supported (random, quasirandom; "
-                "the reference's bayesopt/bohb need external libs)"
+                f"search_alg '{search_alg}' is adaptive — it proposes trials "
+                "from completed results and only runs through run_sweep()"
             )
-        rng = np.random.RandomState(seed)
-        grid_axes = [[(p.key, v) for v in p.values] for p in self.grid] or [[]]
-        grid_points = (
-            [dict(combo) for combo in itertools.product(*grid_axes)]
-            if self.grid
-            else [{}]
+        for _ in range(max(1, num_samples)):
+            us = searcher.propose([])
+            for point in self.grid_points():
+                yield self.realize(point, us)
+                if searcher.alg == "random":
+                    # fresh coordinates per grid point: random explores
+                    # |grid| x num_samples distinct sampled configs
+                    # (quasirandom keeps one Halton row per draw)
+                    us = searcher.propose([])
+
+
+class Searcher:
+    """Sequential trial proposer over the unit cube shared by every
+    :class:`ParamDef` strategy.
+
+    - ``random``: i.i.d. uniform (the reference's Ray Tune default).
+    - ``quasirandom``: Halton sequence — stratified coverage at small trial
+      counts (beyond the reference).
+    - ``bayesopt`` / ``tpe``: Tree-structured Parzen Estimator, the adaptive
+      capability the reference delegates to Ray's BayesOptSearch/TuneBOHB
+      (``trlx/sweep.py:103-133``). After a quasirandom warmup, completed
+      trials are split into good/bad by metric quantile (γ = 0.25); per
+      dimension a Parzen mixture (Gaussians at observed coordinates + a
+      uniform prior component) models each set, candidates are drawn from
+      the good mixture, and the one maximizing ``log l(u|good) −
+      log l(u|bad)`` is proposed — expected-improvement-proportional
+      acquisition, per Bergstra et al. 2011.
+    """
+
+    def __init__(
+        self,
+        ndims: int,
+        alg: str = "random",
+        seed: int = 0,
+        gamma: float = 0.25,
+        n_candidates: int = 24,
+        n_startup: Optional[int] = None,
+    ):
+        if alg not in ("random", "quasirandom", "bayesopt", "tpe"):
+            raise ValueError(
+                f"search_alg '{alg}' not supported "
+                "(random, quasirandom, bayesopt/tpe)"
+            )
+        self.ndims = ndims
+        self.alg = alg
+        self.rng = np.random.RandomState(seed)
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.n_startup = n_startup or max(4, 2 * ndims)
+        self._draw = 0
+
+    @property
+    def adaptive(self) -> bool:
+        return self.alg in ("bayesopt", "tpe")
+
+    def propose(self, history: List[Tuple[List[float], float]]) -> np.ndarray:
+        """Next unit-cube point. ``history`` holds completed trials as
+        ``(u_vector, metric)`` with larger metric = better (callers negate
+        for minimization); non-adaptive algorithms ignore it."""
+        self._draw += 1
+        halton_row = np.array(
+            [_halton(self._draw, _PRIMES[j % len(_PRIMES)]) for j in range(self.ndims)]
         )
-        draws = max(1, num_samples)
-        for i in range(draws):
-            for point in grid_points:
-                hp = dict(point)
-                for j, p in enumerate(self.sampled):
-                    if search_alg == "quasirandom":
-                        u = _halton(i + 1, _PRIMES[j % len(_PRIMES)])
-                    else:
-                        u = rng.rand()
-                    hp[p.key] = p.sample(u, rng)
-                yield hp
+        if self.alg == "random":
+            return self.rng.rand(self.ndims)
+        if self.alg == "quasirandom" or len(history) < self.n_startup:
+            return halton_row
+        ordered = sorted(history, key=lambda t: -t[1])
+        n_good = max(2, int(np.ceil(self.gamma * len(ordered))))
+        good = np.asarray([u for u, _ in ordered[:n_good]], float)
+        bad = np.asarray([u for u, _ in ordered[n_good:]], float)
+        us = np.empty(self.ndims)
+        for j in range(self.ndims):
+            cands = self._parzen_draw(good[:, j])
+            score = self._parzen_logpdf(cands, good[:, j]) - self._parzen_logpdf(
+                cands, bad[:, j] if bad.size else np.empty(0)
+            )
+            us[j] = cands[int(np.argmax(score))]
+        return us
+
+    @staticmethod
+    def _bandwidth(n: int) -> float:
+        return float(np.clip(1.06 * 0.3 / max(n, 1) ** 0.2, 0.06, 0.5))
+
+    def _parzen_draw(self, centers: np.ndarray) -> np.ndarray:
+        """Candidates from the good mixture (uniform component included)."""
+        bw = self._bandwidth(len(centers))
+        picks = self.rng.randint(-1, len(centers), size=self.n_candidates)
+        cands = np.where(
+            picks < 0,
+            self.rng.rand(self.n_candidates),
+            centers[np.clip(picks, 0, None)] + bw * self.rng.randn(self.n_candidates),
+        )
+        return np.clip(cands, 0.0, 1.0 - 1e-9)
+
+    def _parzen_logpdf(self, x: np.ndarray, centers: np.ndarray) -> np.ndarray:
+        """log density of the Parzen mixture: Gaussians at ``centers`` plus
+        one uniform prior component (keeps the ratio bounded off-support)."""
+        if centers.size == 0:
+            return np.zeros_like(x)
+        bw = self._bandwidth(len(centers))
+        z = (x[:, None] - centers[None, :]) / bw
+        comps = np.exp(-0.5 * z**2) / (bw * np.sqrt(2 * np.pi))
+        dens = (comps.sum(axis=1) + 1.0) / (len(centers) + 1)
+        return np.log(dens + 1e-12)
 
 
 def run_trial(
@@ -217,35 +336,58 @@ def run_sweep(
     os.makedirs(output_dir, exist_ok=True)
     results_path = os.path.join(output_dir, "results.jsonl")
     records: List[Dict[str, Any]] = []
-    trials = list(space.trials(n, seed=seed, search_alg=search_alg))
-    logger.info(f"Sweep: {len(trials)} trials of {os.path.basename(script)} → {output_dir}")
+    searcher = Searcher(len(space.sampled), search_alg, seed=seed)
+    grid_points = space.grid_points()
+    draws = max(1, n)
+    sign = 1.0 if mode == "max" else -1.0
+    logger.info(
+        f"Sweep[{search_alg}]: {draws * len(grid_points)} trials of "
+        f"{os.path.basename(script)} → {output_dir}"
+    )
 
     with open(results_path, "w") as results_f:
-        for i, hparams in enumerate(trials):
-            t0 = time.time()
-            result_path = os.path.join(output_dir, f"trial_{i:03d}.json")
-            log_path = os.path.join(output_dir, f"trial_{i:03d}.log")
-            rc = run_trial(script, hparams, result_path, log_path, trial_timeout, extra_env)
-            stats: Dict[str, Any] = {}
-            if os.path.exists(result_path):
-                with open(result_path) as f:
-                    stats = json.load(f)
-            record = {
-                "trial": i,
-                "hparams": hparams,
-                "rc": rc,
-                "runtime_s": round(time.time() - t0, 1),
-                "metric": stats.get("stats", {}).get(metric),
-                "stats": stats.get("stats", {}),
-                "iter_count": stats.get("iter_count"),
-            }
-            records.append(record)
-            results_f.write(json.dumps(record) + "\n")
-            results_f.flush()
-            logger.info(
-                f"trial {i}: rc={rc} {metric}={record['metric']} "
-                f"({record['runtime_s']}s) {hparams}"
-            )
+        i = 0
+        for _ in range(draws):
+            us = None
+            for point in grid_points:
+                if us is None or searcher.alg == "random":
+                    # random: fresh coordinates per grid point (full
+                    # |grid| x num_samples coverage). quasirandom: one
+                    # Halton row per draw. TPE: one proposal per draw —
+                    # grid dims are marginalized out of its model.
+                    history = [
+                        (r["u"], sign * r["metric"])
+                        for r in records
+                        if r.get("u") is not None and r.get("metric") is not None
+                    ]
+                    us = searcher.propose(history)
+                hparams = space.realize(point, us)
+                t0 = time.time()
+                result_path = os.path.join(output_dir, f"trial_{i:03d}.json")
+                log_path = os.path.join(output_dir, f"trial_{i:03d}.log")
+                rc = run_trial(script, hparams, result_path, log_path, trial_timeout, extra_env)
+                stats: Dict[str, Any] = {}
+                if os.path.exists(result_path):
+                    with open(result_path) as f:
+                        stats = json.load(f)
+                record = {
+                    "trial": i,
+                    "hparams": hparams,
+                    "u": [float(x) for x in us],
+                    "rc": rc,
+                    "runtime_s": round(time.time() - t0, 1),
+                    "metric": stats.get("stats", {}).get(metric),
+                    "stats": stats.get("stats", {}),
+                    "iter_count": stats.get("iter_count"),
+                }
+                records.append(record)
+                results_f.write(json.dumps(record) + "\n")
+                results_f.flush()
+                logger.info(
+                    f"trial {i}: rc={rc} {metric}={record['metric']} "
+                    f"({record['runtime_s']}s) {hparams}"
+                )
+                i += 1
 
     def rank_key(r):
         m = r["metric"]
