@@ -44,7 +44,41 @@ import numpy as np
 A100_BASELINE_SAMPLES_PER_SEC = 40.0
 
 
-def _init_devices(retries=4, delay=15.0):
+def _probe_accelerator(timeout_s: float) -> bool:
+    """Try TPU backend init in a THROWAWAY subprocess with a hard timeout.
+
+    A contended single-tenant chip can make ``jax.devices()`` *hang* on the
+    tunnel claim (not just raise UNAVAILABLE) — a stale session from a killed
+    process holds the chip until the server notices. Probing in a subprocess
+    converts that hang into a retryable failure instead of wedging the bench
+    past the driver's timeout. Costs one extra backend init (~30s) on the
+    healthy path — cheap insurance against losing the whole bench window.
+
+    Termination is escalated (SIGTERM, grace, then SIGKILL) and the timeout
+    is generous relative to normal init: a probe killed while *waiting* for
+    the claim is harmless; only a kill in the narrow post-claim init window
+    could itself wedge the chip, which the long timeout makes unlikely.
+    """
+    import subprocess
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        return proc.wait(timeout=timeout_s) == 0
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        return False
+
+
+def _init_devices(retries=4, delay=15.0, probe_timeout=150.0):
     """``jax.devices()`` with fail-soft retry, then forced-CPU fallback.
 
     Returns ``(devices, fallback_exc)`` — ``fallback_exc`` is None unless we
@@ -55,6 +89,10 @@ def _init_devices(retries=4, delay=15.0):
     last_err = None
     for i in range(retries):
         try:
+            if not _probe_accelerator(probe_timeout):
+                raise RuntimeError(
+                    f"accelerator init probe failed/hung (> {probe_timeout}s)"
+                )
             return jax.devices(), None
         except Exception as e:  # backend init failure (e.g. contended chip)
             last_err = e
@@ -162,6 +200,46 @@ def main():
     samples_per_sec = n_cycles * chunk / dt
     per_chip = samples_per_sec / max(n_dev, 1)
     tag = " [cpu-fallback]" if on_cpu else ""
+
+    # Analytic MFU estimate (stderr; stdout stays the one-line contract).
+    # Scaling-book accounting: forward ≈ 2·N FLOPs/token, backward ≈ 4·N
+    # over the trainable fraction. Tokens per cycle: decode (prefill P +
+    # N_new single-token steps), the scoring fwd (policy full + hydra ref
+    # branch ≈ unfrozen fraction), and ppo_epochs train fwd+bwd. Attention
+    # FLOPs (~3% at these shapes) excluded — a lower bound.
+    n_params = sum(
+        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(trainer.state.params)
+    )
+    seq = prompt_tokens + max_new
+    unfrozen_frac = 2 / 12  # num_layers_unfrozen=2 of 12 (config above)
+    tok = chunk * seq
+    fwd = 2 * n_params
+    cycle_flops = (
+        tok * fwd  # decode (prefill + steps, cache makes each token one fwd)
+        + tok * fwd * (1 + unfrozen_frac)  # scoring fwd + hydra ref branch
+        + config.method.ppo_epochs * tok * (fwd + 2 * fwd * unfrozen_frac)
+    )
+    peak = float("nan")
+    if not on_cpu:
+        kind = getattr(devices[0], "device_kind", "").lower()
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+        peaks = {"v4": 275e12, "v5e": 197e12, "v5 lite": 197e12, "v5p": 459e12, "v6e": 918e12}
+        for key, val in peaks.items():
+            if key in kind or key == gen:
+                peak = val  # bf16 peak per chip
+                break
+    mfu = cycle_flops * n_cycles / dt / (peak * max(n_dev, 1))
+    print(
+        json.dumps(
+            {
+                "mfu_estimate": round(mfu, 4) if np.isfinite(mfu) else None,
+                "samples_per_sec_per_chip": round(per_chip, 3),
+                "cycle_tflops": round(cycle_flops / 1e12, 3),
+                "note": "analytic lower-bound MFU (2N fwd / 6N train per token, attention excluded)",
+            }
+        ),
+        file=sys.stderr,
+    )
     print(
         json.dumps(
             {

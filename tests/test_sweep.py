@@ -139,3 +139,82 @@ def test_searcher_rejects_unknown_alg():
 
     with pytest.raises(ValueError, match="not supported"):
         Searcher(2, "bohb9000")
+
+
+def test_asha_successive_halving(tmp_path):
+    """asha scheduler: rung populations shrink by reduction_factor while the
+    budget dot-path grows by it, and the final rung runs at max_t."""
+    script = tmp_path / "toy.py"
+    script.write_text(textwrap.dedent("""
+        import json, os, sys
+        def main(hparams):
+            x = hparams["method.init_kl_coef"]
+            steps = hparams["train.total_steps"]
+            # quality improves with budget; optimum at x=0.3
+            score = -abs(x - 0.3) + 0.01 * steps
+            out = os.environ.get("TRLX_TPU_SWEEP_RESULT")
+            if out:
+                with open(out, "w") as f:
+                    json.dump({"stats": {"reward/mean": score}, "iter_count": steps}, f)
+        if __name__ == "__main__":
+            main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})
+    """))
+    config = {
+        "tune_config": {
+            "mode": "max", "metric": "reward/mean", "search_alg": "random",
+            "num_samples": 6, "scheduler": "asha",
+            "grace_period": 2, "reduction_factor": 3, "max_t": 18,
+        },
+        "method.init_kl_coef": {"strategy": "uniform", "values": [0.0, 1.0]},
+    }
+    records = run_sweep(str(script), config, str(tmp_path / "out"), trial_timeout=60)
+    by_rung = {}
+    for r in records:
+        by_rung.setdefault(r["rung"], []).append(r)
+    assert sorted(by_rung) == [0, 1, 2]
+    assert len(by_rung[0]) == 6 and len(by_rung[1]) == 2 and len(by_rung[2]) == 1
+    assert all(r["hparams"]["train.total_steps"] == 2 for r in by_rung[0])
+    assert all(r["hparams"]["train.total_steps"] == 6 for r in by_rung[1])
+    assert by_rung[2][0]["hparams"]["train.total_steps"] == 18
+    # the promoted survivor is the rung-1 winner's hparams
+    rung1_best = max(by_rung[1], key=lambda r: r["metric"])
+    assert by_rung[2][0]["hparams"]["method.init_kl_coef"] == rung1_best["hparams"]["method.init_kl_coef"]
+    # ranked report exists
+    assert (tmp_path / "out" / "report.md").exists()
+
+
+def test_asha_requires_max_t(tmp_path):
+    config = {
+        "tune_config": {"scheduler": "hyperband", "num_samples": 2},
+        "x": {"strategy": "uniform", "values": [0.0, 1.0]},
+    }
+    with pytest.raises(ValueError, match="max_t"):
+        run_sweep("does_not_matter.py", config, str(tmp_path / "out"))
+
+
+def test_asha_lone_survivor_runs_at_max_t(tmp_path):
+    """When the population collapses to one survivor early, it jumps straight
+    to the full max_t budget (review regression: the winner must always get
+    its final-budget run)."""
+    script = tmp_path / "toy.py"
+    script.write_text(textwrap.dedent("""
+        import json, os, sys
+        def main(hparams):
+            out = os.environ.get("TRLX_TPU_SWEEP_RESULT")
+            if out:
+                with open(out, "w") as f:
+                    json.dump({"stats": {"reward/mean": hparams["x"]}}, f)
+        if __name__ == "__main__":
+            main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})
+    """))
+    config = {
+        "tune_config": {"mode": "max", "metric": "reward/mean", "num_samples": 3,
+                        "scheduler": "asha", "grace_period": 2,
+                        "reduction_factor": 3, "max_t": 18,
+                        "budget_key": "train.total_steps"},
+        "x": {"strategy": "uniform", "values": [0.0, 1.0]},
+    }
+    records = run_sweep(str(script), config, str(tmp_path / "out"), trial_timeout=60)
+    final = [r for r in records if r["rung"] == 1]
+    assert len(final) == 1
+    assert final[0]["hparams"]["train.total_steps"] == 18

@@ -29,8 +29,10 @@ strategy is a deterministic map from a unit coordinate ``u`` ∈ [0,1), so
 all three samplers share one space: random draws u uniformly, quasirandom
 from a Halton sequence, and TPE models completed trials' u-vectors with
 good/bad Parzen mixtures and proposes the candidate maximizing their
-density ratio. ``scheduler`` only accepts ``fifo`` (Ray's early-stopping
-schedulers don't map to subprocess trials).
+density ratio. Schedulers: ``fifo`` (every trial runs its full budget) or
+``asha``/``hyperband`` — successive halving over a budget dot-path (the
+reference's Ray HyperBandScheduler capability, adapted to sequential
+subprocess trials: promotions rerun at the larger budget).
 """
 
 import argparse
@@ -323,15 +325,30 @@ def run_sweep(
 ) -> List[Dict[str, Any]]:
     """Run every trial sequentially (one accelerator — concurrency is
     cross-host, not cross-trial), logging a JSONL results table, and return
-    the records ranked best-first."""
+    the records ranked best-first.
+
+    Schedulers (``tune_config.scheduler``): ``fifo`` (default — every trial
+    runs its full budget, the reference's default) or ``asha``/``hyperband``
+    — synchronous successive halving, the reference's Ray
+    ``HyperBandScheduler`` capability (``trlx/sweep.py:136-174``): the
+    initial population runs at a small budget (``grace_period`` steps of the
+    ``budget_key`` dot-path, default ``train.total_steps``), the top
+    ``1/reduction_factor`` fraction is promoted to an ``eta``-times larger
+    budget, repeating until ``max_t``. Promoted trials rerun at the larger
+    budget (same hparams); configure checkpointing dot-paths in the sweep to
+    make reruns resume instead.
+    """
     space = SweepSpace.from_config(config)
     tune = space.tune
     metric = tune.get("metric", "reward/mean")
     mode = tune.get("mode", "max")
     n = num_samples or int(tune.get("num_samples", 4))
     search_alg = tune.get("search_alg", "random")
-    if tune.get("scheduler", "fifo") != "fifo":
-        raise ValueError("Only the fifo scheduler is supported (no Ray trial preemption)")
+    scheduler = tune.get("scheduler", "fifo")
+    if scheduler not in ("fifo", "asha", "hyperband"):
+        raise ValueError(
+            f"scheduler '{scheduler}' not supported (fifo, asha/hyperband)"
+        )
 
     os.makedirs(output_dir, exist_ok=True)
     results_path = os.path.join(output_dir, "results.jsonl")
@@ -341,53 +358,69 @@ def run_sweep(
     draws = max(1, n)
     sign = 1.0 if mode == "max" else -1.0
     logger.info(
-        f"Sweep[{search_alg}]: {draws * len(grid_points)} trials of "
-        f"{os.path.basename(script)} → {output_dir}"
+        f"Sweep[{search_alg}/{scheduler}]: {draws * len(grid_points)} base trials "
+        f"of {os.path.basename(script)} → {output_dir}"
     )
 
     with open(results_path, "w") as results_f:
-        i = 0
-        for _ in range(draws):
-            us = None
-            for point in grid_points:
-                if us is None or searcher.alg == "random":
-                    # random: fresh coordinates per grid point (full
-                    # |grid| x num_samples coverage). quasirandom: one
-                    # Halton row per draw. TPE: one proposal per draw —
-                    # grid dims are marginalized out of its model.
-                    history = [
-                        (r["u"], sign * r["metric"])
-                        for r in records
-                        if r.get("u") is not None and r.get("metric") is not None
-                    ]
-                    us = searcher.propose(history)
-                hparams = space.realize(point, us)
-                t0 = time.time()
-                result_path = os.path.join(output_dir, f"trial_{i:03d}.json")
-                log_path = os.path.join(output_dir, f"trial_{i:03d}.log")
-                rc = run_trial(script, hparams, result_path, log_path, trial_timeout, extra_env)
-                stats: Dict[str, Any] = {}
-                if os.path.exists(result_path):
-                    with open(result_path) as f:
-                        stats = json.load(f)
-                record = {
-                    "trial": i,
-                    "hparams": hparams,
-                    "u": [float(x) for x in us],
-                    "rc": rc,
-                    "runtime_s": round(time.time() - t0, 1),
-                    "metric": stats.get("stats", {}).get(metric),
-                    "stats": stats.get("stats", {}),
-                    "iter_count": stats.get("iter_count"),
-                }
-                records.append(record)
-                results_f.write(json.dumps(record) + "\n")
-                results_f.flush()
-                logger.info(
-                    f"trial {i}: rc={rc} {metric}={record['metric']} "
-                    f"({record['runtime_s']}s) {hparams}"
-                )
-                i += 1
+
+        def launch(hparams: Dict[str, Any], us: np.ndarray, rung: Optional[int] = None) -> Dict[str, Any]:
+            i = len(records)
+            t0 = time.time()
+            result_path = os.path.join(output_dir, f"trial_{i:03d}.json")
+            log_path = os.path.join(output_dir, f"trial_{i:03d}.log")
+            rc = run_trial(script, hparams, result_path, log_path, trial_timeout, extra_env)
+            stats: Dict[str, Any] = {}
+            if os.path.exists(result_path):
+                with open(result_path) as f:
+                    stats = json.load(f)
+            record = {
+                "trial": i,
+                "hparams": hparams,
+                "u": [float(x) for x in us],
+                "rc": rc,
+                "runtime_s": round(time.time() - t0, 1),
+                "metric": stats.get("stats", {}).get(metric),
+                "stats": stats.get("stats", {}),
+                "iter_count": stats.get("iter_count"),
+            }
+            if rung is not None:
+                record["rung"] = rung
+            records.append(record)
+            results_f.write(json.dumps(record) + "\n")
+            results_f.flush()
+            logger.info(
+                f"trial {i}{'' if rung is None else f' (rung {rung})'}: rc={rc} "
+                f"{metric}={record['metric']} ({record['runtime_s']}s) {hparams}"
+            )
+            return record
+
+        def next_us() -> np.ndarray:
+            history = [
+                (r["u"], sign * r["metric"])
+                for r in records
+                if r.get("u") is not None and r.get("metric") is not None
+            ]
+            return searcher.propose(history)
+
+        def proposals() -> Iterator[Tuple[Dict[str, Any], np.ndarray]]:
+            """Lazy (hparams, u) stream: proposed only when consumed, so
+            adaptive search sees every completed trial so far. random draws
+            fresh coordinates per grid point (full |grid| x num_samples
+            coverage); quasirandom keeps one Halton row per draw; TPE
+            proposes once per draw — grid dims are marginalized out."""
+            for _ in range(draws):
+                us = None
+                for point in grid_points:
+                    if us is None or searcher.alg == "random":
+                        us = next_us()
+                    yield space.realize(point, us), us
+
+        if scheduler == "fifo":
+            for hparams, us in proposals():
+                launch(hparams, us)
+        else:
+            _run_asha(tune, proposals(), launch, sign)
 
     def rank_key(r):
         m = r["metric"]
@@ -398,6 +431,57 @@ def run_sweep(
     records.sort(key=rank_key)
     report(records, metric, mode, output_dir)
     return records
+
+
+def _run_asha(
+    tune: Dict[str, Any],
+    proposals: Iterator[Tuple[Dict[str, Any], np.ndarray]],
+    launch,
+    sign: float,
+) -> None:
+    """Synchronous successive halving over the trial budget.
+
+    Rung r runs its population with the ``budget_key`` dot-path overridden to
+    ``grace_period * reduction_factor**r`` (capped at ``max_t``); the top
+    ``1/reduction_factor`` fraction by metric is promoted to the next rung.
+    The capability analogue of Ray's HyperBandScheduler in the reference
+    (``trlx/sweep.py:136-174``) adapted to sequential subprocess trials:
+    promotions rerun at the larger budget rather than preempting/resuming a
+    live actor.
+    """
+    eta = int(tune.get("reduction_factor", 3))
+    if eta < 2:
+        raise ValueError(f"reduction_factor must be >= 2, got {eta}")
+    max_t = tune.get("max_t")
+    if max_t is None:
+        raise ValueError("asha scheduler requires tune_config.max_t (final budget)")
+    max_t = int(max_t)
+    grace = int(tune.get("grace_period", max(1, max_t // eta**2)))
+    budget_key = tune.get("budget_key", "train.total_steps")
+
+    t = min(grace, max_t)
+    # rung 0 consumes the proposal stream lazily, so adaptive search
+    # (bayesopt) sees each completed low-budget trial before proposing the
+    # next — draining it upfront would silently degrade TPE to its warmup
+    results = []
+    for hparams, us in proposals:
+        rec = launch({**hparams, budget_key: t}, us, rung=0)
+        if rec["metric"] is not None:
+            results.append((sign * rec["metric"], hparams, us))
+    rung = 0
+    while t < max_t and results:
+        results.sort(key=lambda r: -r[0])
+        n_keep = max(1, int(np.ceil(len(results) / eta)))
+        survivors = results[:n_keep]
+        # a lone survivor jumps straight to the final budget: the winning
+        # config always gets its full max_t run
+        t = max_t if len(survivors) <= 1 else min(t * eta, max_t)
+        rung += 1
+        results = []
+        for _, hparams, us in survivors:
+            rec = launch({**hparams, budget_key: t}, us, rung=rung)
+            if rec["metric"] is not None:
+                results.append((sign * rec["metric"], hparams, us))
 
 
 def report(records: List[Dict[str, Any]], metric: str, mode: str, output_dir: str) -> None:

@@ -217,6 +217,8 @@ class TPUBaseTrainer(BaseRLTrainer):
         )
         self._generate_fns: Dict[Any, Callable] = {}
         self._train_step_fn: Optional[Callable] = None
+        self._last_batch_host: Any = None
+        self._last_batch_sharded: Any = None
 
         self.tracker = make_tracker(config)
         self.eval_pipeline: Optional[BasePipeline] = None
@@ -328,14 +330,24 @@ class TPUBaseTrainer(BaseRLTrainer):
         return jax.jit(step_fn, donate_argnums=(0,))
 
     def train_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
-        """One optimization step on a host batch; returns host scalar stats."""
+        """One optimization step on a host batch; returns host scalar stats.
+
+        The sharded device copy is memoized on the batch object: the PPO
+        inner loop replays the same batch ``ppo_epochs`` times
+        (``n_updates_per_batch``), and one host→device transfer serves all
+        replays."""
         set_global_mesh(self.mesh)
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
-        if hasattr(batch, "_asdict"):  # NamedTuple batches (PPORLBatch, ILQLBatch)
-            batch = batch._asdict()
-        arrays = {k: v for k, v in batch.items() if hasattr(v, "ndim")}
-        arrays = shard_batch(arrays, self.mesh)
+        if batch is self._last_batch_host:
+            arrays = self._last_batch_sharded
+        else:
+            items = batch._asdict() if hasattr(batch, "_asdict") else batch
+            arrays = shard_batch(
+                {k: v for k, v in items.items() if hasattr(v, "ndim")}, self.mesh
+            )
+            self._last_batch_host = batch
+            self._last_batch_sharded = arrays
         self.state, stats = self._train_step_fn(self.state, arrays)
         return stats
 
