@@ -211,7 +211,7 @@ def main():
         int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(trainer.state.params)
     )
     seq = prompt_tokens + max_new
-    unfrozen_frac = 2 / 12  # num_layers_unfrozen=2 of 12 (config above)
+    unfrozen_frac = config.model.num_layers_unfrozen / trainer.tcfg.num_layers
     tok = chunk * seq
     fwd = 2 * n_params
     cycle_flops = (

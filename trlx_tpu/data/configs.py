@@ -226,6 +226,12 @@ class TrainConfig:
     # ``accelerate_base_trainer.py:452-460``; NeMo ``resume_if_exists``).
     resume_from_checkpoint: bool = False
 
+    # Background-thread batch prefetch depth for the training loader (the
+    # reference's torch DataLoader num_workers/prefetch_factor capability):
+    # up to this many collated batches are prepared ahead while the device
+    # runs the current step. 0 disables.
+    prefetch_batches: int = 2
+
     from_dict = classmethod(_strict_from_dict)
 
 

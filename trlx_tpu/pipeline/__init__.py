@@ -78,6 +78,75 @@ class BatchLoader:
             yield self.collate_fn([self.dataset[i] for i in idxs])
 
 
+class PrefetchLoader:
+    """Background-thread prefetch over any re-iterable batch loader.
+
+    The torch ``DataLoader(num_workers, prefetch_factor)`` capability the
+    reference leans on (SURVEY.md §2.4 "torch C++ data machinery"): a worker
+    thread keeps up to ``depth`` collated batches ready while the device
+    consumes the current one. Collation bottoms out in the native C++
+    ``pad_rows`` (ctypes releases the GIL), so the overlap is real. One
+    worker preserves batch order and shuffle determinism; worker exceptions
+    re-raise in the consumer.
+    """
+
+    def __init__(self, loader, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.loader = loader
+        self.depth = depth
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def __iter__(self) -> Iterator[Any]:
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        _END, _ERR = object(), object()
+
+        def put(item) -> bool:
+            """Enqueue unless the consumer cancelled; never blocks forever."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for batch in self.loader:
+                    if not put(batch):
+                        return  # cancelled: stop collating, drop the epoch
+                put(_END)
+            except BaseException as e:  # re-raised in the consumer
+                put((_ERR, e))
+
+        t = threading.Thread(target=worker, daemon=True, name="trlx-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+                    raise item[1]
+                yield item
+        finally:
+            # consumer stopped (early break, exception, or exhaustion): cancel
+            # the worker between batches rather than draining a whole epoch
+            stop.set()
+            try:
+                q.get_nowait()  # unblock a put in flight
+            except queue.Empty:
+                pass
+            t.join(timeout=5)
+
+
 class BasePipeline:
     """An indexable dataset of prompts/samples."""
 

@@ -329,6 +329,23 @@ class TPUBaseTrainer(BaseRLTrainer):
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
+    def _drop_batch_memo(self) -> None:
+        """Release the memoized sharded batch (one batch of HBM) once its
+        replay window is over — before rollout collection / final eval."""
+        self._last_batch_host = None
+        self._last_batch_sharded = None
+
+    def _maybe_prefetch(self, loader):
+        """Wrap the training loader in background-thread prefetch
+        (``train.prefetch_batches`` deep) so collation overlaps the device
+        step — the reference's DataLoader-worker capability."""
+        depth = getattr(self.config.train, "prefetch_batches", 0)
+        if depth and loader is not None:
+            from trlx_tpu.pipeline import PrefetchLoader
+
+            return PrefetchLoader(loader, depth)
+        return loader
+
     def train_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         """One optimization step on a host batch; returns host scalar stats.
 
@@ -662,7 +679,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         profile_dir = getattr(self.config.train, "profile_dir", None)
         profiling = False
         for _ in range(self.config.train.epochs):
-            for batch in self.train_dataloader:
+            for batch in self._maybe_prefetch(self.train_dataloader):
                 for _ in range(self.n_updates_per_batch):
                     if profile_dir and self.iter_count == 1 and not profiling:
                         jax.profiler.start_trace(profile_dir)
@@ -714,6 +731,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                         if profiling:
                             jax.profiler.stop_trace()
                             profiling = False
+                        self._drop_batch_memo()
                         results = self.evaluate()
                         stats.update(results)
                         self.tracker.log(stats, step=self.iter_count)
@@ -726,6 +744,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                     self.tracker.log(stats, step=self.iter_count)
 
                 self.post_backward_callback()
+            self._drop_batch_memo()  # free the batch's HBM before rollouts
             self.post_epoch_callback()
         if profiling:
             jax.profiler.stop_trace()
