@@ -1,0 +1,68 @@
+"""GRPO on IMDB sentiment (beyond the reference: trlx v0.6.0 has no GRPO).
+
+Same task shape as ``ppo_sentiments.py``, but learning is group-relative:
+each prompt samples a group of continuations, the sentiment score is
+normalized within the group, and no value function is trained — the modern
+critic-free RLHF recipe (DeepSeekMath §4.1)."""
+
+import os
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_grpo_config
+
+from sentiment_util import get_positive_sentiment_fn, review_prompts
+
+
+def resolve_model():
+    path = os.environ.get("MODEL_PATH")
+    if path:
+        return path, path
+    try:
+        from transformers import AutoConfig
+
+        AutoConfig.from_pretrained("gpt2")
+        return "gpt2", "gpt2"
+    except Exception:
+        return "builtin:gpt2-small", "builtin:bytes"
+
+
+def main(hparams=None):
+    model_path, tokenizer_path = resolve_model()
+    sentiment = get_positive_sentiment_fn()
+
+    config = default_grpo_config().evolve(
+        train=dict(
+            seq_length=128,
+            batch_size=32,
+            total_steps=2000,
+            eval_interval=100,
+            checkpoint_interval=10000,
+            checkpoint_dir="ckpts/grpo_sentiments",
+        ),
+        model=dict(model_path=model_path),
+        tokenizer=dict(tokenizer_path=tokenizer_path),
+        method=dict(
+            gen_kwargs=dict(max_new_tokens=40, top_k=0, top_p=1.0, do_sample=True)
+        ),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        return sentiment(samples)
+
+    return trlx.train(
+        reward_fn=reward_fn,
+        prompts=review_prompts(256, seed=0),
+        eval_prompts=review_prompts(64, seed=1),
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

@@ -348,3 +348,37 @@ def test_ppo_sentiments_llama_gqa_smoke(tmp_path):
     )
     assert trainer.iter_count >= 1
     assert trainer.tcfg.kv_heads < trainer.tcfg.num_heads  # really GQA
+
+
+def test_long_context_sft_smoke(tmp_path, monkeypatch):
+    """Long-context SFT over the sequence axis (ring attention): CI-size run
+    at 512 tokens on a sequence=2 mesh."""
+    monkeypatch.setenv("LONG_CTX_CI", "1")
+    import long_context_sft
+
+    trainer = long_context_sft.main({"train.checkpoint_dir": str(tmp_path / "ck")})
+    assert trainer.iter_count >= 2
+    assert trainer.mesh.shape["sequence"] == 2
+
+
+def test_grpo_sentiments_smoke(tmp_path, monkeypatch):
+    monkeypatch.delenv("MODEL_PATH", raising=False)
+    import grpo_sentiments
+
+    trainer = grpo_sentiments.main(
+        {
+            "tokenizer.tokenizer_path": "builtin:bytes",
+            "train.total_steps": 2,
+            "train.epochs": 100,
+            "train.eval_interval": 2,
+            "train.batch_size": 8,
+            "train.seq_length": 56,
+            "train.checkpoint_dir": str(tmp_path / "ckpt"),
+            "model.model_path": "builtin:gpt2-test",
+            "method.num_rollouts": 8,
+            "method.chunk_size": 8,
+            "method.group_size": 4,
+            "method.ppo_epochs": 1,
+        }
+    )
+    assert trainer.iter_count == 2

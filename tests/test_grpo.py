@@ -1,0 +1,125 @@
+"""GRPO trainer/method tests (beyond the reference — no counterpart there;
+test strategy follows SURVEY.md §4: pure-function unit tests + tiny e2e).
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import trlx_tpu as trlx
+from trlx_tpu.data.default_configs import default_grpo_config
+from trlx_tpu.models.grpo import GRPOConfig, group_advantages_np
+
+
+def test_group_advantages():
+    scores = np.asarray([1.0, 2.0, 3.0, 10.0, 10.0, 10.0], np.float32)
+    adv = group_advantages_np(scores, 3)
+    # first group: centered and scaled; second group: zero std → ~0
+    assert abs(adv[:3].sum()) < 1e-5
+    assert adv[2] > 0 > adv[0]
+    np.testing.assert_allclose(adv[3:], 0.0, atol=1e-4)
+    # Dr.GRPO variant: centered only
+    adv_ns = group_advantages_np(scores, 3, scale=False)
+    np.testing.assert_allclose(adv_ns[:3], [-1.0, 0.0, 1.0], atol=1e-6)
+    with pytest.raises(ValueError, match="divisible"):
+        group_advantages_np(scores, 4)
+
+
+def test_grpo_loss_directions():
+    """Positive-advantage sequences are pushed up, negative down; KL term is
+    non-negative and zero at the reference."""
+    cfg = GRPOConfig(name="GRPOConfig", beta=0.1, cliprange=0.2)
+    B, R = 4, 6
+    rng = np.random.RandomState(0)
+    old = jnp.asarray(rng.uniform(-2, -1, (B, R)), jnp.float32)
+    mask = jnp.ones((B, R), jnp.float32)
+    adv = jnp.asarray([1.0, 1.0, -1.0, -1.0], jnp.float32)
+
+    # at logprobs == old == ref: ratio 1, KL 0 → loss 0
+    loss0, stats0 = cfg.loss(old, old, old, adv, mask)
+    np.testing.assert_allclose(float(loss0), 0.0, atol=1e-6)
+    np.testing.assert_allclose(float(stats0["losses/kl_loss"]), 0.0, atol=1e-6)
+
+    # raising logprobs of positive-advantage rows lowers the policy loss
+    # below its ratio-1 baseline of exactly 0 (advantages sum to 0)
+    up = old.at[:2].add(0.1)
+    _, stats_up = cfg.loss(up, old, old, adv, mask)
+    assert float(stats_up["losses/policy_loss"]) < float(stats0["losses/policy_loss"])
+    assert float(stats_up["losses/policy_loss"]) < 0.0
+    # lowering them instead raises it
+    down = old.at[:2].add(-0.1)
+    _, stats_down = cfg.loss(down, old, old, adv, mask)
+    assert float(stats_down["losses/policy_loss"]) > 0.0
+    # KL penalty is non-negative
+    assert float(stats_up["losses/kl_loss"]) >= 0.0
+
+    # clipping engages for large ratios
+    big = old + 1.0
+    _, stats_big = cfg.loss(big, old, old, adv, mask)
+    assert float(stats_big["policy/clipfrac"]) > 0.0
+
+
+def test_grpo_requires_group_divisibility(tmp_path):
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.grpo  # noqa: F401
+
+    config = default_grpo_config().evolve(
+        train=dict(checkpoint_dir=str(tmp_path), tracker=None),
+        method=dict(chunk_size=10, group_size=4),
+    )
+    with pytest.raises(ValueError, match="multiple"):
+        get_trainer(config.train.trainer)(
+            config=config, reward_fn=lambda **kw: [0.0], metric_fn=None, stop_sequences=[]
+        )
+
+
+@pytest.mark.slow
+def test_grpo_e2e(tmp_path):
+    """Tiny GRPO run through public train(): grouped rollouts, no value head,
+    finite losses, checkpoints."""
+    config = default_grpo_config().evolve(
+        train=dict(
+            seq_length=32,
+            batch_size=8,
+            total_steps=3,
+            eval_interval=3,
+            checkpoint_interval=100,
+            epochs=100,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+            logging_dir=str(tmp_path / "logs"),
+            tracker="jsonl",
+        ),
+        model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+        method=dict(
+            num_rollouts=8,
+            chunk_size=8,
+            group_size=4,
+            ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=6, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        return [float(len(o)) for o in outputs]
+
+    trainer = trlx.train(
+        reward_fn=reward_fn,
+        prompts=["hello world", "foo bar", "baz qux", "lorem ipsum"] * 2,
+        eval_prompts=["hello world", "foo bar"],
+        config=config,
+    )
+    assert trainer.iter_count == 3
+    # no value head in the param tree
+    assert "v_head" not in trainer.state.params
+    records = [
+        json.loads(l)
+        for l in open(os.path.join(config.train.logging_dir, "stats.jsonl"))
+    ]
+    assert any("losses/kl_loss" in r for r in records)
+    losses = [r["losses/total_loss"] for r in records if "losses/total_loss" in r]
+    assert losses and all(np.isfinite(l) for l in losses)
+    # grouped rollouts: store elements carry per-sequence advantages
+    assert all(hasattr(e, "advantage") for e in trainer.store.history)

@@ -1,0 +1,33 @@
+"""GRPO batch datatypes (same host-ragged / device-fixed split as
+``ppo_types``; no value or per-token reward fields — GRPO carries one
+group-relative advantage per sequence and the frozen-reference logprobs for
+the in-loss KL)."""
+
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import jax
+import numpy as np
+
+
+@dataclass
+class GRPORLElement:
+    """One collected experience (host side, ragged numpy)."""
+
+    query_tensor: np.ndarray  # [Q]
+    response_tensor: np.ndarray  # [R]
+    logprobs: np.ndarray  # [R] behavior logprobs
+    ref_logprobs: np.ndarray  # [R] frozen-reference logprobs
+    advantage: float  # group-relative, per sequence
+
+
+class GRPORLBatch(NamedTuple):
+    """A fixed-shape batch of experiences (device side)."""
+
+    query_tensors: jax.Array  # [B, Q] int32, left-padded
+    response_tensors: jax.Array  # [B, R] int32, right-padded
+    logprobs: jax.Array  # [B, R] float32
+    ref_logprobs: jax.Array  # [B, R] float32
+    advantages: jax.Array  # [B] float32
+    query_mask: jax.Array  # [B, Q]
+    response_mask: jax.Array  # [B, R]

@@ -1,0 +1,112 @@
+"""GRPO method: group-relative advantages + clipped objective, no value head.
+
+Beyond the reference (trlx v0.6.0 ships PPO/ILQL/SFT only): Group Relative
+Policy Optimization (Shao et al. 2024, DeepSeekMath §4.1) samples a *group*
+of responses per prompt and uses the group-normalized reward as a per-sequence
+advantage, dropping the value function entirely — half the trainable state
+and no GAE/value-loss machinery. The KL penalty moves from reward shaping
+into the loss (the unbiased k3 estimator against the frozen reference).
+
+Plugs into the same registries the reference's methods use
+(``trlx/data/method_configs.py:9-56``): ``GRPOConfig`` subclasses
+:class:`~trlx_tpu.models.ppo.PPOConfig`, so the PPO trainer's rollout
+machinery (jitted generation, hydra reference branch, score-free overlap)
+is inherited wholesale by :class:`~trlx_tpu.trainer.grpo.GRPOTrainer`.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data.method_configs import register_method
+from trlx_tpu.models.ppo import PPOConfig
+from trlx_tpu.utils import flatten_dict
+from trlx_tpu.utils.stats import get_tensor_stats
+
+
+def group_advantages_np(
+    scores: np.ndarray, group_size: int, scale: bool = True, eps: float = 1e-6
+) -> np.ndarray:
+    """Per-sequence advantages from grouped rewards (host side, numpy).
+
+    ``scores`` [B] must be laid out group-contiguously (the rollout loop
+    repeats each prompt ``group_size`` times in a row). ``scale=False``
+    skips the per-group std division (the "Dr. GRPO" variant, which removes
+    the difficulty bias of std normalization).
+    """
+    if scores.shape[0] % group_size:
+        raise ValueError(
+            f"batch {scores.shape[0]} not divisible by group_size {group_size}"
+        )
+    g = scores.reshape(-1, group_size)
+    adv = g - g.mean(axis=1, keepdims=True)
+    if scale:
+        adv = adv / (g.std(axis=1, keepdims=True) + eps)
+    return adv.reshape(-1).astype(np.float32)
+
+
+@dataclass
+@register_method("GRPOConfig")
+class GRPOConfig(PPOConfig):
+    """GRPO hyperparameters.
+
+    Inherits the PPO sampling/rollout knobs; the value-function fields
+    (``cliprange_value``, ``vf_coef``, ``gamma``, ``lam``) are unused.
+
+    :param group_size: responses sampled per prompt; ``chunk_size`` must be
+        a multiple of it.
+    :param beta: coefficient of the in-loss KL penalty vs the frozen
+        reference (k3 estimator) — replaces PPO's KL-shaped rewards.
+    :param scale_advantage: divide group-centered rewards by the group std
+        (True = original GRPO; False = Dr. GRPO).
+    """
+
+    name: str = "GRPOConfig"
+    group_size: int = 8
+    beta: float = 0.04
+    scale_advantage: bool = True
+
+    def loss(
+        self,
+        logprobs: jax.Array,  # [B, R] current policy logprobs of response tokens
+        old_logprobs: jax.Array,  # [B, R] behavior logprobs at collection time
+        ref_logprobs: jax.Array,  # [B, R] frozen-reference logprobs
+        advantages: jax.Array,  # [B] per-sequence group-relative advantages
+        mask: jax.Array,  # [B, R] response mask
+    ) -> Tuple[jax.Array, Dict[str, Any]]:
+        """Clipped ratio objective with sequence-level advantages and an
+        in-loss KL penalty; token-mean normalization (masked)."""
+        mask = mask.astype(jnp.float32)
+        n = jnp.maximum(mask.sum(), 1.0)
+        adv = advantages.astype(jnp.float32)[:, None]
+
+        log_ratio = (logprobs - old_logprobs) * mask
+        ratio = jnp.exp(log_ratio)
+        pg_loss1 = -adv * ratio
+        pg_loss2 = -adv * jnp.clip(ratio, 1.0 - self.cliprange, 1.0 + self.cliprange)
+        pg_loss = jnp.sum(jnp.maximum(pg_loss1, pg_loss2) * mask) / n
+
+        # k3 KL estimator vs the frozen reference (Schulman 2020): unbiased,
+        # guaranteed non-negative — exp(δ) − δ − 1 with δ = ref − current
+        delta = (ref_logprobs - logprobs) * mask
+        kl = jnp.sum((jnp.exp(delta) - delta - 1.0) * mask) / n
+
+        loss = pg_loss + self.beta * kl
+
+        approx_kl_old = 0.5 * jnp.sum(log_ratio**2) / n  # vs behavior policy
+        clipfrac = jnp.sum((pg_loss2 > pg_loss1).astype(jnp.float32) * mask) / n
+        stats = dict(
+            losses=dict(
+                total_loss=loss,
+                policy_loss=pg_loss,
+                kl_loss=kl,
+            ),
+            ratio=get_tensor_stats(ratio, mask, n),
+            advantages_mean=jnp.mean(adv),
+            policy=dict(approx_kl=approx_kl_old, clipfrac=clipfrac, ref_kl=kl),
+            padding_percentage=1.0 - n / mask.size,
+        )
+        return loss, flatten_dict(stats)

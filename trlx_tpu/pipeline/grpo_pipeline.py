@@ -1,0 +1,94 @@
+"""GRPO rollout storage: the PPO replay-buffer/collator shape
+(``trlx/pipeline/ppo_pipeline.py:13-80`` analogue) carrying per-sequence
+advantages and reference logprobs instead of values/per-token rewards."""
+
+from typing import List, Optional
+
+import numpy as np
+
+from trlx_tpu.data.grpo_types import GRPORLBatch, GRPORLElement
+from trlx_tpu.pipeline import BaseRolloutStore, BatchLoader
+from trlx_tpu.pipeline.offline_pipeline import pad_rows
+
+
+class GRPORolloutStorage(BaseRolloutStore):
+    """Replay buffer of :class:`GRPORLElement` used during GRPO learning."""
+
+    def __init__(self, pad_token_id: int):
+        super().__init__()
+        self.pad_token_id = pad_token_id
+        self.history: List[GRPORLElement] = []
+
+    def push(self, exps: List[GRPORLElement]):
+        self.history += exps
+
+    def clear_history(self):
+        self.history = []
+
+    def export_history(self, location: str):
+        """Append rollouts as JSON (reference ``ppo_pipeline.py:30-40``)."""
+        import json
+        import os
+        import time
+
+        assert os.path.exists(location)
+        fpath = os.path.join(location, f"epoch-{str(time.time())}.json")
+        with open(fpath, "w") as f:
+            json.dump(
+                [
+                    {
+                        "query_tensor": np.asarray(e.query_tensor).tolist(),
+                        "response_tensor": np.asarray(e.response_tensor).tolist(),
+                        "logprobs": np.asarray(e.logprobs).tolist(),
+                        "ref_logprobs": np.asarray(e.ref_logprobs).tolist(),
+                        "advantage": float(e.advantage),
+                    }
+                    for e in self.history
+                ],
+                f,
+            )
+
+    def collate(
+        self,
+        elems: List[GRPORLElement],
+        pad_multiple: int = 8,
+        query_length: Optional[int] = None,
+        response_length: Optional[int] = None,
+    ) -> GRPORLBatch:
+        queries, query_mask = pad_rows(
+            [e.query_tensor for e in elems], self.pad_token_id, "left", pad_multiple, query_length
+        )
+        responses, response_mask = pad_rows(
+            [e.response_tensor for e in elems], self.pad_token_id, "right", pad_multiple, response_length
+        )
+        r_len = responses.shape[1]
+        logprobs, _ = pad_rows([e.logprobs for e in elems], 0.0, "right", 1, r_len, np.float32)
+        ref_logprobs, _ = pad_rows([e.ref_logprobs for e in elems], 0.0, "right", 1, r_len, np.float32)
+        return GRPORLBatch(
+            query_tensors=queries,
+            response_tensors=responses,
+            logprobs=logprobs,
+            ref_logprobs=ref_logprobs,
+            advantages=np.asarray([e.advantage for e in elems], np.float32),
+            query_mask=query_mask,
+            response_mask=response_mask,
+        )
+
+    def create_loader(
+        self,
+        batch_size: int,
+        shuffle: bool = False,
+        pad_multiple: int = 8,
+        query_length: Optional[int] = None,
+        response_length: Optional[int] = None,
+        drop_last: bool = True,
+        seed: int = 0,
+    ) -> BatchLoader:
+        return BatchLoader(
+            self,
+            batch_size,
+            lambda elems: self.collate(elems, pad_multiple, query_length, response_length),
+            shuffle=shuffle,
+            drop_last=drop_last,
+            seed=seed,
+        )

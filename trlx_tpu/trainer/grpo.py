@@ -1,0 +1,232 @@
+"""GRPO trainer: group sampling, group-relative advantages, no value head.
+
+Beyond the reference (which ships PPO/ILQL/SFT): the PPO trainer's TPU
+rollout machinery — jitted KV-cache generation, the score-free scoring
+forward overlapping the host reward call, the hydra frozen-reference branch
+— is inherited unchanged; what changes is *what* is learned from a rollout:
+
+- each prompt is repeated ``group_size`` times (group-contiguous rows);
+- the scalar reward of each sequence is normalized within its group
+  (:func:`~trlx_tpu.models.grpo.group_advantages_np`) — no values, no GAE;
+- the KL penalty moves from reward shaping into the loss
+  (:meth:`~trlx_tpu.models.grpo.GRPOConfig.loss`), so rewards stay pure.
+"""
+
+from time import time
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.grpo_types import GRPORLElement
+from trlx_tpu.models.grpo import GRPOConfig, group_advantages_np
+from trlx_tpu.parallel import shard_batch
+from trlx_tpu.pipeline import BasePipeline
+from trlx_tpu.pipeline.grpo_pipeline import GRPORolloutStorage
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.ppo import PPOTrainer
+from trlx_tpu.utils import infinite_loader, logging, to_host
+from trlx_tpu.utils.stats import logprobs_of_labels
+
+logger = logging.get_logger(__name__)
+
+
+@register_trainer
+class GRPOTrainer(PPOTrainer):
+    model_head = None  # no value function — half the trainable state
+
+    def __init__(self, config: TRLConfig, **kwargs):
+        # cheap config validation before the expensive model build
+        if config.model.model_arch_type == "seq2seq":
+            raise NotImplementedError("GRPO is implemented for causal LMs")
+        method = config.method
+        if not isinstance(method, GRPOConfig):
+            raise ValueError("config.method must be GRPOConfig")
+        if method.chunk_size % method.group_size:
+            raise ValueError(
+                f"chunk_size {method.chunk_size} must be a multiple of "
+                f"group_size {method.group_size}"
+            )
+        super().__init__(config, **kwargs)
+        self.store = GRPORolloutStorage(self.tokenizer.pad_token_id)
+
+    def add_prompt_pipeline(self, pipeline: BasePipeline) -> None:
+        # one loader row fans out into group_size rollout rows
+        method: GRPOConfig = self.config.method
+        loader = pipeline.create_loader(
+            max(method.chunk_size // method.group_size, 1),
+            shuffle=True,
+            seed=self.config.train.seed,
+        )
+        self.prompt_iterator = infinite_loader(loader)
+
+    def _get_score_fn(self, batch_shape: Tuple[int, int, int]):
+        """Jitted scoring program: policy + frozen-reference logprobs of the
+        response tokens (the PPO version minus the value head)."""
+        if batch_shape in self._score_fns:
+            return self._score_fns[batch_shape]
+        module = self.module
+        ref_module = self._ref_module
+        nlu = self.num_layers_unfrozen
+        B, P, N = batch_shape
+
+        def score_fn(params, ref_params, sequences, prompt_mask, response_tokens,
+                     response_mask):
+            full_mask = jnp.concatenate([prompt_mask, response_mask], axis=1)
+            span = (P - 1, P + N - 1)
+            out = module.apply(
+                {"params": params},
+                sequences,
+                attention_mask=full_mask,
+                branch_layer=nlu if nlu > 0 else None,
+                logits_span=span,
+            )
+            logprobs = logprobs_of_labels(out["logits"], response_tokens)
+            if nlu > 0:
+                # head=None: module IS the bare CausalTransformer, so the
+                # branch params live at the tree root (no "backbone" scope)
+                ref_out = module.apply(
+                    {"params": ref_params},
+                    out["branch_input"],
+                    nlu,
+                    full_mask,
+                    None,
+                    span,
+                    method=type(module).forward_branch,
+                )
+            else:
+                ref_out = ref_module.apply(
+                    {"params": ref_params}, sequences, attention_mask=full_mask,
+                    logits_span=span,
+                )
+            ref_logprobs = logprobs_of_labels(ref_out["logits"], response_tokens)
+            return {"logprobs": logprobs, "ref_logprobs": ref_logprobs}
+
+        fn = jax.jit(score_fn)
+        self._score_fns[batch_shape] = fn
+        return fn
+
+    def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:
+        """Collect grouped rollouts with group-relative advantages."""
+        logger.info("Collecting GRPO rollouts")
+        if self.prompt_iterator is None:
+            raise RuntimeError("add_prompt_pipeline must be called before make_experience")
+        method: GRPOConfig = self.config.method
+        G = method.group_size
+
+        stats: Dict[str, float] = {}
+        elements = []
+        kl_sum, kl_batches = 0.0, 0
+        exp_time = time()
+
+        while len(elements) < num_rollouts:
+            batch = next(self.prompt_iterator)
+            prompt_ids = np.repeat(np.asarray(batch["input_ids"], np.int32), G, axis=0)
+            prompt_mask = np.repeat(
+                np.asarray(batch["attention_mask"], np.int32), G, axis=0
+            )
+
+            gen_time = time()
+            gen_out = self.generate(prompt_ids, prompt_mask)
+            B, P = prompt_ids.shape
+            N = int(gen_out.response_tokens.shape[1])
+            score_fn = self._get_score_fn((B, P, N))
+            score_out = score_fn(
+                self.state.params,
+                self.ref_params,
+                gen_out.sequences,
+                shard_batch({"prompt_mask": prompt_mask}, self.mesh)["prompt_mask"],
+                gen_out.response_tokens,
+                gen_out.response_mask,
+            )
+            for leaf in jax.tree_util.tree_leaves(score_out):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+            host_gen = to_host(
+                {
+                    "response_tokens": gen_out.response_tokens,
+                    "response_mask": gen_out.response_mask,
+                }
+            )
+            response_tokens = np.asarray(host_gen["response_tokens"])
+            response_mask = np.asarray(host_gen["response_mask"])
+            stats["time/exp_generate"] = time() - gen_time
+
+            samples, prompts, outputs = self.decode(
+                prompt_ids, response_tokens, append_eos_token=True
+            )
+            score_time = time()
+            scores = np.asarray(
+                self.reward_fn(samples=samples, prompts=prompts, outputs=outputs),
+                dtype=np.float32,
+            )
+            stats["time/exp_score"] = time() - score_time
+            host = to_host(score_out)
+
+            clip = method.cliprange_reward
+            if clip:
+                scores = np.clip(scores, -clip, clip)
+            self.running_moments.update(scores)  # logging only: the group
+            # normalization below IS the reward scaling in GRPO
+            stats["exp_scores/mean"] = float(scores.mean())
+            stats["exp_scores/std"] = float(scores.std())
+            advantages = group_advantages_np(scores, G, method.scale_advantage)
+
+            # reference KL for logging (the loss recomputes it on device)
+            lp, rlp = np.asarray(host["logprobs"]), np.asarray(host["ref_logprobs"])
+            delta = (rlp - lp) * response_mask
+            n_tok = max(response_mask.sum(), 1)
+            mean_kl = float(((np.exp(delta) - delta - 1.0) * response_mask).sum() / n_tok)
+            kl_sum += mean_kl
+            kl_batches += 1
+
+            for i in range(B):
+                n_i = int(response_mask[i].sum())
+                if n_i == 0:
+                    continue
+                elements.append(
+                    GRPORLElement(
+                        query_tensor=prompt_ids[i][prompt_mask[i] > 0],
+                        response_tensor=response_tokens[i, :n_i],
+                        logprobs=lp[i, :n_i],
+                        ref_logprobs=rlp[i, :n_i],
+                        advantage=float(advantages[i]),
+                    )
+                )
+
+        self.mean_kl = kl_sum / max(kl_batches, 1)
+        stats["policy/sqrt_ref_kl"] = float(np.sqrt(max(self.mean_kl, 0.0)))
+        stats["time/exp"] = time() - exp_time
+        self.make_experience_stats = stats
+        self.tracker.log(stats, step=iter_count)
+
+        self.store.push(elements[:num_rollouts] if num_rollouts else elements)
+        if self.log_rollouts:
+            self.store.export_history(location=self.rollout_logging_dir)
+
+    def loss_fn(
+        self, params: Any, batch: Dict[str, jax.Array], rng: jax.Array
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """Forward on query‖response then the GRPO clipped objective."""
+        method: GRPOConfig = self.config.method
+        queries = batch["query_tensors"]
+        responses = batch["response_tensors"]
+        Q, R = queries.shape[1], responses.shape[1]
+        input_ids = jnp.concatenate([queries, responses], axis=1)
+        attention_mask = jnp.concatenate(
+            [batch["query_mask"], batch["response_mask"]], axis=1
+        )
+        out = self.module.apply(
+            {"params": params}, input_ids, attention_mask=attention_mask,
+            logits_span=(Q - 1, Q + R - 1),
+        )
+        logprobs = logprobs_of_labels(out["logits"], responses)
+        return method.loss(
+            logprobs=logprobs,
+            old_logprobs=batch["logprobs"],
+            ref_logprobs=batch["ref_logprobs"],
+            advantages=batch["advantages"],
+            mask=batch["response_mask"],
+        )

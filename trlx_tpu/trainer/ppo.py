@@ -83,9 +83,14 @@ class PPOTrainer(TPUBaseTrainer):
                 branch = hydra_ref_params(self.state.params, self.tcfg, nlu)
                 self.ref_params = jax.tree_util.tree_map(jnp.copy, branch)
             else:
-                self.ref_params = jax.tree_util.tree_map(
-                    jnp.copy, self.state.params["backbone"]
+                # head wrappers scope the transformer under "backbone";
+                # head-less policies (GRPO) are the bare transformer tree
+                backbone = (
+                    self.state.params["backbone"]
+                    if "backbone" in self.state.params
+                    else self.state.params
                 )
+                self.ref_params = jax.tree_util.tree_map(jnp.copy, backbone)
             self._ref_module = CausalTransformer(self.tcfg)
 
         self.running_moments = RunningMoments()

@@ -127,6 +127,7 @@ def train(  # noqa: C901
         "trlx_tpu.trainer.ppo",
         "trlx_tpu.trainer.ilql",
         "trlx_tpu.trainer.sft",
+        "trlx_tpu.trainer.grpo",
     ):
         importlib.import_module(module)
     from trlx_tpu.pipeline import get_pipeline
