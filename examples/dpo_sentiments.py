@@ -1,0 +1,78 @@
+"""DPO on sentiment preference pairs (beyond the reference: no DPO upstream).
+
+Builds (prompt, chosen, rejected) triples from IMDB-style reviews — the
+chosen completion comes from a positive review, the rejected from a negative
+one — and optimizes the DPO logistic objective directly: no reward model,
+no rollouts. The eval metric tracks sentiment of free generations."""
+
+import os
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_dpo_config
+
+from sentiment_util import get_positive_sentiment_fn, load_imdb_texts, review_prompts
+
+
+def resolve_model():
+    path = os.environ.get("MODEL_PATH")
+    if path:
+        return path, path
+    try:
+        from transformers import AutoConfig
+
+        AutoConfig.from_pretrained("gpt2")
+        return "gpt2", "gpt2"
+    except Exception:
+        return "builtin:gpt2-small", "builtin:bytes"
+
+
+def preference_triples(n: int, seed: int = 0, prompt_words: int = 4):
+    texts, labels = load_imdb_texts(2 * n, seed=seed)
+    pos = [t for t, l in zip(texts, labels) if l == 1]
+    neg = [t for t, l in zip(texts, labels) if l == 0]
+    triples = []
+    for p, q in zip(pos, neg):
+        prompt = " ".join(p.split()[:prompt_words])
+        chosen = " " + " ".join(p.split()[prompt_words:])[:200]
+        rejected = " " + " ".join(q.split()[prompt_words:])[:200]
+        triples.append((prompt, chosen, rejected))
+    return triples
+
+
+def main(hparams=None):
+    model_path, tokenizer_path = resolve_model()
+    sentiment = get_positive_sentiment_fn()
+
+    config = default_dpo_config().evolve(
+        train=dict(
+            seq_length=256,
+            batch_size=16,
+            total_steps=1000,
+            eval_interval=100,
+            checkpoint_interval=10000,
+            checkpoint_dir="ckpts/dpo_sentiments",
+        ),
+        model=dict(model_path=model_path),
+        tokenizer=dict(tokenizer_path=tokenizer_path),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    def metric_fn(samples, prompts, outputs, **kwargs):
+        return {"sentiment": sentiment(samples)}
+
+    return trlx.train(
+        samples=preference_triples(256, seed=0),
+        eval_prompts=review_prompts(64, seed=1),
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

@@ -21,7 +21,8 @@ CPU_ENV = {
 def test_task_table_covers_benchmark_sh_suite():
     # the reference suite: randomwalks anchors + the sentiment quartet
     assert {"ppo_randomwalks", "ilql_randomwalks", "ppo_sentiments",
-            "ilql_sentiments", "sft_sentiments", "ppo_sentiments_t5"} <= set(TASKS)
+            "ilql_sentiments", "sft_sentiments", "ppo_sentiments_t5",
+            "grpo_sentiments"} <= set(TASKS)
     for name, (script, _) in TASKS.items():
         assert os.path.exists(script), script
 

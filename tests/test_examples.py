@@ -382,3 +382,22 @@ def test_grpo_sentiments_smoke(tmp_path, monkeypatch):
         }
     )
     assert trainer.iter_count == 2
+
+
+def test_dpo_sentiments_smoke(tmp_path, monkeypatch):
+    monkeypatch.delenv("MODEL_PATH", raising=False)
+    import dpo_sentiments
+
+    trainer = dpo_sentiments.main(
+        {
+            "tokenizer.tokenizer_path": "builtin:bytes",
+            "train.total_steps": 2,
+            "train.epochs": 100,
+            "train.eval_interval": 2,
+            "train.batch_size": 4,
+            "train.seq_length": 64,
+            "train.checkpoint_dir": str(tmp_path / "ckpt"),
+            "model.model_path": "builtin:gpt2-test",
+        }
+    )
+    assert trainer.iter_count == 2

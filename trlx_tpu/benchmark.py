@@ -83,6 +83,15 @@ TASKS: Dict[str, Tuple[str, Dict[str, Any]]] = {
             "model.model_path": "builtin:t5-test", "tokenizer.tokenizer_path": "builtin:bytes",
         },
     ),
+    "grpo_sentiments": (
+        os.path.join(_EXAMPLES, "grpo_sentiments.py"),
+        {
+            "train.total_steps": 2, "train.batch_size": 8, "train.eval_interval": 2,
+            "train.seq_length": 56, "method.num_rollouts": 8, "method.chunk_size": 8,
+            "method.group_size": 4, "method.ppo_epochs": 1,
+            "model.model_path": "builtin:gpt2-test", "tokenizer.tokenizer_path": "builtin:bytes",
+        },
+    ),
 }
 
 

@@ -1,0 +1,77 @@
+"""DPO method: direct preference optimization — pure JAX loss.
+
+Beyond the reference (trlx v0.6.0 ships PPO/ILQL/SFT): DPO (Rafailov et al.
+2023) trains directly on preference pairs ``(prompt, chosen, rejected)``
+without a reward model or rollouts — the implicit reward is
+``β·(log π − log π_ref)`` and the objective is a logistic loss on the
+chosen-vs-rejected reward margin. Fits this framework's offline path
+(``trlx.train(samples=triples)``) exactly like ILQL/SFT do, and registers
+through the same method registry (``trlx/data/method_configs.py:9-56``).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.method_configs import MethodConfig, register_method
+from trlx_tpu.utils import flatten_dict
+
+
+@dataclass
+@register_method("DPOConfig")
+class DPOConfig(MethodConfig):
+    """DPO hyperparameters.
+
+    :param beta: inverse temperature of the implicit reward (typical 0.1-0.5).
+    :param label_smoothing: conservative-DPO smoothing ε — assumes labels are
+        flipped with probability ε (0 = standard DPO).
+    :param reference_free: drop the reference terms (π_ref ≡ uniform);
+        mostly for ablation.
+    :param gen_kwargs: sampling settings for evaluation generation.
+    """
+
+    name: str = "DPOConfig"
+    beta: float = 0.1
+    label_smoothing: float = 0.0
+    reference_free: bool = False
+    gen_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def loss(
+        self,
+        policy_chosen_logps: jax.Array,  # [B] summed logprobs of chosen completions
+        policy_rejected_logps: jax.Array,  # [B]
+        ref_chosen_logps: jax.Array,  # [B] frozen-reference sums
+        ref_rejected_logps: jax.Array,  # [B]
+    ) -> Tuple[jax.Array, Dict[str, Any]]:
+        pi_ratios = policy_chosen_logps - policy_rejected_logps
+        if self.reference_free:
+            ref_ratios = jnp.zeros_like(pi_ratios)
+        else:
+            ref_ratios = ref_chosen_logps - ref_rejected_logps
+        logits = pi_ratios - ref_ratios  # the preference margin
+
+        eps = self.label_smoothing
+        losses = (
+            -(1.0 - eps) * jax.nn.log_sigmoid(self.beta * logits)
+            - eps * jax.nn.log_sigmoid(-self.beta * logits)
+        )
+        loss = losses.mean()
+
+        chosen_rewards = self.beta * (policy_chosen_logps - ref_chosen_logps)
+        rejected_rewards = self.beta * (policy_rejected_logps - ref_rejected_logps)
+        stats = dict(
+            losses=dict(total_loss=loss),
+            rewards=dict(
+                chosen=chosen_rewards.mean(),
+                rejected=rejected_rewards.mean(),
+                margin=(chosen_rewards - rejected_rewards).mean(),
+                accuracy=(chosen_rewards > rejected_rewards).astype(jnp.float32).mean(),
+            ),
+            logps=dict(
+                chosen=policy_chosen_logps.mean(),
+                rejected=policy_rejected_logps.mean(),
+            ),
+        )
+        return loss, flatten_dict(stats)

@@ -7,23 +7,16 @@ from typing import List, Optional
 import numpy as np
 
 from trlx_tpu.data.grpo_types import GRPORLBatch, GRPORLElement
-from trlx_tpu.pipeline import BaseRolloutStore, BatchLoader
 from trlx_tpu.pipeline.offline_pipeline import pad_rows
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
 
 
-class GRPORolloutStorage(BaseRolloutStore):
-    """Replay buffer of :class:`GRPORLElement` used during GRPO learning."""
+class GRPORolloutStorage(PPORolloutStorage):
+    """Replay buffer of :class:`GRPORLElement` used during GRPO learning.
 
-    def __init__(self, pad_token_id: int):
-        super().__init__()
-        self.pad_token_id = pad_token_id
-        self.history: List[GRPORLElement] = []
-
-    def push(self, exps: List[GRPORLElement]):
-        self.history += exps
-
-    def clear_history(self):
-        self.history = []
+    Shares the PPO store's push/clear/loader machinery; only the element
+    fields differ (per-sequence advantage + reference logprobs instead of
+    values/per-token rewards), so only collation and export change."""
 
     def export_history(self, location: str):
         """Append rollouts as JSON (reference ``ppo_pipeline.py:30-40``)."""
@@ -72,23 +65,4 @@ class GRPORolloutStorage(BaseRolloutStore):
             advantages=np.asarray([e.advantage for e in elems], np.float32),
             query_mask=query_mask,
             response_mask=response_mask,
-        )
-
-    def create_loader(
-        self,
-        batch_size: int,
-        shuffle: bool = False,
-        pad_multiple: int = 8,
-        query_length: Optional[int] = None,
-        response_length: Optional[int] = None,
-        drop_last: bool = True,
-        seed: int = 0,
-    ) -> BatchLoader:
-        return BatchLoader(
-            self,
-            batch_size,
-            lambda elems: self.collate(elems, pad_multiple, query_length, response_length),
-            shuffle=shuffle,
-            drop_last=drop_last,
-            seed=seed,
         )

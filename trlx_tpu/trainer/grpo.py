@@ -62,51 +62,24 @@ class GRPOTrainer(PPOTrainer):
         )
         self.prompt_iterator = infinite_loader(loader)
 
-    def _get_score_fn(self, batch_shape: Tuple[int, int, int]):
-        """Jitted scoring program: policy + frozen-reference logprobs of the
-        response tokens (the PPO version minus the value head)."""
-        if batch_shape in self._score_fns:
-            return self._score_fns[batch_shape]
-        module = self.module
-        ref_module = self._ref_module
-        nlu = self.num_layers_unfrozen
-        B, P, N = batch_shape
+    # scoring reuses PPOTrainer._get_score_fn, which adapts to the head-less
+    # policy (no value output, branch params bound at the tree root)
 
-        def score_fn(params, ref_params, sequences, prompt_mask, response_tokens,
-                     response_mask):
-            full_mask = jnp.concatenate([prompt_mask, response_mask], axis=1)
-            span = (P - 1, P + N - 1)
-            out = module.apply(
-                {"params": params},
-                sequences,
-                attention_mask=full_mask,
-                branch_layer=nlu if nlu > 0 else None,
-                logits_span=span,
-            )
-            logprobs = logprobs_of_labels(out["logits"], response_tokens)
-            if nlu > 0:
-                # head=None: module IS the bare CausalTransformer, so the
-                # branch params live at the tree root (no "backbone" scope)
-                ref_out = module.apply(
-                    {"params": ref_params},
-                    out["branch_input"],
-                    nlu,
-                    full_mask,
-                    None,
-                    span,
-                    method=type(module).forward_branch,
-                )
-            else:
-                ref_out = ref_module.apply(
-                    {"params": ref_params}, sequences, attention_mask=full_mask,
-                    logits_span=span,
-                )
-            ref_logprobs = logprobs_of_labels(ref_out["logits"], response_tokens)
-            return {"logprobs": logprobs, "ref_logprobs": ref_logprobs}
+    def post_backward_callback(self) -> None:
+        # GRPO's KL coefficient (method.beta) is fixed in-loss — no adaptive
+        # controller to update (PPO's kl_ctl stays at its init value, unused)
+        pass
 
-        fn = jax.jit(score_fn)
-        self._score_fns[batch_shape] = fn
-        return fn
+    def _extra_checkpoint_state(self) -> Dict[str, Any]:
+        # running moments only (logging); no controller state to persist
+        return {
+            "running_moments": {
+                "mean": self.running_moments.mean,
+                "std": self.running_moments.std,
+                "var": self.running_moments.var,
+                "count": self.running_moments.count,
+            },
+        }
 
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:
         """Collect grouped rollouts with group-relative advantages."""

@@ -222,6 +222,12 @@ class PPOTrainer(TPUBaseTrainer):
             self._score_fns[batch_shape] = fn
             return fn
 
+        # head wrappers scope the transformer under "backbone"; head-less
+        # policies (GRPO) are the bare transformer, so the hydra branch
+        # params bind at the tree root and there is no value output
+        has_value = self.model_head == "value"
+        wrap_ref = (lambda p: {"backbone": p}) if self.model_head else (lambda p: p)
+
         def score_fn(params, ref_params, sequences, prompt_mask, response_tokens,
                      response_mask):
             full_mask = jnp.concatenate([prompt_mask, response_mask], axis=1)
@@ -237,11 +243,10 @@ class PPOTrainer(TPUBaseTrainer):
                 logits_span=span,
             )
             logprobs = logprobs_of_labels(out["logits"], response_tokens)
-            values = out["value"][:, P - 1 : P + N - 1]
 
             if nlu > 0:
                 ref_out = module.apply(
-                    {"params": {"backbone": ref_params}},
+                    {"params": wrap_ref(ref_params)},
                     out["branch_input"],
                     nlu,
                     full_mask,
@@ -255,11 +260,10 @@ class PPOTrainer(TPUBaseTrainer):
                     logits_span=span,
                 )
             ref_logprobs = logprobs_of_labels(ref_out["logits"], response_tokens)
-            return {
-                "logprobs": logprobs,
-                "values": values,
-                "ref_logprobs": ref_logprobs,
-            }
+            result = {"logprobs": logprobs, "ref_logprobs": ref_logprobs}
+            if has_value:
+                result["values"] = out["value"][:, P - 1 : P + N - 1]
+            return result
 
         fn = jax.jit(score_fn)
         self._score_fns[batch_shape] = fn

@@ -128,6 +128,7 @@ def train(  # noqa: C901
         "trlx_tpu.trainer.ilql",
         "trlx_tpu.trainer.sft",
         "trlx_tpu.trainer.grpo",
+        "trlx_tpu.trainer.dpo",
     ):
         importlib.import_module(module)
     from trlx_tpu.pipeline import get_pipeline
