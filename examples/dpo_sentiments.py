@@ -27,11 +27,15 @@ def resolve_model():
 
 
 def preference_triples(n: int, seed: int = 0, prompt_words: int = 4):
-    texts, labels = load_imdb_texts(2 * n, seed=seed)
+    # draw enough reviews that both classes cover n even on skewed splits
+    texts, labels = load_imdb_texts(4 * n, seed=seed)
     pos = [t for t, l in zip(texts, labels) if l == 1]
     neg = [t for t, l in zip(texts, labels) if l == 0]
+    if not pos or not neg:
+        raise ValueError("need both positive and negative reviews for preference pairs")
     triples = []
-    for p, q in zip(pos, neg):
+    for i in range(n):
+        p, q = pos[i % len(pos)], neg[i % len(neg)]
         prompt = " ".join(p.split()[:prompt_words])
         chosen = " " + " ".join(p.split()[prompt_words:])[:200]
         rejected = " " + " ".join(q.split()[prompt_words:])[:200]

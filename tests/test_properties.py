@@ -135,3 +135,61 @@ def test_ilql_loss_finite_over_shapes(B, A, V, two_qs):
         actions=actions, rewards=rewards, dones=dones,
     )
     assert np.isfinite(float(loss))
+
+
+@given(
+    groups=st.integers(min_value=1, max_value=5),
+    group_size=st.integers(min_value=2, max_value=8),
+    seed=st.integers(min_value=0, max_value=2**16),
+)
+@settings(max_examples=30, deadline=None)
+def test_group_advantages_invariants(groups, group_size, seed):
+    """GRPO group advantages: zero-mean per group, scale-invariant under
+    per-group reward shifts, and std-normalized when scaled."""
+    from trlx_tpu.models.grpo import group_advantages_np
+
+    rng = np.random.RandomState(seed)
+    scores = rng.randn(groups * group_size).astype(np.float32) * 3.0
+    adv = group_advantages_np(scores, group_size)
+    g = adv.reshape(groups, group_size)
+    np.testing.assert_allclose(g.mean(axis=1), 0.0, atol=1e-5)
+    # shifting any group's rewards by a constant leaves advantages unchanged
+    shifted = scores + np.repeat(rng.randn(groups).astype(np.float32) * 10, group_size)
+    np.testing.assert_allclose(
+        group_advantages_np(shifted, group_size), adv, atol=1e-4
+    )
+    # unscaled variant is exactly the centered rewards
+    centered = group_advantages_np(scores, group_size, scale=False)
+    np.testing.assert_allclose(
+        centered.reshape(groups, group_size),
+        scores.reshape(groups, group_size) - scores.reshape(groups, group_size).mean(axis=1, keepdims=True),
+        atol=1e-5,
+    )
+
+
+@given(
+    batch=st.integers(min_value=1, max_value=8),
+    beta=st.floats(min_value=0.05, max_value=1.0),
+    seed=st.integers(min_value=0, max_value=2**16),
+)
+@settings(max_examples=30, deadline=None)
+def test_dpo_loss_invariants(batch, beta, seed):
+    """DPO loss: invariant to adding a constant to both policy and reference
+    logprobs of the same completion (only margins matter), bounded below by
+    0, and equal to log 2 at zero margin."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.dpo import DPOConfig
+
+    cfg = DPOConfig(name="DPOConfig", beta=float(beta))
+    rng = np.random.RandomState(seed)
+    pc, pr, rc_, rr = (jnp.asarray(rng.uniform(-30, -5, batch), jnp.float32) for _ in range(4))
+    loss, stats = cfg.loss(pc, pr, rc_, rr)
+    assert float(loss) > 0.0
+    # shift chosen logps of policy AND reference by the same constant
+    c = jnp.asarray(rng.randn(batch), jnp.float32)
+    loss2, _ = cfg.loss(pc + c, pr, rc_ + c, rr)
+    np.testing.assert_allclose(float(loss), float(loss2), rtol=1e-4)
+    # zero margin exactly
+    loss0, _ = cfg.loss(pc, pc, pc, pc)
+    np.testing.assert_allclose(float(loss0), np.log(2.0), rtol=1e-5)

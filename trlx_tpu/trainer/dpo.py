@@ -4,12 +4,13 @@ Beyond the reference feature set. Offline like ILQL/SFT — no rollouts, no
 reward model; ``trlx.train(samples=[(prompt, chosen, rejected), ...],
 config=...)`` with ``train.trainer: DPOTrainer``.
 
-TPU design: the frozen reference's completion logprobs are precomputed in
-ONE jitted pass over the dataset at ``make_experience`` time (per-length-
-bucket compiled programs), then the reference parameters are dropped — the
-steady-state train step holds a single model and does a single forward on
-the chosen‖rejected concatenated batch. The reference-model memory cost of
-DPO exists only during setup.
+TPU design: the reference completion logprobs are precomputed in ONE jitted
+pass over the dataset at ``make_experience`` time (per-length-bucket
+compiled programs) using the pre-update parameters directly — experience
+creation runs before any optimization step, so no reference snapshot is
+ever materialized and the train step holds a single model doing a single
+forward on the chosen‖rejected concatenated batch. DPO's usual
+reference-model memory cost does not exist here at all.
 """
 
 from typing import Any, Dict, List, Sequence, Tuple
@@ -51,13 +52,11 @@ class DPOTrainer(TPUBaseTrainer):
             raise NotImplementedError("DPO is implemented for causal LMs")
         super().__init__(config, **kwargs)
         self.store: DPOStore = None
-        # full frozen copy for the one-time reference pass (freed afterwards;
-        # never materialized in the reference-free ablation)
-        self.ref_params = (
-            None
-            if config.method.reference_free
-            else jax.tree_util.tree_map(jnp.copy, self.state.params)
-        )
+        # No reference snapshot is ever materialized: the one-time reference
+        # pass in make_experience runs BEFORE any optimization step (train()
+        # collects experience first, and resume happens inside learn()), so
+        # the current parameters ARE the reference — zero extra param HBM.
+        self.ref_params = None
 
     def make_experience(self, samples: Sequence[Sequence[str]], seq_length: int) -> None:
         """Tokenize preference triples and precompute the frozen-reference
@@ -67,10 +66,11 @@ class DPOTrainer(TPUBaseTrainer):
             for e in self.store.history:
                 e["ref_chosen_logp"] = 0.0
                 e["ref_rejected_logp"] = 0.0
-            self.ref_params = None
             return
 
         logger.info("Precomputing frozen-reference logprobs for %d pairs", len(self.store))
+        from trlx_tpu.parallel import shard_batch
+
         ref_fn = jax.jit(
             lambda p, ids, attn, out: _completion_logps(self.module, p, ids, attn, out)
         )
@@ -78,13 +78,22 @@ class DPOTrainer(TPUBaseTrainer):
         loader = self.store.create_loader(bs, shuffle=False, drop_last=False)
         idx = 0
         for batch in loader:
+            # mesh placement like every other forward path: batch arrays
+            # data-sharded, matching the sharded parameters (required on
+            # multi-host, where process-local arrays cannot mix with
+            # globally-sharded params in one jit)
+            arrays = shard_batch(
+                {k: batch[k] for k in ("input_ids", "attention_mask", "out_mask")},
+                self.mesh,
+            )
             logps = np.asarray(
                 jax.device_get(
                     ref_fn(
-                        self.ref_params,
-                        jnp.asarray(batch["input_ids"]),
-                        jnp.asarray(batch["attention_mask"]),
-                        jnp.asarray(batch["out_mask"]),
+                        # pre-update params ARE the frozen reference here
+                        self.state.params,
+                        arrays["input_ids"],
+                        arrays["attention_mask"],
+                        arrays["out_mask"],
                     )
                 ),
                 np.float32,
@@ -95,8 +104,6 @@ class DPOTrainer(TPUBaseTrainer):
                 self.store.history[idx + j]["ref_rejected_logp"] = float(logps[2 * j + 1])
             idx += n
         assert idx == len(self.store)
-        # steady state holds a single model: drop the reference snapshot
-        self.ref_params = None
 
     def loss_fn(
         self, params: Any, batch: Dict[str, jax.Array], rng: jax.Array

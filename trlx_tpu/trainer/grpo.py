@@ -71,15 +71,10 @@ class GRPOTrainer(PPOTrainer):
         pass
 
     def _extra_checkpoint_state(self) -> Dict[str, Any]:
-        # running moments only (logging); no controller state to persist
-        return {
-            "running_moments": {
-                "mean": self.running_moments.mean,
-                "std": self.running_moments.std,
-                "var": self.running_moments.var,
-                "count": self.running_moments.count,
-            },
-        }
+        # PPO's extra state minus the adaptive-KL coefficient (fixed in-loss)
+        extra = super()._extra_checkpoint_state()
+        extra.pop("kl_ctl_value", None)
+        return extra
 
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:
         """Collect grouped rollouts with group-relative advantages."""
