@@ -252,3 +252,41 @@ def test_pipeline_ilql_e2e():
             for l in open(os.path.join(config.train.logging_dir, "stats.jsonl"))
         ]
         assert any("losses/loss_q" in r for r in records)
+
+
+@pytest.mark.slow
+def test_pipeline_grpo_e2e():
+    """GRPO (head-less policy, inherited PPO machinery) through the pipeline
+    schedule: grouped rollout collection + pipelined train step over a
+    pipe×model mesh."""
+    import trlx_tpu as trlx
+    from trlx_tpu.data.default_configs import default_grpo_config
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        config = default_grpo_config().evolve(
+            train=dict(
+                seq_length=32, batch_size=8, total_steps=2, eval_interval=2,
+                checkpoint_interval=100, epochs=100, checkpoint_dir=tmp + "/ck",
+                tracker=None,
+            ),
+            model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1,
+                       model_extra_kwargs=dict(num_layers=4)),
+            parallel=dict(data=2, pipe=2, fsdp=1, model=2, scan_layers=True),
+            method=dict(num_rollouts=8, chunk_size=8, group_size=4, ppo_epochs=1,
+                        gen_kwargs=dict(max_new_tokens=6, top_k=0, top_p=1.0, do_sample=True)),
+        )
+
+        def reward_fn(samples, prompts, outputs, **kwargs):
+            return [float(len(o)) for o in outputs]
+
+        trainer = trlx.train(
+            reward_fn=reward_fn,
+            prompts=["hello world", "foo bar", "baz qux", "lorem ipsum"] * 2,
+            eval_prompts=["hello world", "foo bar"],
+            config=config,
+        )
+        assert trainer.mesh.shape["pipe"] == 2
+        assert trainer.iter_count == 2
+        assert all(np.isfinite(e.advantage) for e in trainer.store.history)

@@ -90,15 +90,20 @@ def test_ring_rejects_indivisible_length():
         ring_flash_attention(q, k, v, mask, _mesh(4), interpret=True)
 
 
-def test_model_forward_with_sequence_mesh_matches_unsharded():
+@pytest.mark.parametrize("spec", ["builtin:gpt2-test", "builtin:llama-test"])
+def test_model_forward_with_sequence_mesh_matches_unsharded(spec):
     """Full CausalTransformer forward with the global mesh's sequence axis > 1
-    routes attention through the ring and matches the unsharded xla path."""
+    routes attention through the ring and matches the unsharded xla path —
+    including grouped-query attention (llama-test), whose K/V rotate
+    unrepeated around the ring."""
     import dataclasses
 
     from trlx_tpu.models.transformer import CausalTransformer, config_from_spec
     from trlx_tpu.parallel import set_global_mesh
 
-    cfg_x = config_from_spec("builtin:gpt2-test", dtype=jnp.float32, attention_impl="xla")
+    cfg_x = config_from_spec(spec, dtype=jnp.float32, attention_impl="xla")
+    if "llama" in spec:
+        assert cfg_x.kv_heads < cfg_x.num_heads  # really grouped-query
     cfg_p = dataclasses.replace(cfg_x, attention_impl="pallas")
     model_x, model_p = CausalTransformer(cfg_x), CausalTransformer(cfg_p)
     B, T = 2, 16
