@@ -26,10 +26,14 @@ reference's host-side re-tokenization between decode and scoring
 samples_per_sec / 40.0 (target ≥3.0 per BASELINE.json).
 
 Robustness: the TPU backend can be transiently unavailable (single-tenant
-chip contended by a concurrent driver check — this killed BENCH_r01).  Init
-is retried with backoff; if the accelerator never comes up, the bench falls
-back to forced-CPU with a reduced work size so it still emits a parsable
-JSON line (tagged ``[cpu-fallback]`` in the metric name).
+chip wedged by a stale session from a killed process — this killed the r1
+AND r2 bench windows).  Init is probed in throwaway subprocesses (SIGTERM
+only, never SIGKILL — killing a mid-claim process is what causes the wedge)
+and retried with backoff for ``BENCH_ACCEL_WAIT`` seconds (default 40 min —
+a wedge typically clears server-side within the hour); if the accelerator
+never comes up, the bench falls back to forced-CPU with a reduced work size
+so it still emits a parsable JSON line (tagged ``[cpu-fallback]`` in the
+metric name).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -44,23 +48,30 @@ import numpy as np
 A100_BASELINE_SAMPLES_PER_SEC = 40.0
 
 
+_ORPHANED_PROBES = 0
+
+
 def _probe_accelerator(timeout_s: float) -> bool:
     """Try TPU backend init in a THROWAWAY subprocess with a hard timeout.
 
     A contended single-tenant chip can make ``jax.devices()`` *hang* on the
     tunnel claim (not just raise UNAVAILABLE) — a stale session from a killed
-    process holds the chip until the server notices. Probing in a subprocess
-    converts that hang into a retryable failure instead of wedging the bench
-    past the driver's timeout. Costs one extra backend init (~30s) on the
-    healthy path — cheap insurance against losing the whole bench window.
+    process holds the chip until the server notices (observed to take tens of
+    minutes; it ate both the r1 and r2 bench windows). Probing in a
+    subprocess converts that hang into a retryable failure instead of
+    wedging the bench past the driver's timeout.
 
-    Termination is escalated (SIGTERM, grace, then SIGKILL) and the timeout
-    is generous relative to normal init: a probe killed while *waiting* for
-    the claim is harmless; only a kill in the narrow post-claim init window
-    could itself wedge the chip, which the long timeout makes unlikely.
+    A hung probe is NEVER SIGKILLed: SIGKILL on a process mid-claim is
+    exactly what wedges the chip for the next session. Escalation is
+    SIGTERM → grace → SIGTERM → grace → orphan (leave it running and move
+    on). A probe blocked *waiting* for the claim holds nothing and dies
+    cleanly on SIGTERM; one that ignores SIGTERM is likely inside the claim
+    handshake, where killing it is the one action guaranteed to make things
+    worse. Orphans are capped — see ``_init_devices``.
     """
     import subprocess
 
+    global _ORPHANED_PROBES
     proc = subprocess.Popen(
         [sys.executable, "-c", "import jax; jax.devices()"],
         stdout=subprocess.DEVNULL,
@@ -69,41 +80,81 @@ def _probe_accelerator(timeout_s: float) -> bool:
     try:
         return proc.wait(timeout=timeout_s) == 0
     except subprocess.TimeoutExpired:
-        proc.terminate()
-        try:
-            proc.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait()
+        for _ in range(2):
+            proc.terminate()  # SIGTERM only — never SIGKILL (chip wedge)
+            try:
+                proc.wait(timeout=30)
+                return False
+            except subprocess.TimeoutExpired:
+                continue
+        _ORPHANED_PROBES += 1
+        print(
+            f"bench: probe pid {proc.pid} ignored SIGTERM — orphaning it "
+            f"(orphans={_ORPHANED_PROBES}); NOT escalating to SIGKILL",
+            file=sys.stderr,
+        )
         return False
 
 
-def _init_devices(retries=4, delay=15.0, probe_timeout=150.0):
-    """``jax.devices()`` with fail-soft retry, then forced-CPU fallback.
+def _init_devices():
+    """``jax.devices()`` with a long accelerator-wait horizon, then
+    forced-CPU fallback.
+
+    The driver's bench window is multi-hour; a wedged chip claim typically
+    clears in 30-60 min when the server reaps the stale session. So keep
+    re-probing with backoff for ``BENCH_ACCEL_WAIT`` seconds (default 40
+    min) before giving up, logging every attempt's outcome to stderr.
 
     Returns ``(devices, fallback_exc)`` — ``fallback_exc`` is None unless we
     gave up on the accelerator and dropped to CPU.
     """
     import jax
 
+    wait_budget = float(os.environ.get("BENCH_ACCEL_WAIT", 2400.0))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120.0))
+    deadline = time.time() + wait_budget
     last_err = None
-    for i in range(retries):
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = time.time()
         try:
             if not _probe_accelerator(probe_timeout):
                 raise RuntimeError(
                     f"accelerator init probe failed/hung (> {probe_timeout}s)"
                 )
+            print(
+                f"bench: accelerator up on attempt {attempt} "
+                f"(waited {time.time() + wait_budget - deadline:.0f}s total)",
+                file=sys.stderr,
+            )
             return jax.devices(), None
         except Exception as e:  # backend init failure (e.g. contended chip)
             last_err = e
-            print(f"bench: backend init failed (try {i + 1}/{retries}): {e}", file=sys.stderr)
+            remaining = deadline - time.time()
+            print(
+                f"bench: backend init failed (attempt {attempt}, "
+                f"{time.time() - t0:.0f}s, {remaining:.0f}s of wait budget "
+                f"left): {e}",
+                file=sys.stderr,
+            )
             try:
                 import jax.extend.backend
 
                 jax.extend.backend.clear_backends()
             except Exception:
                 pass
-            time.sleep(delay * (i + 1))
+            if remaining <= 0 or _ORPHANED_PROBES > 2:
+                if _ORPHANED_PROBES > 2:
+                    print(
+                        "bench: too many orphaned probes — stopping probes to "
+                        "avoid a claim pileup",
+                        file=sys.stderr,
+                    )
+                break
+            # backoff 30→60s; a wedge clears server-side, polling faster
+            # than ~1/min buys nothing
+            time.sleep(min(30.0 + 5.0 * attempt, 60.0, max(remaining, 1.0)))
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
         jax.config.update("jax_platforms", "cpu")
@@ -211,7 +262,10 @@ def main():
         int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(trainer.state.params)
     )
     seq = prompt_tokens + max_new
-    unfrozen_frac = config.model.num_layers_unfrozen / trainer.tcfg.num_layers
+    n_unfrozen = config.model.num_layers_unfrozen
+    unfrozen_frac = (
+        1.0 if n_unfrozen < 0 else n_unfrozen / trainer.tcfg.num_layers
+    )  # -1 sentinel = all layers trainable (mirrors _scan_layer_vector)
     tok = chunk * seq
     fwd = 2 * n_params
     cycle_flops = (

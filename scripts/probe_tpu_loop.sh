@@ -1,0 +1,59 @@
+#!/bin/bash
+# Probe the TPU claim repeatedly without ever SIGKILLing a probe process.
+# A wedged chip claim (stale session from a killed process) clears on its
+# own after the server notices; this loop watches for that moment.
+# Logs one line per attempt to $LOG. Exits 0 on first success.
+#
+# The probe runs in the background with a bounded wait: a probe that
+# ignores SIGTERM (hung inside the claim handshake) is ORPHANED — never
+# SIGKILLed (that is what wedges the chip) — and the loop keeps going.
+LOG=${1:-/tmp/tpu_probe.log}
+INTERVAL=${2:-60}
+TIMEOUT=${3:-120}
+MAX_ATTEMPTS=${4:-0}   # 0 = forever
+MAX_ORPHANS=${5:-3}    # stop after this many SIGTERM-ignoring probes pile up
+i=0
+orphans=0
+while :; do
+  i=$((i+1))
+  start=$(date +%s)
+  rcfile=$(mktemp)
+  # timeout sends SIGTERM (default); never -9. A probe blocked on the claim
+  # wait holds nothing, so SIGTERM is safe.
+  (
+    timeout "$TIMEOUT" python -c "
+import jax, sys
+d = jax.devices()
+print(d[0].platform, getattr(d[0], 'device_kind', '?'), len(d))
+sys.exit(0 if d[0].platform != 'cpu' else 3)
+" >>"$LOG.out" 2>&1
+    echo $? > "$rcfile"
+  ) &
+  wpid=$!
+  grace=$((TIMEOUT + 45))
+  for ((s=0; s<grace; s++)); do
+    kill -0 "$wpid" 2>/dev/null || break
+    sleep 1
+  done
+  if kill -0 "$wpid" 2>/dev/null; then
+    # SIGTERM was ignored — orphan the probe rather than SIGKILL it
+    rc=125
+    orphans=$((orphans+1))
+    echo "$(date -u +%FT%TZ) attempt=$i probe pid $wpid ignored SIGTERM; orphaned ($orphans/$MAX_ORPHANS)" >> "$LOG"
+    if [ "$orphans" -ge "$MAX_ORPHANS" ]; then
+      echo "$(date -u +%FT%TZ) too many orphaned probes; stopping to avoid a claim pileup" >> "$LOG"
+      exit 2
+    fi
+  else
+    rc=$(cat "$rcfile" 2>/dev/null || echo 126)
+  fi
+  rm -f "$rcfile"
+  dur=$(( $(date +%s) - start ))
+  echo "$(date -u +%FT%TZ) attempt=$i rc=$rc dur=${dur}s" >> "$LOG"
+  if [ "$rc" -eq 0 ]; then
+    echo "$(date -u +%FT%TZ) TPU AVAILABLE after $i attempts" >> "$LOG"
+    exit 0
+  fi
+  [ "$MAX_ATTEMPTS" -gt 0 ] && [ "$i" -ge "$MAX_ATTEMPTS" ] && exit 1
+  sleep "$INTERVAL"
+done

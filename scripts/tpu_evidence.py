@@ -1,0 +1,281 @@
+"""On-chip evidence harness: every TPU claim in the README gets a committed
+artifact under ``benchmarks/tpu/`` (VERDICT r2 next#2/#3/#6).
+
+Stages (each an independent subprocess; a failure doesn't kill the rest):
+
+- ``env``         — device_kind / platform / jax version / timestamp.
+- ``bench``       — the driver bench (``bench.py``), full stdout+stderr.
+- ``randomwalks`` — PPO learning curve on the real chip: metrics/optimality
+                    rising 0 → ~1 (``stats.jsonl``).
+- ``profile``     — a ``jax.profiler`` trace of the bench shapes + proof the
+                    Pallas flash-attention kernel engages on TPU (the CPU
+                    test suite runs it in interpret mode), + the wall-time
+                    split decode/score/train from trainer stats.
+- ``gpt2_xl``     — 1.5B-param real training (scan_layers + remat + bf16 +
+                    adamw_8bit): N optimizer steps, decreasing loss,
+                    tokens/s, peak HBM.
+
+Usage: ``python scripts/tpu_evidence.py [--only stage[,stage]] [--out DIR]``
+
+TPU processes are never SIGKILLed (a kill mid-claim wedges the chip for the
+next session — it ate the r1 AND r2 bench windows): timeouts escalate
+SIGTERM → grace → orphan.
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_stage(name: str, argv, out_dir: str, timeout_s: float, env=None) -> bool:
+    """Run ``argv`` in a subprocess; tee stdout/stderr to artifacts; SIGTERM
+    (never SIGKILL) on timeout."""
+    out_path = os.path.join(out_dir, f"{name}.out")
+    err_path = os.path.join(out_dir, f"{name}.err")
+    t0 = time.time()
+    with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+        proc = subprocess.Popen(
+            argv,
+            stdout=out_f,
+            stderr=err_f,
+            cwd=REPO,
+            env={**os.environ, **(env or {})},
+        )
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            print(f"[{name}] timeout after {timeout_s}s — SIGTERM (never SIGKILL)")
+            for _ in range(3):
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    rc = proc.wait(timeout=60)
+                    break
+                except subprocess.TimeoutExpired:
+                    continue
+            else:
+                print(f"[{name}] pid {proc.pid} ignored SIGTERM; orphaning it")
+                rc = -1
+    dt = time.time() - t0
+    print(f"[{name}] rc={rc} ({dt:.0f}s) → {out_path}")
+    return rc == 0
+
+
+ENV_CODE = """
+import json, time
+import jax
+d = jax.devices()[0]
+print(json.dumps({
+    "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    "platform": d.platform,
+    "device_kind": getattr(d, "device_kind", "?"),
+    "n_devices": jax.device_count(),
+    "jax": jax.__version__,
+}, indent=2))
+"""
+
+RANDOMWALKS_CODE = """
+import os, sys
+sys.path.insert(0, os.path.join({repo!r}, "examples", "randomwalks"))
+os.chdir(os.path.join({repo!r}, "examples", "randomwalks"))
+import importlib.util
+spec = importlib.util.spec_from_file_location("ppo_randomwalks", "ppo_randomwalks.py")
+mod = importlib.util.module_from_spec(spec); spec.loader.exec_module(mod)
+trainer = mod.main({{
+    "train.total_steps": 240,
+    "train.eval_interval": 20,
+    "train.checkpoint_interval": 10000,
+    "train.save_best": False,
+    "train.tracker": "jsonl",
+    "train.checkpoint_dir": {ckpt_dir!r},
+}})
+"""
+
+PROFILE_CODE = """
+import json, os, sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+
+out_dir = {out_dir!r}
+
+# --- 1) Pallas flash-attention engages as a compiled TPU kernel ---------
+from trlx_tpu.ops.flash_attention import flash_attention
+B, H, T, D = 4, 12, 512, 64
+rs = np.random.RandomState(0)
+q = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
+k = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
+v = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
+key_mask = jnp.ones((B, T), jnp.int32)
+compiled = jax.jit(
+    lambda q, k, v, m: flash_attention(q, k, v, m, causal=True)
+).lower(q, k, v, key_mask).compile()
+hlo = compiled.as_text()
+markers = [m for m in ("tpu_custom_call", "mosaic", "custom-call") if m in hlo]
+print(json.dumps({{"flash_kernel_markers": markers, "hlo_len": len(hlo)}}))
+assert any(m in hlo for m in ("tpu_custom_call", "mosaic")), "flash kernel did not lower to a Mosaic TPU custom call"
+
+# --- 2) bench-shaped PPO with a profiler trace + wall-time split --------
+from trlx_tpu.data.default_configs import default_ppo_config
+from trlx_tpu.pipeline import get_pipeline
+from trlx_tpu.trainer import get_trainer
+import trlx_tpu.trainer.ppo, trlx_tpu.pipeline.offline_pipeline  # noqa
+
+chunk, P, N = 128, 64, 40
+config = default_ppo_config().evolve(
+    train=dict(seq_length=P + N, batch_size=chunk, total_steps=10**6,
+               eval_interval=10**6, checkpoint_interval=10**6, epochs=1,
+               checkpoint_dir="/tmp/trlx_tpu_profile", tracker=None),
+    model=dict(model_path="builtin:gpt2-small", num_layers_unfrozen=2),
+    method=dict(num_rollouts=chunk, chunk_size=chunk, ppo_epochs=4,
+                gen_kwargs=dict(max_new_tokens=N, top_k=0, top_p=1.0, do_sample=True)),
+)
+def reward_fn(samples, prompts, outputs, **kw):
+    return [float(sum(c in "aeiou" for c in o)) for o in outputs]
+trainer = get_trainer(config.train.trainer)(config=config, reward_fn=reward_fn,
+                                            metric_fn=None, stop_sequences=[])
+rng = np.random.RandomState(0)
+prompts = ["".join(chr(97 + c) for c in rng.randint(0, 26, P)) for _ in range(512)]
+trainer.add_prompt_pipeline(get_pipeline(config.train.pipeline)(prompts, P, trainer.tokenizer))
+
+def cycle():
+    trainer.store.clear_history()
+    trainer.make_experience(chunk)
+    loader = trainer.store.create_loader(chunk, shuffle=True, query_length=P, response_length=N)
+    t_train = time.time()
+    for batch in loader:
+        for _ in range(config.method.ppo_epochs):
+            stats = trainer.train_step(batch)
+    jax.block_until_ready(trainer.state.params)
+    return time.time() - t_train
+
+cycle()  # warmup/compile
+jax.profiler.start_trace(os.path.join(out_dir, "trace"))
+t0 = time.time()
+t_train = cycle()
+total = time.time() - t0
+jax.profiler.stop_trace()
+es = trainer.make_experience_stats  # recorded by the last make_experience
+split = {{
+    "total_cycle_s": round(total, 3),
+    "train_steps_s": round(t_train, 3),
+    "exp_generate_s": round(es.get("time/exp_generate", float("nan")), 3),
+    "exp_score_s": round(es.get("time/exp_score", float("nan")), 3),
+    "exp_total_s": round(es.get("time/exp", float("nan")), 3),
+}}
+print(json.dumps({{"wall_time_split": split}}))
+mem = jax.devices()[0].memory_stats() or {{}}
+print(json.dumps({{"hbm_peak_bytes": mem.get("peak_bytes_in_use"), "hbm_limit_bytes": mem.get("bytes_limit")}}))
+"""
+
+GPT2_XL_CODE = """
+import json, time
+import numpy as np
+import jax, jax.numpy as jnp
+from trlx_tpu.data.default_configs import default_sft_config
+from trlx_tpu.trainer import get_trainer
+import trlx_tpu.trainer.sft, trlx_tpu.pipeline.offline_pipeline  # noqa
+
+B, T, STEPS = 8, 512, 30
+config = default_sft_config().evolve(
+    train=dict(seq_length=T, batch_size=B, total_steps=STEPS, epochs=10**6,
+               eval_interval=10**6, checkpoint_interval=10**6, save_best=False,
+               checkpoint_dir="/tmp/trlx_tpu_xl", tracker=None),
+    model=dict(model_path="builtin:gpt2-xl",
+               model_extra_kwargs=dict(scan_layers=True)),
+    parallel=dict(data=1, fsdp=1, model=1, remat="full"),
+    optimizer=dict(name="adamw_8bit", kwargs=dict(lr=1e-4, weight_decay=0.0)),
+    scheduler=dict(name="constant", kwargs=dict(lr=1e-4)),
+)
+rs = np.random.RandomState(0)
+corpus = ["".join(chr(97 + c) for c in rs.randint(0, 26, 600)) for _ in range(64)]
+trainer = get_trainer(config.train.trainer)(config=config, reward_fn=None,
+                                            metric_fn=None, stop_sequences=[])
+n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(trainer.state.params))
+print(json.dumps({"n_params": n_params}))
+assert n_params > 1.4e9
+
+trainer.make_experience(corpus, T)
+trainer.prepare_learning()
+losses, t0 = [], None
+import itertools
+loader = itertools.cycle(list(trainer.train_dataloader))
+for step in range(STEPS + 1):
+    batch = next(loader)
+    stats = trainer.train_step(batch)
+    loss = float(np.asarray(jax.device_get(stats["losses/total_loss"])))
+    if step == 0:
+        jax.block_until_ready(trainer.state.params)
+        t0 = time.time()  # exclude compile
+        continue
+    losses.append(loss)
+    print(json.dumps({"step": step, "loss": round(loss, 4)}))
+jax.block_until_ready(trainer.state.params)
+dt = time.time() - t0
+mem = jax.devices()[0].memory_stats() or {}
+print(json.dumps({
+    "steps_timed": STEPS,
+    "tokens_per_sec": round(STEPS * B * T / dt, 1),
+    "step_time_s": round(dt / STEPS, 3),
+    "loss_first": losses[0], "loss_last": losses[-1],
+    "loss_decreasing": losses[-1] < losses[0],
+    "hbm_peak_bytes": mem.get("peak_bytes_in_use"),
+    "hbm_limit_bytes": mem.get("bytes_limit"),
+}))
+assert all(np.isfinite(l) for l in losses)
+assert losses[-1] < losses[0], "loss did not decrease"
+"""
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default=os.path.join(REPO, "benchmarks", "tpu"))
+    parser.add_argument("--only", default=None, help="comma-separated stage names")
+    args = parser.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+    stages = {
+        "env": (ENV_CODE, 600),
+        "bench": (None, 5400),  # bench.py handles its own accelerator wait
+        "randomwalks": (
+            RANDOMWALKS_CODE.format(
+                repo=REPO, ckpt_dir=os.path.join(args.out, "randomwalks_ckpt")
+            ),
+            3600,
+        ),
+        "profile": (PROFILE_CODE.format(out_dir=args.out), 3600),
+        "gpt2_xl": (GPT2_XL_CODE, 3600),
+    }
+    only = args.only.split(",") if args.only else list(stages)
+    ok = {}
+    for name in only:
+        code, timeout_s = stages[name]
+        if name == "bench":
+            # the real driver bench verbatim — same SIGTERM-only timeout as
+            # every other stage (a wedged parent jax.devices() must not hang
+            # the whole evidence window)
+            ok[name] = run_stage(
+                name, [sys.executable, os.path.join(REPO, "bench.py")],
+                args.out, timeout_s,
+            )
+        else:
+            ok[name] = run_stage(name, [sys.executable, "-c", code], args.out, timeout_s)
+        # post-process randomwalks: copy the stats log next to the artifacts
+        if name == "randomwalks" and ok[name]:
+            import glob
+            import shutil
+
+            for p in glob.glob(
+                os.path.join(args.out, "randomwalks_ckpt", "**", "stats.jsonl"),
+                recursive=True,
+            ):
+                shutil.copy(p, os.path.join(args.out, "randomwalks_stats.jsonl"))
+    print(json.dumps(ok))
+    return 0 if all(ok.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

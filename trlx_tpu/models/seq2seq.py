@@ -78,6 +78,12 @@ class Seq2SeqConfig:
     def is_seq2seq(self) -> bool:
         return True
 
+    @property
+    def model_type(self) -> str:
+        # HF family tag — enables the checkpoint layer's HF-format export
+        # (EXPORTERS["t5"]) exactly like the causal families
+        return "t5"
+
     @staticmethod
     def t5(size: str = "small", **overrides) -> "Seq2SeqConfig":
         dims = {

@@ -42,7 +42,9 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
@@ -297,21 +299,34 @@ def run_trial(
     if extra_env:
         env.update(extra_env)
     with open(log_path, "a") as log:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(script), json.dumps(hparams)],
+            cwd=os.path.dirname(os.path.abspath(script)) or None,
+            env=env,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+        )
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(script), json.dumps(hparams)],
-                cwd=os.path.dirname(os.path.abspath(script)) or None,
-                env=env,
-                stdout=log,
-                stderr=subprocess.STDOUT,
-                timeout=timeout,
-            )
+            return proc.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
             # a hung trial must not abort the sweep; its last _report_sweep
-            # write (if any) still counts
-            log.write(f"\nsweep: trial killed after {timeout}s timeout\n")
+            # write (if any) still counts. SIGTERM only — a trial hung on
+            # the accelerator claim must NEVER be SIGKILLed (a kill
+            # mid-claim wedges the chip for every subsequent trial); if it
+            # ignores SIGTERM, orphan it and move on.
+            for _ in range(2):
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                    log.write(f"\nsweep: trial terminated after {timeout}s timeout\n")
+                    return -1
+                except subprocess.TimeoutExpired:
+                    continue
+            log.write(
+                f"\nsweep: trial pid {proc.pid} ignored SIGTERM after "
+                f"{timeout}s timeout; orphaned (never SIGKILL — chip wedge)\n"
+            )
             return -1
-    return proc.returncode
 
 
 def run_sweep(
@@ -322,10 +337,21 @@ def run_sweep(
     seed: int = 0,
     trial_timeout: Optional[float] = None,
     extra_env: Optional[Dict[str, str]] = None,
+    max_concurrent: int = 1,
 ) -> List[Dict[str, Any]]:
-    """Run every trial sequentially (one accelerator — concurrency is
-    cross-host, not cross-trial), logging a JSONL results table, and return
-    the records ranked best-first.
+    """Run the sweep's trials (subprocesses of the user script), logging a
+    JSONL results table, and return the records ranked best-first.
+
+    Concurrency (``max_concurrent`` / ``tune_config.max_concurrent``): up to
+    N trials run at once in a subprocess pool, the reference's Ray Tune
+    parallel-trials capability (``trlx/sweep.py:267-347``, per-trial
+    resources).  Parallel trials only make sense on a CPU mesh (one process
+    per trial); when the trials would target a single accelerator the sweep
+    serializes automatically with a warning — pass
+    ``extra_env={"JAX_PLATFORMS": "cpu"}`` (CLI ``--cpu-trials``) to opt
+    into parallel CPU trials.  Adaptive search (TPE) under concurrency
+    proposes in chunks of ``max_concurrent`` from the history completed so
+    far — the same stale-history compromise Ray makes.
 
     Schedulers (``tune_config.scheduler``): ``fifo`` (default — every trial
     runs its full budget, the reference's default) or ``asha``/``hyperband``
@@ -334,9 +360,12 @@ def run_sweep(
     initial population runs at a small budget (``grace_period`` steps of the
     ``budget_key`` dot-path, default ``train.total_steps``), the top
     ``1/reduction_factor`` fraction is promoted to an ``eta``-times larger
-    budget, repeating until ``max_t``. Promoted trials rerun at the larger
-    budget (same hparams); configure checkpointing dot-paths in the sweep to
-    make reruns resume instead.
+    budget, repeating until ``max_t``.  By default promoted trials RESUME
+    from the rung's final interval checkpoint (each config gets a private
+    ``train.checkpoint_dir`` under the sweep dir and promotions set
+    ``train.resume_from_checkpoint``); set ``tune_config.asha_resume: false``
+    to rerun promotions from scratch instead (e.g. when the user script
+    overrides checkpointing itself).
     """
     space = SweepSpace.from_config(config)
     tune = space.tune
@@ -349,6 +378,18 @@ def run_sweep(
         raise ValueError(
             f"scheduler '{scheduler}' not supported (fifo, asha/hyperband)"
         )
+    max_concurrent = max(1, int(tune.get("max_concurrent", max_concurrent)))
+    trial_platform = (extra_env or {}).get(
+        "JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "")
+    )
+    if max_concurrent > 1 and trial_platform.lower() != "cpu":
+        logger.warning(
+            f"max_concurrent={max_concurrent} but trials target the "
+            "accelerator (JAX_PLATFORMS is not 'cpu'); a single chip cannot "
+            "host concurrent trials — serializing. Pass --cpu-trials (or "
+            "extra_env JAX_PLATFORMS=cpu) for parallel CPU-mesh trials."
+        )
+        max_concurrent = 1
 
     os.makedirs(output_dir, exist_ok=True)
     results_path = os.path.join(output_dir, "results.jsonl")
@@ -357,15 +398,21 @@ def run_sweep(
     grid_points = space.grid_points()
     draws = max(1, n)
     sign = 1.0 if mode == "max" else -1.0
+    lock = threading.Lock()
     logger.info(
-        f"Sweep[{search_alg}/{scheduler}]: {draws * len(grid_points)} base trials "
+        f"Sweep[{search_alg}/{scheduler}"
+        + (f"/x{max_concurrent}" if max_concurrent > 1 else "")
+        + f"]: {draws * len(grid_points)} base trials "
         f"of {os.path.basename(script)} → {output_dir}"
     )
 
     with open(results_path, "w") as results_f:
 
         def launch(hparams: Dict[str, Any], us: np.ndarray, rung: Optional[int] = None) -> Dict[str, Any]:
-            i = len(records)
+            with lock:  # reserve a trial index
+                i = len(records)
+                record: Dict[str, Any] = {"trial": i, "metric": None}
+                records.append(record)
             t0 = time.time()
             result_path = os.path.join(output_dir, f"trial_{i:03d}.json")
             log_path = os.path.join(output_dir, f"trial_{i:03d}.log")
@@ -374,33 +421,53 @@ def run_sweep(
             if os.path.exists(result_path):
                 with open(result_path) as f:
                     stats = json.load(f)
-            record = {
-                "trial": i,
-                "hparams": hparams,
-                "u": [float(x) for x in us],
-                "rc": rc,
-                "runtime_s": round(time.time() - t0, 1),
-                "metric": stats.get("stats", {}).get(metric),
-                "stats": stats.get("stats", {}),
-                "iter_count": stats.get("iter_count"),
-            }
+            record.update(
+                hparams=hparams,
+                u=[float(x) for x in us],
+                rc=rc,
+                runtime_s=round(time.time() - t0, 1),
+                metric=stats.get("stats", {}).get(metric),
+                stats=stats.get("stats", {}),
+                iter_count=stats.get("iter_count"),
+            )
             if rung is not None:
                 record["rung"] = rung
-            records.append(record)
-            results_f.write(json.dumps(record) + "\n")
-            results_f.flush()
+            with lock:
+                results_f.write(json.dumps(record) + "\n")
+                results_f.flush()
             logger.info(
                 f"trial {i}{'' if rung is None else f' (rung {rung})'}: rc={rc} "
                 f"{metric}={record['metric']} ({record['runtime_s']}s) {hparams}"
             )
             return record
 
+        def launch_batch(
+            batch: List[Tuple[Dict[str, Any], np.ndarray, Optional[int]]]
+        ) -> List[Dict[str, Any]]:
+            """Run a batch of trials, up to ``max_concurrent`` at a time."""
+            if max_concurrent <= 1 or len(batch) <= 1:
+                return [launch(h, u, r) for h, u, r in batch]
+            with ThreadPoolExecutor(max_workers=max_concurrent) as pool:
+                futs = [pool.submit(launch, h, u, r) for h, u, r in batch]
+                return [f.result() for f in futs]
+
         def next_us() -> np.ndarray:
-            history = [
-                (r["u"], sign * r["metric"])
-                for r in records
-                if r.get("u") is not None and r.get("metric") is not None
-            ]
+            # TPE history: one entry per unit-cube point. ASHA promotions
+            # re-launch the same u-vector at a larger budget — keep only the
+            # highest-budget (latest-rung) metric per point so promoted
+            # configs aren't double-weighted in the Parzen good set, while
+            # the search still sees the most-converged estimate.
+            by_u: Dict[Tuple[float, ...], Tuple[int, float]] = {}
+            with lock:
+                snapshot = list(records)
+            for r in snapshot:
+                if r.get("u") is None or r.get("metric") is None:
+                    continue
+                key = tuple(r["u"])
+                rung = r.get("rung") or 0
+                if key not in by_u or rung >= by_u[key][0]:
+                    by_u[key] = (rung, sign * r["metric"])
+            history = [(list(k), m) for k, (_, m) in by_u.items()]
             return searcher.propose(history)
 
         def proposals() -> Iterator[Tuple[Dict[str, Any], np.ndarray]]:
@@ -417,10 +484,18 @@ def run_sweep(
                     yield space.realize(point, us), us
 
         if scheduler == "fifo":
+            # chunks of max_concurrent keep adaptive search fed with
+            # completed results between batches
+            batch: List[Tuple[Dict[str, Any], np.ndarray, Optional[int]]] = []
             for hparams, us in proposals():
-                launch(hparams, us)
+                batch.append((hparams, us, None))
+                if len(batch) >= max_concurrent:
+                    launch_batch(batch)
+                    batch = []
+            if batch:
+                launch_batch(batch)
         else:
-            _run_asha(tune, proposals(), launch, sign)
+            _run_asha(tune, proposals(), launch_batch, sign, output_dir, max_concurrent)
 
     def rank_key(r):
         m = r["metric"]
@@ -436,8 +511,10 @@ def run_sweep(
 def _run_asha(
     tune: Dict[str, Any],
     proposals: Iterator[Tuple[Dict[str, Any], np.ndarray]],
-    launch,
+    launch_batch,
     sign: float,
+    output_dir: str,
+    max_concurrent: int = 1,
 ) -> None:
     """Synchronous successive halving over the trial budget.
 
@@ -445,9 +522,14 @@ def _run_asha(
     ``grace_period * reduction_factor**r`` (capped at ``max_t``); the top
     ``1/reduction_factor`` fraction by metric is promoted to the next rung.
     The capability analogue of Ray's HyperBandScheduler in the reference
-    (``trlx/sweep.py:136-174``) adapted to sequential subprocess trials:
-    promotions rerun at the larger budget rather than preempting/resuming a
-    live actor.
+    (``trlx/sweep.py:136-174``) adapted to subprocess trials.
+
+    By default each config gets a private checkpoint dir
+    (``<output_dir>/ckpt_cfg<i>`` via ``train.checkpoint_dir``) and promoted
+    trials set ``train.resume_from_checkpoint`` so rung r+1 CONTINUES from
+    rung r's final interval checkpoint instead of reburning its compute —
+    Ray's pause/resume actor semantics. ``tune_config.asha_resume: false``
+    (or custom ``checkpoint_dir_key``/``resume_key``) opts out/retargets.
     """
     eta = int(tune.get("reduction_factor", 3))
     if eta < 2:
@@ -458,16 +540,46 @@ def _run_asha(
     max_t = int(max_t)
     grace = int(tune.get("grace_period", max(1, max_t // eta**2)))
     budget_key = tune.get("budget_key", "train.total_steps")
+    resume = bool(tune.get("asha_resume", True))
+    ckpt_key = tune.get("checkpoint_dir_key", "train.checkpoint_dir")
+    resume_key = tune.get("resume_key", "train.resume_from_checkpoint")
+
+    def with_ckpt(hparams: Dict[str, Any], cid: int, promoted: bool) -> Dict[str, Any]:
+        if not resume:
+            return hparams
+        hp = dict(hparams)
+        hp[ckpt_key] = os.path.join(output_dir, f"ckpt_cfg{cid:03d}")
+        if promoted:
+            hp[resume_key] = True
+        return hp
 
     t = min(grace, max_t)
-    # rung 0 consumes the proposal stream lazily, so adaptive search
-    # (bayesopt) sees each completed low-budget trial before proposing the
-    # next — draining it upfront would silently degrade TPE to its warmup
+    # rung 0 consumes the proposal stream lazily in batches, so adaptive
+    # search (bayesopt) sees completed low-budget trials between batches —
+    # draining it upfront would silently degrade TPE to its warmup
     results = []
+    cid = 0
+    pending: List[Tuple[int, Dict[str, Any], np.ndarray]] = []
+
+    def flush_rung0():
+        nonlocal results
+        if not pending:
+            return
+        recs = launch_batch(
+            [({**with_ckpt(h, c, False), budget_key: t}, us, 0) for c, h, us in pending]
+        )
+        for (c, h, us), rec in zip(pending, recs):
+            if rec["metric"] is not None:
+                results.append((sign * rec["metric"], c, h, us))
+        pending.clear()
+
     for hparams, us in proposals:
-        rec = launch({**hparams, budget_key: t}, us, rung=0)
-        if rec["metric"] is not None:
-            results.append((sign * rec["metric"], hparams, us))
+        pending.append((cid, hparams, us))
+        cid += 1
+        if len(pending) >= max_concurrent:
+            flush_rung0()
+    flush_rung0()
+
     rung = 0
     while t < max_t and results:
         results.sort(key=lambda r: -r[0])
@@ -477,11 +589,17 @@ def _run_asha(
         # config always gets its full max_t run
         t = max_t if len(survivors) <= 1 else min(t * eta, max_t)
         rung += 1
-        results = []
-        for _, hparams, us in survivors:
-            rec = launch({**hparams, budget_key: t}, us, rung=rung)
-            if rec["metric"] is not None:
-                results.append((sign * rec["metric"], hparams, us))
+        recs = launch_batch(
+            [
+                ({**with_ckpt(h, c, True), budget_key: t}, us, rung)
+                for _, c, h, us in survivors
+            ]
+        )
+        results = [
+            (sign * rec["metric"], c, h, us)
+            for (_, c, h, us), rec in zip(survivors, recs)
+            if rec["metric"] is not None
+        ]
 
 
 def report(records: List[Dict[str, Any]], metric: str, mode: str, output_dir: str) -> None:
@@ -511,6 +629,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--output-dir", default=None)
     parser.add_argument("--num-samples", type=int, default=None)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--max-concurrent",
+        type=int,
+        default=1,
+        help="run up to N trials at once (requires CPU-mesh trials; see --cpu-trials)",
+    )
+    parser.add_argument(
+        "--cpu-trials",
+        action="store_true",
+        help="force each trial onto a CPU mesh (JAX_PLATFORMS=cpu) so trials "
+        "can run concurrently without contending for the accelerator",
+    )
     args = parser.parse_args(argv)
 
     with open(args.config) as f:
@@ -518,8 +648,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     output_dir = args.output_dir or os.path.join(
         "sweeps", os.path.splitext(os.path.basename(args.script))[0] + time.strftime("-%y%m%d-%H%M%S")
     )
+    extra_env = {"JAX_PLATFORMS": "cpu"} if args.cpu_trials else None
     records = run_sweep(
-        args.script, config, output_dir, num_samples=args.num_samples, seed=args.seed
+        args.script,
+        config,
+        output_dir,
+        num_samples=args.num_samples,
+        seed=args.seed,
+        extra_env=extra_env,
+        max_concurrent=args.max_concurrent,
     )
     return 0 if records and any(r["metric"] is not None for r in records) else 1
 

@@ -122,6 +122,13 @@ class DPOTrainer(TPUBaseTrainer):
         )
 
     def prepare_learning(self) -> None:
+        if len(self.store) < self.config.train.batch_size:
+            raise ValueError(
+                f"preference dataset has {len(self.store)} pairs but "
+                f"train.batch_size={self.config.train.batch_size}; the loader "
+                "drops incomplete batches, so training would silently run zero "
+                "updates — lower train.batch_size or provide more pairs"
+            )
         self.train_dataloader = self.store.create_loader(
             self.config.train.batch_size, shuffle=True, seed=self.config.train.seed
         )

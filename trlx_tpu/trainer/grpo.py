@@ -87,6 +87,8 @@ class GRPOTrainer(PPOTrainer):
         stats: Dict[str, float] = {}
         elements = []
         kl_sum, kl_batches = 0.0, 0
+        gen_time_sum, score_time_sum = 0.0, 0.0
+        all_scores: list = []
         exp_time = time()
 
         while len(elements) < num_rollouts:
@@ -120,7 +122,7 @@ class GRPOTrainer(PPOTrainer):
             )
             response_tokens = np.asarray(host_gen["response_tokens"])
             response_mask = np.asarray(host_gen["response_mask"])
-            stats["time/exp_generate"] = time() - gen_time
+            gen_time_sum += time() - gen_time
 
             samples, prompts, outputs = self.decode(
                 prompt_ids, response_tokens, append_eos_token=True
@@ -130,7 +132,7 @@ class GRPOTrainer(PPOTrainer):
                 self.reward_fn(samples=samples, prompts=prompts, outputs=outputs),
                 dtype=np.float32,
             )
-            stats["time/exp_score"] = time() - score_time
+            score_time_sum += time() - score_time
             host = to_host(score_out)
 
             clip = method.cliprange_reward
@@ -138,8 +140,7 @@ class GRPOTrainer(PPOTrainer):
                 scores = np.clip(scores, -clip, clip)
             self.running_moments.update(scores)  # logging only: the group
             # normalization below IS the reward scaling in GRPO
-            stats["exp_scores/mean"] = float(scores.mean())
-            stats["exp_scores/std"] = float(scores.std())
+            all_scores.append(scores)
             advantages = group_advantages_np(scores, G, method.scale_advantage)
 
             # reference KL for logging (the loss recomputes it on device)
@@ -166,6 +167,11 @@ class GRPOTrainer(PPOTrainer):
 
         self.mean_kl = kl_sum / max(kl_batches, 1)
         stats["policy/sqrt_ref_kl"] = float(np.sqrt(max(self.mean_kl, 0.0)))
+        stats["time/exp_generate"] = gen_time_sum
+        stats["time/exp_score"] = score_time_sum
+        pooled = np.concatenate(all_scores) if all_scores else np.zeros((0,), np.float32)
+        stats["exp_scores/mean"] = float(pooled.mean()) if pooled.size else 0.0
+        stats["exp_scores/std"] = float(pooled.std()) if pooled.size else 0.0
         stats["time/exp"] = time() - exp_time
         self.make_experience_stats = stats
         self.tracker.log(stats, step=iter_count)

@@ -68,16 +68,21 @@ def _configure_root():
 
 def _process_index() -> int:
     # Cheap: prefer env (set before jax.distributed init) over importing jax.
-    for var in ("JAX_PROCESS_INDEX", "RANK"):
+    for var in ("TRLX_TPU_PROCESS_ID", "JAX_PROCESS_INDEX", "RANK"):
         if var in os.environ:
             try:
                 return int(os.environ[var])
             except ValueError:
                 pass
+    # Read the distributed-runtime state WITHOUT initializing a backend:
+    # ``jax.process_index()`` would trigger backend init, which on a
+    # contended/wedged TPU blocks for minutes — a log prefix must never
+    # touch the accelerator (bit the sweep CLI: its first log line hung).
     try:
-        import jax
+        from jax._src import distributed
 
-        return jax.process_index()
+        pid = distributed.global_state.process_id
+        return int(pid) if pid is not None else 0
     except Exception:
         return 0
 
