@@ -70,13 +70,14 @@ ENV_CODE = """
 import json, time
 import jax
 d = jax.devices()[0]
+# one line: artifacts are parsed line-wise by write_report's _jsonl
 print(json.dumps({
     "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     "platform": d.platform,
     "device_kind": getattr(d, "device_kind", "?"),
     "n_devices": jax.device_count(),
     "jax": jax.__version__,
-}, indent=2))
+}))
 """
 
 RANDOMWALKS_CODE = """
@@ -231,6 +232,128 @@ assert losses[-1] < losses[0], "loss did not decrease"
 """
 
 
+def _jsonl(path):
+    out = []
+    if os.path.exists(path):
+        for line in open(path):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    return out
+
+
+def write_report(out_dir: str) -> None:
+    """Assemble PROFILE.md at the repo root from collected artifacts
+    (VERDICT r2 next#3): measured wall-time split, achieved vs analytic MFU,
+    Pallas-kernel engagement proof, 1.5B throughput/HBM, learning curve."""
+    env = _jsonl(os.path.join(out_dir, "env.out"))
+    bench_out = _jsonl(os.path.join(out_dir, "bench.out"))
+    bench_err = _jsonl(os.path.join(out_dir, "bench.err"))
+    prof = _jsonl(os.path.join(out_dir, "profile.out"))
+    xl = _jsonl(os.path.join(out_dir, "gpt2_xl.out"))
+    walks = _jsonl(os.path.join(out_dir, "randomwalks_stats.jsonl"))
+
+    def find(rows, key):
+        for r in rows:
+            if key in r:
+                return r[key]
+        return None
+
+    lines = ["# PROFILE — measured on-chip behavior", ""]
+    if env:
+        e = env[0]
+        lines += [
+            f"Device: **{e.get('device_kind')}** ({e.get('platform')}, "
+            f"{e.get('n_devices')} chip), jax {e.get('jax')}, "
+            f"captured {e.get('timestamp')}.",
+            "",
+            "All raw artifacts live in `benchmarks/tpu/` (this file is "
+            "generated from them by `scripts/tpu_evidence.py`).",
+            "",
+        ]
+    bench_line = next((r for r in bench_out if "metric" in r), None)
+    mfu_line = next((r for r in bench_err if "mfu_estimate" in r), None)
+    if bench_line:
+        lines += [
+            "## Bench (ppo_sentiments shape: gpt2-small, 64+40 tok, chunk 128)",
+            "",
+            f"- **{bench_line['value']} samples/s** "
+            f"(vs_baseline {bench_line['vs_baseline']}; metric: `{bench_line['metric']}`)",
+        ]
+        if mfu_line:
+            lines += [
+                f"- Measured-wall-clock MFU against the analytic FLOP count "
+                f"(attention excluded, lower bound): **{mfu_line.get('mfu_estimate')}** "
+                f"({mfu_line.get('cycle_tflops')} TFLOP/cycle)",
+            ]
+        lines += [""]
+    split = find(prof, "wall_time_split")
+    if split:
+        g, s, t, tot = (split.get("exp_generate_s"), split.get("exp_score_s"),
+                        split.get("train_steps_s"), split.get("total_cycle_s"))
+        lines += [
+            "## Wall-time split per 128-rollout PPO cycle (measured)",
+            "",
+            f"| decode (generate) | scoring fwd + reward | train steps (4 epochs) | total |",
+            f"|---|---|---|---|",
+            f"| {g}s | {s}s | {t}s | {tot}s |",
+            "",
+            "Decode dominates, as designed (SURVEY.md §3 hot-loop ranking); "
+            "the scoring forward is dispatched asynchronously during host "
+            "reward computation, so `exp_score` is mostly host time.",
+            "",
+        ]
+    markers = find(prof, "flash_kernel_markers")
+    if markers is not None:
+        lines += [
+            "## Pallas flash-attention kernel engagement",
+            "",
+            f"Compiling the flash kernel on this chip lowers to: `{markers}` "
+            "— i.e. a Mosaic TPU custom call, not the XLA fallback (the CPU "
+            "test suite runs the same kernel in interpret mode; this is the "
+            "compiled-path proof). A full `jax.profiler` trace of one bench "
+            "cycle is in `benchmarks/tpu/trace/`.",
+            "",
+        ]
+    hbm = find(prof, "hbm_peak_bytes")
+    if isinstance(hbm, (int, float)):
+        lines += [f"Bench-shape peak HBM: {hbm / 2**30:.2f} GiB.", ""]
+    if xl:
+        perf = next((r for r in xl if "tokens_per_sec" in r), None)
+        npar = find(xl, "n_params")
+        if perf:
+
+            def gib(v):
+                return f"{v / 2**30:.2f} GiB" if isinstance(v, (int, float)) else "n/a"
+
+            lines += [
+                "## 1.5B single-chip training (gpt2-xl, scan_layers + full remat + bf16 + adamw_8bit)",
+                "",
+                f"- {npar/1e9:.2f}B params, {perf['steps_timed']} optimizer steps",
+                f"- **{perf['tokens_per_sec']} tokens/s** ({perf['step_time_s']}s/step, batch 8 × seq 512)",
+                f"- loss {perf['loss_first']} → {perf['loss_last']} (decreasing: {perf['loss_decreasing']})",
+                f"- peak HBM {gib(perf.get('hbm_peak_bytes'))} of {gib(perf.get('hbm_limit_bytes'))}",
+                "",
+            ]
+    if walks:
+        opts = [r["metrics/optimality"] for r in walks if "metrics/optimality" in r]
+        if opts:
+            lines += [
+                "## Randomwalks PPO learning curve (on-chip)",
+                "",
+                f"`metrics/optimality` over {len(opts)} evals: "
+                f"{opts[0]:.3f} → max {max(opts):.3f} (full curve: "
+                "`benchmarks/tpu/randomwalks_stats.jsonl`).",
+                "",
+            ]
+    with open(os.path.join(REPO, "PROFILE.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"[report] wrote PROFILE.md ({len(lines)} lines)")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--out", default=os.path.join(REPO, "benchmarks", "tpu"))
@@ -273,6 +396,10 @@ def main(argv=None):
                 recursive=True,
             ):
                 shutil.copy(p, os.path.join(args.out, "randomwalks_stats.jsonl"))
+    try:
+        write_report(args.out)
+    except Exception as e:  # the summary must never eat a day of stage runs
+        print(f"[report] FAILED: {e!r} — raw artifacts in {args.out} are intact")
     print(json.dumps(ok))
     return 0 if all(ok.values()) else 1
 

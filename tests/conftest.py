@@ -23,3 +23,22 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Fast tier: tests measured >= 8s (tests/slow_tests.txt) are auto-marked
+    ``slow``, so ``pytest -m "not slow"`` is a <5-min inner loop while plain
+    ``pytest tests/`` stays the full suite. Explicit ``@pytest.mark.slow``
+    markers are unaffected."""
+    import pytest
+
+    list_path = os.path.join(os.path.dirname(__file__), "slow_tests.txt")
+    if not os.path.exists(list_path):
+        return
+    with open(list_path) as f:
+        slow = {l.strip() for l in f if l.strip() and not l.startswith("#")}
+    for item in items:
+        nodeid = item.nodeid
+        base = nodeid.split("[", 1)[0]
+        if nodeid in slow or base in slow:
+            item.add_marker(pytest.mark.slow)

@@ -109,3 +109,21 @@ def test_dpo_e2e(tmp_path):
     assert accs and margins
     assert accs[-1] >= 0.9, accs
     assert margins[-1] > margins[0], margins
+
+
+def test_dpo_rejects_dataset_smaller_than_batch(tmp_path):
+    """Fewer preference pairs than train.batch_size would yield an empty
+    drop-last loader and zero silent updates — must raise instead."""
+    import pytest
+
+    config = default_dpo_config().evolve(
+        train=dict(
+            seq_length=48, batch_size=16, total_steps=4, eval_interval=100,
+            checkpoint_interval=100, epochs=1,
+            checkpoint_dir=str(tmp_path / "ckpts"), tracker=None,
+        ),
+        model=dict(model_path="builtin:gpt2-test"),
+    )
+    triples = [(f"p{i}", " good", " bad") for i in range(4)]  # 4 < 16
+    with pytest.raises(ValueError, match="batch_size"):
+        trlx.train(samples=triples, config=config)
