@@ -267,10 +267,15 @@ def _jsonl(path):
     return out
 
 
-def write_report(out_dir: str) -> None:
-    """Assemble PROFILE.md at the repo root from collected artifacts
-    (VERDICT r2 next#3): measured wall-time split, achieved vs analytic MFU,
-    Pallas-kernel engagement proof, 1.5B throughput/HBM, learning curve."""
+def write_report(out_dir: str, allow_publish: bool = False) -> None:
+    """Assemble PROFILE.md from collected artifacts (VERDICT r2 next#3):
+    measured wall-time split, achieved vs analytic MFU, Pallas-kernel
+    engagement proof, 1.5B throughput/HBM, learning curve.
+
+    Publishing to the repo-root PROFILE.md additionally requires
+    ``allow_publish`` — set by ``main`` only when the env stage ran IN THIS
+    INVOCATION (a stale on-disk env.out from an earlier TPU run must not
+    let a partial CPU rerun masquerade as on-chip evidence)."""
     env = _jsonl(os.path.join(out_dir, "env.out"))
     bench_out = _jsonl(os.path.join(out_dir, "bench.out"))
     bench_err = _jsonl(os.path.join(out_dir, "bench.err"))
@@ -392,7 +397,9 @@ def write_report(out_dir: str) -> None:
     out_path = os.path.join(out_dir, "PROFILE.md")
     with open(out_path, "w") as f:
         f.write("\n".join(lines) + "\n")
-    on_accelerator = bool(env) and env[0].get("platform") not in (None, "cpu")
+    on_accelerator = (
+        allow_publish and bool(env) and env[0].get("platform") not in (None, "cpu")
+    )
     if on_accelerator:
         with open(os.path.join(REPO, "PROFILE.md"), "w") as f:
             f.write("\n".join(lines) + "\n")
@@ -404,9 +411,19 @@ def write_report(out_dir: str) -> None:
 
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("--out", default=os.path.join(REPO, "benchmarks", "tpu"))
+    default_out = os.path.join(REPO, "benchmarks", "tpu")
+    parser.add_argument("--out", default=default_out)
     parser.add_argument("--only", default=None, help="comma-separated stage names")
     args = parser.parse_args(argv)
+    if (
+        os.environ.get("TRLX_TPU_PLATFORM", "").lower() == "cpu"
+        and os.path.abspath(args.out) == default_out
+    ):
+        parser.error(
+            "CPU smoke runs must pass an explicit --out scratch directory: "
+            "the default benchmarks/tpu/ is the COMMITTED evidence directory "
+            "and must only ever hold artifacts from real accelerator runs"
+        )
     os.makedirs(args.out, exist_ok=True)
     stages = {
         "env": (ENV_CODE, 600),
@@ -445,7 +462,7 @@ def main(argv=None):
             ):
                 shutil.copy(p, os.path.join(args.out, "randomwalks_stats.jsonl"))
     try:
-        write_report(args.out)
+        write_report(args.out, allow_publish=bool(ok.get("env")))
     except Exception as e:  # the summary must never eat a day of stage runs
         print(f"[report] FAILED: {e!r} — raw artifacts in {args.out} are intact")
     print(json.dumps(ok))

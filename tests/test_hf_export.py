@@ -172,3 +172,30 @@ def test_trainer_save_pretrained_writes_hf(tmp_path):
     trainer.save_pretrained(out)
     model = transformers.AutoModelForCausalLM.from_pretrained(out)
     assert model.config.vocab_size == trainer.tcfg.vocab_size
+
+
+def test_t5_lora_merged_on_export():
+    """A LoRA-tuned T5 exports with adapters folded into the kernels
+    (same exact-merge semantics as the causal families)."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.builder import build_seq2seq_lm
+
+    module, params, scfg = build_seq2seq_lm(
+        ModelConfig(
+            "builtin:t5-test", model_arch_type="seq2seq",
+            peft_kwargs={"peft_type": "lora", "r": 4, "lora_alpha": 8,
+                         "modified_modules": "attention"},
+        ),
+        head="value",
+    )
+    proj = params["backbone"]["dec_0"]["cross_attn"]["q_proj"]
+    proj["lora_b"] = jnp.ones_like(proj["lora_b"]) * 0.01
+    sd = hf_interop.params_to_hf_state_dict(params, scfg)
+    base = np.asarray(proj["kernel"])
+    expected = base + (np.asarray(proj["lora_a"]) @ np.asarray(proj["lora_b"])) * (
+        scfg.lora_alpha / scfg.lora_r
+    )
+    merged = np.asarray(sd["decoder.block.0.layer.1.EncDecAttention.q.weight"]).T
+    np.testing.assert_allclose(merged, expected, atol=1e-6)
+    assert not any("lora" in k for k in sd)

@@ -137,3 +137,22 @@ def test_kl_penalty_rewards_np_matches_device():
     np.testing.assert_allclose(np.asarray(r_dev), r_np, atol=1e-6)
     assert abs(float(kl_dev) - kl_np) < 1e-6
     assert abs(float(kls_dev) - kls_np) < 1e-6
+
+
+def test_log_rank_prefix_never_initializes_backend(monkeypatch):
+    """The log rank prefix must come from env or the distributed state
+    object — jax.process_index() would initialize a backend, which on a
+    contended TPU blocks for minutes just to print '[RANK 0]'."""
+    from trlx_tpu.utils import logging as tlog
+
+    for var in ("TRLX_TPU_PROCESS_ID", "JAX_PROCESS_INDEX", "RANK"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("TRLX_TPU_PROCESS_ID", "3")
+    assert tlog._process_index() == 3
+    monkeypatch.delenv("TRLX_TPU_PROCESS_ID")
+    monkeypatch.setenv("RANK", "2")
+    assert tlog._process_index() == 2
+    monkeypatch.delenv("RANK")
+    # no env: falls through to jax.distributed global state WITHOUT backend
+    # init — uninitialized single-process state reads as rank 0
+    assert tlog._process_index() == 0
