@@ -176,6 +176,21 @@ def main():
     on_cpu = devices[0].platform == "cpu"
     if fallback_err is not None:
         print(f"bench: accelerator unavailable, CPU fallback: {fallback_err}", file=sys.stderr)
+    # self-documenting provenance: device kind + timestamp ride the stderr
+    # artifact so a bench capture alone is attributable evidence
+    print(
+        json.dumps(
+            {
+                "bench_env": {
+                    "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    "platform": devices[0].platform,
+                    "device_kind": getattr(devices[0], "device_kind", "?"),
+                    "n_devices": len(devices),
+                }
+            }
+        ),
+        file=sys.stderr,
+    )
 
     from trlx_tpu.data.default_configs import default_ppo_config
     from trlx_tpu.pipeline import get_pipeline
