@@ -425,6 +425,8 @@ def main(argv=None):
             "and must only ever hold artifacts from real accelerator runs"
         )
     os.makedirs(args.out, exist_ok=True)
+    # ordered by evidence value: if the chip window closes mid-run, the
+    # north-star bench and the learning curve land before the extras
     stages = {
         "env": (ENV_CODE, 600),
         "bench": (None, 5400),  # bench.py handles its own accelerator wait
@@ -434,8 +436,8 @@ def main(argv=None):
             ),
             3600,
         ),
-        "profile": (PROFILE_CODE.format(out_dir=args.out), 3600),
         "gpt2_xl": (GPT2_XL_CODE, 3600),
+        "profile": (PROFILE_CODE.format(out_dir=args.out), 3600),
     }
     only = args.only.split(",") if args.only else list(stages)
     ok = {}
