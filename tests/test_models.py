@@ -66,6 +66,15 @@ def _tiny_hf(family: str):
         )
     elif family == "bloom":
         hf = tf.BloomForCausalLM(tf.BloomConfig(vocab_size=97, hidden_size=32, n_layer=2, n_head=4))
+    elif family == "mixtral":
+        hf = tf.MixtralForCausalLM(
+            tf.MixtralConfig(
+                vocab_size=97, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, intermediate_size=64, max_position_embeddings=64,
+                num_local_experts=4, num_experts_per_tok=2, sliding_window=None,
+                tie_word_embeddings=False,
+            )
+        )
     else:
         raise ValueError(family)
     hf.eval()
@@ -73,7 +82,7 @@ def _tiny_hf(family: str):
     return hf, params, _f32(cfg)
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama", "gpt_neox", "gptj", "opt", "bloom"])
+@pytest.mark.parametrize("family", ["gpt2", "llama", "gpt_neox", "gptj", "opt", "bloom", "mixtral"])
 def test_hf_logit_parity(family):
     """The flax decoder reproduces the torch reference logits exactly."""
     import torch

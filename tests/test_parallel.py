@@ -25,10 +25,10 @@ from trlx_tpu.parallel.sharding import param_specs
 
 def test_mesh_shape_inference():
     # axis order: (data, pipe, fsdp, model, sequence)
-    assert mesh_shape_from_config(ParallelConfig(), 8) == (8, 1, 1, 1, 1)
-    assert mesh_shape_from_config(ParallelConfig(data=2, fsdp=2, model=2), 8) == (2, 1, 2, 2, 1)
-    assert mesh_shape_from_config(ParallelConfig(data=-1, model=4), 8) == (2, 1, 1, 4, 1)
-    assert mesh_shape_from_config(ParallelConfig(data=1, pipe=4, model=2), 8) == (1, 4, 1, 2, 1)
+    assert mesh_shape_from_config(ParallelConfig(), 8) == (8, 1, 1, 1, 1, 1)
+    assert mesh_shape_from_config(ParallelConfig(data=2, fsdp=2, model=2), 8) == (2, 1, 2, 2, 1, 1)
+    assert mesh_shape_from_config(ParallelConfig(data=-1, model=4), 8) == (2, 1, 1, 4, 1, 1)
+    assert mesh_shape_from_config(ParallelConfig(data=1, pipe=4, model=2), 8) == (1, 4, 1, 2, 1, 1)
     with pytest.raises(ValueError):
         mesh_shape_from_config(ParallelConfig(data=3), 8)
     with pytest.raises(ValueError):
@@ -37,7 +37,7 @@ def test_mesh_shape_inference():
 
 def test_make_mesh_axes():
     mesh = make_mesh(ParallelConfig(data=2, fsdp=2, model=2))
-    assert mesh.axis_names == ("data", "pipe", "fsdp", "model", "sequence")
+    assert mesh.axis_names == ("data", "pipe", "fsdp", "model", "sequence", "expert")
     assert mesh.shape["data"] == 2 and mesh.shape["model"] == 2
     assert mesh.shape["pipe"] == 1
 

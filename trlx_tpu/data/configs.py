@@ -140,6 +140,9 @@ class ParallelConfig:
     :param model: tensor-parallel axis (Megatron TP analogue).
     :param sequence: context/sequence-parallel axis for ring attention over
         long sequences (beyond the reference, which has only Megatron SP).
+    :param expert: expert-parallel axis for mixture-of-experts models
+        (mixtral family): expert weights shard here and token dispatch rides
+        all_to_alls over this axis (beyond the reference, which has no MoE).
     :param pipe_microbatches: microbatches per pipeline round (GPipe schedule
         fill; the reference's NeMo micro-vs-global batch split,
         ``megatron_20b.yaml:51-52``). 0 = auto (one per stage, capped at the
@@ -161,6 +164,7 @@ class ParallelConfig:
     pipe: int = 1
     model: int = 1
     sequence: int = 1
+    expert: int = 1
     pipe_microbatches: int = 0
 
     param_dtype: str = "float32"

@@ -113,6 +113,48 @@ def convert_llama(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict[str
     return {"backbone": backbone}
 
 
+def convert_mixtral(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict[str, Any]:
+    """Mixtral (llama-style attention + sparse MoE MLP): per-expert
+    ``w1``/``w3``/``w2`` Linears stack into the ``[E, ...]`` expert kernels
+    and the router ``gate`` Linear becomes the fp32 router Dense.
+    ``sliding_window`` is ignored — full causal attention (exact for
+    sequences up to the window; the released Mixtral checkpoints ship with
+    ``sliding_window: null``)."""
+    p = "model."
+    backbone: Dict[str, Any] = {
+        "wte": {"embedding": sd[p + "embed_tokens.weight"]},
+        "ln_f": {"scale": sd[p + "norm.weight"]},
+        "lm_head": {"kernel": _t(sd["lm_head.weight"])},
+    }
+    E = cfg.num_experts
+    for i in range(cfg.num_layers):
+        lp = f"{p}layers.{i}."
+        ep = lp + "block_sparse_moe."
+        backbone[f"h_{i}"] = {
+            "ln_attn": {"scale": sd[lp + "input_layernorm.weight"]},
+            "ln_mlp": {"scale": sd[lp + "post_attention_layernorm.weight"]},
+            "attn": {
+                "q_proj": _proj(_t(sd[lp + "self_attn.q_proj.weight"])),
+                "k_proj": _proj(_t(sd[lp + "self_attn.k_proj.weight"])),
+                "v_proj": _proj(_t(sd[lp + "self_attn.v_proj.weight"])),
+                "o_proj": _proj(_t(sd[lp + "self_attn.o_proj.weight"])),
+            },
+            "mlp": {
+                "router": {"kernel": _t(sd[ep + "gate.weight"])},
+                "w_gate": np.stack(
+                    [_t(sd[f"{ep}experts.{e}.w1.weight"]) for e in range(E)]
+                ),
+                "w_up": np.stack(
+                    [_t(sd[f"{ep}experts.{e}.w3.weight"]) for e in range(E)]
+                ),
+                "w_down": np.stack(
+                    [_t(sd[f"{ep}experts.{e}.w2.weight"]) for e in range(E)]
+                ),
+            },
+        }
+    return {"backbone": backbone}
+
+
 def convert_gptneox(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict[str, Any]:
     p = "gpt_neox."
     D = cfg.dims_per_head
@@ -239,6 +281,7 @@ CONVERTERS: Dict[str, Callable] = {
     "gptj": convert_gptj,
     "opt": convert_opt,
     "bloom": convert_bloom,
+    "mixtral": convert_mixtral,
 }
 
 
@@ -276,6 +319,45 @@ def config_from_hf(hf_config) -> TransformerConfig:
             attn_bias=False,
             mlp_bias=False,
             tie_word_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        )
+    if mt == "mixtral":
+        if getattr(hf_config, "sliding_window", None):
+            from trlx_tpu.utils.logging import get_logger
+
+            get_logger(__name__).warning(
+                "Mixtral checkpoint declares sliding_window=%s; this backbone "
+                "uses full causal attention — logits are exact only for "
+                "sequences up to the window (the released Mixtral checkpoints "
+                "ship sliding_window: null)",
+                hf_config.sliding_window,
+            )
+        return TransformerConfig(
+            model_type=mt,
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+            intermediate_size=hf_config.intermediate_size,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            position_scheme="rotary",
+            rope_theta=getattr(hf_config, "rope_theta", 1e6),
+            norm="rmsnorm",
+            layer_norm_epsilon=hf_config.rms_norm_eps,
+            activation="silu",
+            attn_bias=False,
+            mlp_bias=False,
+            tie_word_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+            num_experts=hf_config.num_local_experts,
+            num_experts_per_tok=hf_config.num_experts_per_tok,
+            router_aux_coef=getattr(hf_config, "router_aux_loss_coef", 0.01),
+            moe_group_size=512,
+            # HF Mixtral routes with no capacity bound (dense gather); a
+            # capacity factor of E makes the einsum dispatch drop-free by
+            # construction (even if every token picked the same expert), so
+            # imported checkpoints reproduce HF logits exactly. Lower it for
+            # training throughput at the cost of overflow-token drops.
+            moe_capacity_factor=float(hf_config.num_local_experts),
         )
     if mt == "gpt_neox":
         head_dim = hf_config.hidden_size // hf_config.num_attention_heads
@@ -683,6 +765,41 @@ def export_bloom(backbone: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
     return sd
 
 
+def export_mixtral(backbone: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`convert_mixtral`: expert kernels unstack into the
+    per-expert ``w1``/``w3``/``w2`` Linears of MixtralForCausalLM."""
+    p = "model."
+    sd: Dict[str, np.ndarray] = {
+        p + "embed_tokens.weight": np.asarray(backbone["wte"]["embedding"]),
+        p + "norm.weight": np.asarray(backbone["ln_f"]["scale"]),
+        "lm_head.weight": (
+            np.asarray(backbone["wte"]["embedding"])
+            if cfg.tie_word_embeddings
+            else _t(np.asarray(backbone["lm_head"]["kernel"]))
+        ),
+    }
+    for i in range(cfg.num_layers):
+        lp = f"{p}layers.{i}."
+        ep = lp + "block_sparse_moe."
+        h = backbone[f"h_{i}"]
+        sd[lp + "input_layernorm.weight"] = np.asarray(h["ln_attn"]["scale"])
+        sd[lp + "post_attention_layernorm.weight"] = np.asarray(h["ln_mlp"]["scale"])
+        for ours, theirs in (
+            ("q_proj", "self_attn.q_proj"),
+            ("k_proj", "self_attn.k_proj"),
+            ("v_proj", "self_attn.v_proj"),
+            ("o_proj", "self_attn.o_proj"),
+        ):
+            _put_linear(sd, lp + theirs, h["attn"][ours])
+        mlp = h["mlp"]
+        sd[ep + "gate.weight"] = _t(np.asarray(mlp["router"]["kernel"]))
+        for e in range(cfg.num_experts):
+            sd[f"{ep}experts.{e}.w1.weight"] = _t(np.asarray(mlp["w_gate"][e]))
+            sd[f"{ep}experts.{e}.w3.weight"] = _t(np.asarray(mlp["w_up"][e]))
+            sd[f"{ep}experts.{e}.w2.weight"] = _t(np.asarray(mlp["w_down"][e]))
+    return sd
+
+
 def export_t5(backbone: Dict[str, Any], cfg) -> Dict[str, np.ndarray]:
     """Inverse of :func:`convert_t5`: T5Transformer param tree → HF
     T5ForConditionalGeneration state dict (the seq2seq leg of the
@@ -750,6 +867,7 @@ EXPORTERS: Dict[str, Callable] = {
     "opt": export_opt,
     "bloom": export_bloom,
     "t5": export_t5,
+    "mixtral": export_mixtral,
 }
 
 
@@ -821,6 +939,23 @@ def hf_config_from_transformer(cfg):
             max_position_embeddings=cfg.max_position_embeddings,
             rms_norm_eps=cfg.layer_norm_epsilon,
             rope_theta=cfg.rope_theta,
+            tie_word_embeddings=cfg.tie_word_embeddings,
+        )
+    if mt == "mixtral":
+        return tf.MixtralConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_heads,
+            num_key_value_heads=cfg.kv_heads,
+            intermediate_size=cfg.intermediate_size,
+            max_position_embeddings=cfg.max_position_embeddings,
+            rms_norm_eps=cfg.layer_norm_epsilon,
+            rope_theta=cfg.rope_theta,
+            num_local_experts=cfg.num_experts,
+            num_experts_per_tok=cfg.num_experts_per_tok,
+            router_aux_loss_coef=cfg.router_aux_coef,
+            sliding_window=None,
             tie_word_embeddings=cfg.tie_word_embeddings,
         )
     if mt == "gpt_neox":

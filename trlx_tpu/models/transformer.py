@@ -60,23 +60,33 @@ def _maybe_pipeline_mesh(cfg: "TransformerConfig"):
     return mesh
 
 
-def _maybe_ring_mesh(T: int):
-    """The global mesh, iff its ``sequence`` axis should carry this pass
-    (full self-attention forwards, ALiBi included; ring doesn't apply to
-    cache decode — plain flash handles that, with GSPMD gathering K/V if
-    activations are sequence-sharded)."""
+def _traced_global_mesh():
+    """The global mesh, iff one is set AND we are inside a trace (sharding
+    constraints / collective layouts only apply under jit; eager passes —
+    e.g. ``module.init`` — take the plain paths)."""
     from trlx_tpu.parallel.mesh import get_global_mesh
 
     try:
         from jax._src.core import trace_state_clean
     except ImportError:  # pragma: no cover - private API moved
+
         def trace_state_clean():
             return False
 
     mesh = get_global_mesh()
+    if mesh is not None and not trace_state_clean():
+        return mesh
+    return None
+
+
+def _maybe_ring_mesh(T: int):
+    """The traced mesh, iff its ``sequence`` axis should carry this pass
+    (full self-attention forwards, ALiBi included; ring doesn't apply to
+    cache decode — plain flash handles that, with GSPMD gathering K/V if
+    activations are sequence-sharded)."""
+    mesh = _traced_global_mesh()
     if (
         mesh is not None
-        and not trace_state_clean()  # eager (e.g. module.init): plain flash
         and mesh.shape.get("sequence", 1) > 1
         and T % mesh.shape["sequence"] == 0
     ):
@@ -142,6 +152,20 @@ class TransformerConfig:
     # pipe axis > 1 (0 = auto: one per stage). See parallel/pipeline.py.
     pipe_microbatches: int = 0
 
+    # mixture-of-experts MLP (mixtral family; beyond the reference, which has
+    # no MoE — SURVEY.md §2.3 lists EP as n/a). 0 = dense MLP. Experts are
+    # GShard-style einsum dispatch with a per-sequence token group and a
+    # static capacity; expert weights shard over the mesh's `expert` axis
+    # (parallel/mesh.py) so XLA inserts the token all_to_alls.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_capacity_factor: float = 1.25  # slots per expert = ceil(k*G*cf/E)
+    moe_group_size: int = 0  # dispatch group tokens (0 = whole sequence);
+    # bounds the [.., E, C] slot tensors to O(T·G) instead of O(T²)
+    moe_renormalize: bool = True  # mixtral renormalizes the top-k gate probs
+    router_aux_coef: float = 0.01  # load-balance loss weight (Switch-style)
+    router_z_coef: float = 0.0  # router logit z-loss weight (ST-MoE)
+
     def resolved_attention_impl(self) -> str:
         if self.attention_impl == "auto":
             return "pallas" if jax.default_backend() == "tpu" else "xla"
@@ -195,6 +219,27 @@ class TransformerConfig:
             attn_bias=False,
             mlp_bias=False,
             tie_word_embeddings=False,
+        )
+
+    @staticmethod
+    def mixtral(size: str = "8x7b", **overrides) -> "TransformerConfig":
+        dims = {
+            "test": dict(vocab_size=259, hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2, intermediate_size=96, max_position_embeddings=128, num_experts=4),
+            "8x7b": dict(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=8, intermediate_size=14336, max_position_embeddings=32768, num_experts=8, moe_group_size=512),
+        }[size]
+        return _make_preset(
+            dims,
+            overrides,
+            model_type="mixtral",
+            position_scheme="rotary",
+            rope_theta=1e6,
+            norm="rmsnorm",
+            layer_norm_epsilon=1e-5,
+            activation="silu",
+            attn_bias=False,
+            mlp_bias=False,
+            tie_word_embeddings=False,
+            num_experts_per_tok=2,
         )
 
     @staticmethod
@@ -526,22 +571,213 @@ class MLP(nn.Module):
         return _dense(cfg, cfg.hidden_size, cfg.mlp_bias, ("ffn", "embed"), "down_proj")(h)
 
 
+def _maybe_expert_mesh():
+    """The traced mesh, iff its ``expert`` axis actually partitions experts
+    (size > 1)."""
+    mesh = _traced_global_mesh()
+    if mesh is not None and mesh.shape.get("expert", 1) > 1:
+        return mesh
+    return None
+
+
+class MoEMLP(nn.Module):
+    """Mixture-of-experts MLP: top-k router + GShard-style einsum dispatch.
+
+    TPU-first design (the reference has no MoE at all — SURVEY.md §2.3 lists
+    EP as n/a; this is a beyond-parity capability for the mixtral family):
+
+    - each sequence is a dispatch group: tokens route to their top-k experts
+      with a *static* per-group capacity ``C = ceil(k·T·cf/E)`` (first
+      choices claim slots before second choices; overflow tokens fall back to
+      the residual path). Static shapes keep the whole thing one XLA program
+      — no sorting, no dynamic gather.
+    - expert weights carry a leading ``E`` dim sharded over the mesh's
+      ``expert`` axis; the dispatch/combine einsums change token layout from
+      batch-sharded to expert-sharded and back, which GSPMD lowers to
+      all_to_all over the ``expert`` axis (the EP analogue of Megatron TP's
+      allreduce). Per-expert matmul dims still shard over ``fsdp``/``model``.
+    - the router runs in fp32; returns ``(y, aux)`` where ``aux`` is
+      ``[load_balance, router_z]`` — the Switch-style balance loss
+      (≡ 1.0 at a perfectly uniform router) and the ST-MoE z-loss.
+
+    At decode (T = 1) the capacity is ``max(1, ceil(k·cf/E)) ≥ 1`` and top-k
+    indices are distinct, so decode never drops tokens.
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, token_mask: Optional[jax.Array] = None
+    ) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.config
+        E, K = cfg.num_experts, cfg.num_experts_per_tok
+        B, T, d = x.shape
+        f = cfg.intermediate_size
+        act = get_activation(cfg.activation)
+        gated = cfg.activation == "silu"
+
+        logits = nn.Dense(
+            E,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            kernel_init=param_with_axes(nn.initializers.normal(0.02), ("embed", "expert_sel")),
+            name="router",
+        )(x.astype(jnp.float32))  # [B, T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, idx = jax.lax.top_k(probs, K)  # [B, T, K]
+        if cfg.moe_renormalize:
+            gate_vals = gate_vals / jnp.maximum(
+                jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+            )
+
+        # dispatch groups: capacity (and the [.., E, C] dispatch tensors)
+        # scale with the group size G, not with T — whole-sequence groups
+        # would make the slot tensors O(T²) per row at long context. G is
+        # the largest divisor of T ≤ moe_group_size (static).
+        G = T
+        if cfg.moe_group_size > 0:
+            G = min(cfg.moe_group_size, T)
+            while T % G:
+                G -= 1
+        N = B * (T // G)
+        xg = x.reshape(N, G, d)
+        w = (
+            jnp.ones((N, G), jnp.float32)
+            if token_mask is None
+            else token_mask.reshape(N, G).astype(jnp.float32)
+        )
+
+        C = max(1, int(np.ceil(K * G * cfg.moe_capacity_factor / E)))
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32).reshape(N, G, K, E)
+        # padding tokens route nowhere: they claim no capacity slots and
+        # leave the layer with zero output (the Block residual carries them)
+        onehot = onehot * w[..., None, None].astype(jnp.int32)
+        # slot assignment with choice-priority: every token's first choice
+        # outranks any second choice (GShard top-2 semantics)
+        perm = onehot.transpose(0, 2, 1, 3).reshape(N, K * G, E)
+        pos = jnp.cumsum(perm, axis=1) - perm  # slots taken before this entry
+        kept = perm * (pos < C)
+        slot = jax.nn.one_hot(pos, C, dtype=jnp.float32) * kept[..., None]
+        gates_perm = (
+            gate_vals.reshape(N, G, K).transpose(0, 2, 1).reshape(N, K * G)
+        )
+        combine = (
+            (slot * gates_perm[..., None, None]).reshape(N, K, G, E, C).sum(1)
+        )  # [N, G, E, C] fp32
+        dispatch = slot.reshape(N, K, G, E, C).sum(1)
+
+        mesh = _maybe_expert_mesh()
+
+        def expert_sharded(a):
+            if mesh is None:
+                return a
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec = ("expert", ("data", "fsdp")) + (None,) * (a.ndim - 2)
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P(*spec))
+            )
+
+        xin = jnp.einsum("ngd,ngec->encd", xg, dispatch.astype(x.dtype))
+        xin = expert_sharded(xin)  # ← GSPMD inserts the dispatch all_to_all
+        if gated:
+            w_gate = self.param(
+                "w_gate",
+                param_with_axes(nn.initializers.normal(0.02), ("expert", "embed", "ffn")),
+                (E, d, f),
+                cfg.param_dtype,
+            )
+            w_up = self.param(
+                "w_up",
+                param_with_axes(nn.initializers.normal(0.02), ("expert", "embed", "ffn")),
+                (E, d, f),
+                cfg.param_dtype,
+            )
+            h = act(jnp.einsum("encd,edf->encf", xin, w_gate.astype(cfg.dtype)))
+            h = h * jnp.einsum("encd,edf->encf", xin, w_up.astype(cfg.dtype))
+        else:
+            w_up = self.param(
+                "w_up",
+                param_with_axes(nn.initializers.normal(0.02), ("expert", "embed", "ffn")),
+                (E, d, f),
+                cfg.param_dtype,
+            )
+            h = act(jnp.einsum("encd,edf->encf", xin, w_up.astype(cfg.dtype)))
+        w_down = self.param(
+            "w_down",
+            param_with_axes(nn.initializers.normal(0.02), ("expert", "ffn", "embed")),
+            (E, f, d),
+            cfg.param_dtype,
+        )
+        out = jnp.einsum("encf,efd->encd", h, w_down.astype(cfg.dtype))
+        out = expert_sharded(out)
+        y = jnp.einsum("encd,ngec->ngd", out, combine.astype(out.dtype))
+        y = y.reshape(B, T, d)
+
+        # Switch load-balance loss over pre-capacity assignments: E·Σ f_e·p_e
+        # (1.0 when both routing fractions and router probs are uniform).
+        # Means are over REAL tokens only — padding must not train the router.
+        # Returned as token-weighted sufficient statistics [lb·w, Σw·lse², w]
+        # so accumulation over layers / microbatches / pipeline stages stays
+        # correctly weighted under uneven padding; ``router_aux_summary``
+        # normalizes to [lb, z] at the forward's end.
+        n_real = jnp.sum(w)
+        denom = jnp.maximum(n_real, 1.0)
+        wf = w.reshape(B, T)
+        me = jnp.sum(probs * wf[..., None], axis=(0, 1)) / denom
+        ce = jnp.sum(onehot.astype(jnp.float32), axis=(0, 1, 2)) / (denom * K)
+        aux_lb = E * jnp.sum(me * ce)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [B, T]
+        z_sum = jnp.sum((lse**2) * wf)
+        return y.astype(cfg.dtype), jnp.stack([aux_lb * n_real, z_sum, n_real])
+
+
+_ZERO_AUX = (3,)  # Block aux statistics: [lb·tokens, Σ tokens·lse², tokens]
+
+
+def router_aux_summary(aux: jax.Array) -> jax.Array:
+    """Accumulated per-layer aux statistics → ``[load_balance, router_z]``
+    (token-weighted means; exact for the z-loss under any layer/microbatch/
+    pipeline-stage accumulation, token-weighted for the balance loss — which
+    is a product of per-group means and therefore has per-group semantics,
+    like every microbatched MoE implementation)."""
+    return aux[:2] / jnp.maximum(aux[2], 1.0)
+
+
+def _token_validity(slot_mask: jax.Array, q_offset, T: int) -> jax.Array:
+    """[B, T] validity of the query tokens occupying cache slots
+    ``[q_offset, q_offset + T)`` of a [B, S] slot mask."""
+    B = slot_mask.shape[0]
+    qs = jnp.broadcast_to(q_offset + jnp.arange(T)[None, :], (B, T))
+    return jax.vmap(lambda m, q: m[q])(slot_mask, qs)
+
+
 class Block(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, attention_bias, positions, cache=None, cache_index=None, flash_args=None):
+    def __call__(self, x, attention_bias, positions, cache=None, cache_index=None, flash_args=None, token_mask=None):
         cfg = self.config
+
+        def run_mlp(h):
+            if cfg.num_experts > 0:
+                return MoEMLP(cfg, name="mlp")(h, token_mask)
+            return MLP(cfg, name="mlp")(h), jnp.zeros(_ZERO_AUX, jnp.float32)
+
         h = Norm(cfg, name="ln_attn")(x)
         attn_out, new_cache = Attention(cfg, name="attn")(h, attention_bias, positions, cache, cache_index, flash_args)
         if cfg.parallel_residual:
             mlp_in = h if cfg.shared_ln else Norm(cfg, name="ln_mlp")(x)
-            x = x + attn_out + MLP(cfg, name="mlp")(mlp_in)
+            mlp_out, aux = run_mlp(mlp_in)
+            x = x + attn_out + mlp_out
         else:
             x = x + attn_out
             h = Norm(cfg, name="ln_mlp")(x)
-            x = x + MLP(cfg, name="mlp")(h)
-        return x, new_cache
+            mlp_out, aux = run_mlp(h)
+            x = x + mlp_out
+        return x, new_cache, aux
 
 
 def _remat_policy(cfg: TransformerConfig):
@@ -576,14 +812,14 @@ class _ScanBlockBody(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, carry, cache_layer, layer_idx, attention_bias, positions, cache_index, flash_args, branch_at):
-        x, branch_input = carry
-        x_new, new_cache = _block_cls(self.config)(self.config, name="block")(
-            x, attention_bias, positions, cache_layer, cache_index, flash_args
+    def __call__(self, carry, cache_layer, layer_idx, attention_bias, positions, cache_index, flash_args, branch_at, token_mask):
+        x, branch_input, aux_sum = carry
+        x_new, new_cache, aux = _block_cls(self.config)(self.config, name="block")(
+            x, attention_bias, positions, cache_layer, cache_index, flash_args, token_mask
         )
         if branch_input is not None:  # static: only hydra passes pay for it
             branch_input = jnp.where(layer_idx == branch_at, x, branch_input)
-        return (x_new, branch_input), new_cache
+        return (x_new, branch_input, aux_sum + aux), new_cache
 
 
 class CausalTransformer(nn.Module):
@@ -630,7 +866,7 @@ class CausalTransformer(nn.Module):
                 _ScanBlockBody,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
-                in_axes=(0, 0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+                in_axes=(0, 0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
                 out_axes=0,
                 length=cfg.num_layers,
             )
@@ -738,15 +974,25 @@ class CausalTransformer(nn.Module):
                 key_pos = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
                 positions = jax.vmap(lambda kp, qs: kp[qs])(key_pos, query_slots)
 
+        token_mask = None
+        if cfg.num_experts > 0:
+            # MoE routing must know which query tokens are real: padding
+            # claims no expert capacity and trains no router statistics
+            if cache is None:
+                token_mask = attention_mask
+            else:
+                offset = cache_index if cache_index is not None else 0
+                token_mask = _token_validity(attention_mask, offset, T)
+
         x = self._embed(input_ids, positions)
         use_flash = cfg.resolved_attention_impl() == "pallas" and T > 1
         pipe_mesh = None if self.is_initializing() else _maybe_pipeline_mesh(cfg)
         if pipe_mesh is not None:
-            x, branch_input, new_cache = self._pipelined_blocks(
+            x, branch_input, new_cache, aux = self._pipelined_blocks(
                 pipe_mesh, x, attention_mask, positions, use_flash,
                 cache, cache_index, branch_layer,
             )
-            return self._epilogue(x, branch_input, new_cache, logits_span)
+            return self._epilogue(x, branch_input, new_cache, logits_span, aux)
         bias, flash_args = self._attn_inputs(
             attention_mask,
             positions,
@@ -755,11 +1001,12 @@ class CausalTransformer(nn.Module):
         )
 
         branch_input = None
+        aux = jnp.zeros(_ZERO_AUX, jnp.float32)
         if cfg.scan_layers:
             branch_at = cfg.num_layers - branch_layer if branch_layer is not None else -1
             branch_buf0 = jnp.zeros_like(x) if branch_layer is not None else None
-            (x, branch_buf), new_cache = self.scan_blocks(
-                (x, branch_buf0),
+            (x, branch_buf, aux), new_cache = self.scan_blocks(
+                (x, branch_buf0, aux),
                 cache,  # stacked {"k": [L,B,S,KV,D], "v": ...} or None
                 jnp.arange(cfg.num_layers),
                 bias,
@@ -767,6 +1014,7 @@ class CausalTransformer(nn.Module):
                 cache_index,
                 flash_args,
                 jnp.asarray(branch_at),
+                token_mask,
             )
             if branch_layer is not None:
                 branch_input = branch_buf
@@ -776,23 +1024,30 @@ class CausalTransformer(nn.Module):
                 if branch_layer is not None and i == len(self.blocks) - branch_layer:
                     branch_input = x
                 layer_cache = cache[i] if cache is not None else None
-                x, updated = block(x, bias, positions, layer_cache, cache_index, flash_args)
+                x, updated, aux_i = block(x, bias, positions, layer_cache, cache_index, flash_args, token_mask)
+                aux = aux + aux_i
                 if cache is not None:
                     new_cache.append(updated)
 
-        return self._epilogue(x, branch_input, new_cache, logits_span)
+        return self._epilogue(x, branch_input, new_cache, logits_span, aux)
 
-    def _epilogue(self, x, branch_input, new_cache, logits_span):
+    def _epilogue(self, x, branch_input, new_cache, logits_span, aux=None):
         """Shared forward tail: final norm + (span-restricted) lm head."""
-        h = self.ln_f(x) if self.config.final_norm else x
+        cfg = self.config
+        h = self.ln_f(x) if cfg.final_norm else x
         logits = self._logits(h if logits_span is None else h[:, logits_span[0] : logits_span[1]])
-        return {
+        out = {
             "logits": logits,
             "hidden_states": h,
             "pre_norm_hidden": x,
             "branch_input": branch_input,
             "cache": new_cache,
         }
+        if cfg.num_experts > 0 and aux is not None:
+            # token-weighted [load_balance, router_z] over all layers —
+            # trainers add router_aux_coef/router_z_coef · these to the loss
+            out["router_aux_loss"] = router_aux_summary(aux)
+        return out
 
     def _pipelined_blocks(
         self, mesh, x, attention_mask, positions, use_flash, cache, cache_index, branch_layer
@@ -815,12 +1070,19 @@ class CausalTransformer(nn.Module):
         q_offset = cache_index if in_decode else 0
 
         def make_attn_inputs(mask_mb, pos_mb):
-            return self._attn_inputs(mask_mb, pos_mb, q_offset, use_flash) + (pos_mb,)
+            tm = None
+            if cfg.num_experts > 0:
+                tm = (
+                    _token_validity(mask_mb, q_offset, pos_mb.shape[1])
+                    if in_decode
+                    else mask_mb
+                )
+            return self._attn_inputs(mask_mb, pos_mb, q_offset, use_flash) + (pos_mb, tm)
 
-        def apply_block(layer_params, h, aux, cache_layer, cidx):
-            bias_mb, flash_mb, pos_mb = aux
+        def apply_block(layer_params, h, attn_inputs, cache_layer, cidx):
+            bias_mb, flash_mb, pos_mb, tm = attn_inputs
             return body_block.apply(
-                {"params": layer_params}, h, bias_mb, pos_mb, cache_layer, cidx, flash_mb
+                {"params": layer_params}, h, bias_mb, pos_mb, cache_layer, cidx, flash_mb, tm
             )
 
         if cfg.remat in ("full", "minimal"):
@@ -839,6 +1101,7 @@ class CausalTransformer(nn.Module):
             cache_index=cache_index,
             branch_at=branch_at,
             mesh=mesh,
+            aux_init=jnp.zeros(_ZERO_AUX, jnp.float32),
         )
 
     def forward_branch(
@@ -880,8 +1143,9 @@ class CausalTransformer(nn.Module):
             body_block = Block(cfg, parent=None)
 
             def body(h, layer_params):
-                out, _ = body_block.apply(
-                    {"params": layer_params}, h, bias, positions, flash_args=flash_args
+                out, _, _ = body_block.apply(
+                    {"params": layer_params}, h, bias, positions,
+                    flash_args=flash_args, token_mask=attention_mask,
                 )
                 return out, None
 
@@ -890,7 +1154,7 @@ class CausalTransformer(nn.Module):
             x, _ = jax.lax.scan(body, x, sliced)
         else:
             for block in self.blocks[len(self.blocks) - branch_layer :]:
-                x, _ = block(x, bias, positions, flash_args=flash_args)
+                x, _, _ = block(x, bias, positions, flash_args=flash_args, token_mask=attention_mask)
         h = self.ln_f(x) if cfg.final_norm else x
         logits = self._logits(h if logits_span is None else h[:, logits_span[0] : logits_span[1]])
         return {"logits": logits, "hidden_states": h}
@@ -951,6 +1215,7 @@ def unstack_layer_params(backbone: Dict[str, Any], prefix: str = "h_") -> Dict[s
 BUILTIN_SPECS = {
     "gpt2": TransformerConfig.gpt2,
     "llama": TransformerConfig.llama,
+    "mixtral": TransformerConfig.mixtral,
     "gptj": TransformerConfig.gptj,
     "gptneox": TransformerConfig.gptneox,
     "pythia": TransformerConfig.gptneox,

@@ -102,7 +102,10 @@ def sample_token_from_logits(
     return next_token, logprob
 
 
-_NON_CARRY_KEYS = ("cache", "logits", "branch_input", "pre_norm_hidden", "encoder_hidden")
+_NON_CARRY_KEYS = (
+    "cache", "logits", "branch_input", "pre_norm_hidden", "encoder_hidden",
+    "router_aux_loss",  # scalar vector, not [B, ...] — and unused in decode
+)
 
 
 def last_step_info(out: Dict[str, Any]) -> Dict[str, Any]:

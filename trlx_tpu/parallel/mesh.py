@@ -16,6 +16,10 @@ Axes:
   ``configs/nemo_configs/megatron_20b.yaml:53``).
 - ``sequence`` — context parallelism for long sequences (ring attention);
   beyond the reference, which only has Megatron SP inside TP.
+- ``expert``   — expert parallelism for mixture-of-experts MLPs (mixtral
+  family): expert weights shard their leading expert dim here and GSPMD
+  lowers the dispatch/combine einsums to all_to_alls over this axis. Beyond
+  the reference (SURVEY.md §2.3: EP absent).
 
 The mesh is the single source of truth for every compiled program: train
 steps, rollout decode, and eval all run under the same mesh so arrays never
@@ -31,7 +35,7 @@ from jax.sharding import Mesh
 
 from trlx_tpu.data.configs import ParallelConfig
 
-MESH_AXES = ("data", "pipe", "fsdp", "model", "sequence")
+MESH_AXES = ("data", "pipe", "fsdp", "model", "sequence", "expert")
 
 # The process-wide mesh, set by trainers at construction. Model code reads it
 # (``get_global_mesh``) to decide whether sequence-parallel ops (ring
@@ -51,8 +55,8 @@ def get_global_mesh() -> Optional[Mesh]:
 
 def mesh_shape_from_config(
     parallel: ParallelConfig, device_count: Optional[int] = None
-) -> Tuple[int, int, int, int, int]:
-    """Resolve the 5-axis mesh shape; a single ``-1`` axis is inferred."""
+) -> Tuple[int, int, int, int, int, int]:
+    """Resolve the 6-axis mesh shape; a single ``-1`` axis is inferred."""
     n = device_count if device_count is not None else jax.device_count()
     sizes = [
         parallel.data,
@@ -60,6 +64,7 @@ def mesh_shape_from_config(
         parallel.fsdp,
         parallel.model,
         parallel.sequence,
+        parallel.expert,
     ]
     if sizes.count(-1) > 1:
         raise ValueError(f"At most one mesh axis may be -1, got {sizes}")

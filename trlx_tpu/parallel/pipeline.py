@@ -86,18 +86,25 @@ def pipeline_blocks(
     num_stages: int,
     num_microbatches: int,
     make_attn_inputs: Callable[[jax.Array, jax.Array], Any],
-    # (layer_params, h, aux, cache_layer, cache_index) -> (h, new_cache_layer)
-    apply_block: Callable[..., Tuple[jax.Array, Any]],
+    # (layer_params, h, attn_inputs, cache_layer, cache_index)
+    #   -> (h, new_cache_layer, aux_stats)
+    apply_block: Callable[..., Tuple[jax.Array, Any, jax.Array]],
     cache: Any = None,  # pytree, leaves [L, B, ...] (stacked KV cache) or None
     cache_index: Any = None,
     branch_at: int = -1,  # global layer idx whose INPUT feeds the hydra branch
     mesh: Optional[Mesh] = None,
-) -> Tuple[jax.Array, Optional[jax.Array], Any]:
+    aux_init: Optional[jax.Array] = None,  # zero aux vector (defines its width)
+) -> Tuple[jax.Array, Optional[jax.Array], Any, jax.Array]:
     """Run the stacked block params over ``x`` through the pipeline schedule.
 
-    Returns ``(hidden, branch_input, new_cache)`` with the same shapes/layout
-    the unpipelined ``nn.scan`` path produces — callers cannot tell the two
-    executions apart (tested for exact logits parity).
+    Returns ``(hidden, branch_input, new_cache, aux)`` — hidden/branch/cache
+    with the same shapes/layout the unpipelined ``nn.scan`` path produces
+    (tested for exact logits parity). ``aux`` is the raw SUM of each block's
+    aux-statistics vector over every valid (layer, microbatch) pair: blocks
+    return token-weighted sufficient statistics (see
+    ``models/transformer.py::router_aux_summary``), so the caller's final
+    normalization stays correctly weighted even when microbatches carry
+    different amounts of padding.
     """
     L = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     S, M = num_stages, num_microbatches
@@ -108,6 +115,8 @@ def pipeline_blocks(
         raise ValueError(f"batch {B} not divisible by pipe microbatches {M}")
     lps, mb = L // S, B // M
     track_branch = branch_at >= 0
+    if aux_init is None:
+        aux_init = jnp.zeros(3, jnp.float32)
 
     # [L, ...] -> [S, lps, ...]: L is sharded over `pipe` with exactly lps
     # contiguous rows per shard, so this reshape is local to each device.
@@ -148,18 +157,20 @@ def pipeline_blocks(
             )
 
         def layer_body(carry, inp):
-            h, branch_buf = carry
+            h, branch_buf, aux_sum = carry
             layer_params, cache_layer, local_idx = inp
             if track_branch:
                 branch_buf = jnp.where(
                     stage_idx * lps + local_idx == branch_at, h, branch_buf
                 )
-            h, new_cache_layer = apply_block(layer_params, h, aux, cache_layer, cache_index)
-            return (h, branch_buf), new_cache_layer
+            h, new_cache_layer, block_aux = apply_block(
+                layer_params, h, aux, cache_layer, cache_index
+            )
+            return (h, branch_buf, aux_sum + block_aux), new_cache_layer
 
-        (h, branch_buf), new_cache_m = jax.lax.scan(
+        (h, branch_buf, aux_sum), new_cache_m = jax.lax.scan(
             layer_body,
-            (h, branch_buf),
+            (h, branch_buf, aux_init),
             (stage_params, cache_m, jnp.arange(lps)),
         )
         new_stage_cache = None
@@ -173,7 +184,7 @@ def pipeline_blocks(
             new_stage_cache = jax.tree_util.tree_map(
                 lambda u, c: jnp.where(valid, u, c), updated, stage_cache
             )
-        return h, branch_buf, new_stage_cache
+        return h, branch_buf, new_stage_cache, aux_sum
 
     stages = jax.vmap(stage_fn, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0))
     stage_iota = jnp.arange(S)
@@ -192,11 +203,13 @@ def pipeline_blocks(
         m = t - stage_iota
         valid = (m >= 0) & (m < M)
         m_idx = jnp.clip(m, 0, M - 1)
-        h, br, cache_new = stages(
+        h, br, cache_new, aux_s = stages(
             params_s, h, mk, ps, br, carry.cache, m_idx, stage_iota, valid
         )
         h = constrain(h, "pipe", ("data", "fsdp"))
-        out = (h[-1], br[-1] if track_branch else jnp.zeros((0,), x.dtype))
+        # filler ticks (invalid stage/microbatch pairs) must not contribute
+        aux_t = jnp.sum(jnp.where(valid[:, None], aux_s, 0.0), axis=0)
+        out = (h[-1], br[-1] if track_branch else jnp.zeros((0,), x.dtype), aux_t)
         return _TickCarry(h, mk, ps, br, cache_new), out
 
     zeros_buf = jnp.zeros((S, mb) + x.shape[1:], x.dtype)
@@ -208,7 +221,7 @@ def pipeline_blocks(
         branch=zeros_buf if track_branch else None,
         cache=cache_s,
     )
-    final, (ys, brs) = jax.lax.scan(
+    final, (ys, brs, auxs) = jax.lax.scan(
         tick, init, (xs, masks, poss, jnp.arange(tk))
     )
 
@@ -222,4 +235,6 @@ def pipeline_blocks(
         new_cache = jax.tree_util.tree_map(
             lambda c, orig: c.reshape(orig.shape), final.cache, cache
         )
-    return hidden, branch_input, new_cache
+    # each valid (layer, microbatch) pair contributed its weighted statistics
+    # exactly once; normalization happens in the caller (router_aux_summary)
+    return hidden, branch_input, new_cache, jnp.sum(auxs, axis=0)

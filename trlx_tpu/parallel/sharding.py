@@ -1,7 +1,8 @@
 """Path-based parameter sharding rules (t5x/maxtext style).
 
 One ordered rule table maps every parameter path in the model tree to a
-``PartitionSpec`` over the ``(data, pipe, fsdp, model, sequence)`` mesh:
+``PartitionSpec`` over the ``(data, pipe, fsdp, model, sequence, expert)``
+mesh:
 
 - the **model** axis carries Megatron-style tensor parallelism — qkv/mlp-up
   kernels shard their *output* features, o/mlp-down kernels their *input*
@@ -33,6 +34,12 @@ _RULES: Tuple[Tuple[str, P], ...] = (
     # row-parallel (input features on `model`); bias replicated
     (r".*/(o_proj|down_proj)/kernel$", P("model", "fsdp")),
     (r".*/(o_proj|down_proj)/bias$", P(None)),
+    # mixture-of-experts MLP: expert dim over `expert` (EP), per-expert
+    # matmul dims over fsdp/model exactly like the dense column/row split;
+    # the router is tiny and replicates
+    (r".*/mlp/w_(gate|up)$", P("expert", "fsdp", "model")),
+    (r".*/mlp/w_down$", P("expert", "model", "fsdp")),
+    (r".*/mlp/router/kernel$", P(None)),
     # vocab-parallel embedding (Megatron-style: vocab over model×fsdp, embed
     # replicated — lookups then yield cleanly batch-sharded activations; an
     # embed-dim-sharded table instead forces a GSPMD involuntary

@@ -235,6 +235,36 @@ class TPUBaseTrainer(BaseRLTrainer):
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
         ...
 
+    def with_router_aux(
+        self,
+        loss_stats: Tuple[jax.Array, Dict[str, Any]],
+        out: Any,
+    ) -> Tuple[jax.Array, Dict[str, Any]]:
+        """Fold the MoE router auxiliary losses (Switch load-balance +
+        ST-MoE z-loss, weighted by the model config's ``router_aux_coef`` /
+        ``router_z_coef``) into a trainer loss. No-op for dense backbones —
+        every ``loss_fn`` routes its return through here so any trainer can
+        drive a mixture-of-experts policy."""
+        loss, stats = loss_stats
+        aux = out.get("router_aux_loss") if isinstance(out, dict) else None
+        if aux is None:
+            return loss, stats
+        tcfg = self.tcfg
+        new_loss = (
+            loss
+            + getattr(tcfg, "router_aux_coef", 0.0) * aux[0]
+            + getattr(tcfg, "router_z_coef", 0.0) * aux[1]
+        )
+        stats = dict(stats)
+        stats["losses/router_load_balance"] = aux[0]
+        stats["losses/router_z"] = aux[1]
+        # keep the logged total in sync with what is actually optimized
+        # (PPO/ILQL/GRPO/DPO flatten to losses/total_loss, SFT to losses/loss)
+        for key in ("losses/total_loss", "losses/loss"):
+            if key in stats:
+                stats[key] = new_loss
+        return new_loss, stats
+
     @abstractmethod
     def prepare_learning(self) -> None:
         ...

@@ -32,13 +32,14 @@ logger = logging.get_logger(__name__)
 
 def _completion_logps(module, params, input_ids, attention_mask, out_mask):
     """Summed logprob of completion tokens per row: token t is predicted at
-    position t-1; only positions with ``out_mask`` contribute."""
+    position t-1; only positions with ``out_mask`` contribute. Also returns
+    the raw forward outputs (router aux losses for MoE policies)."""
     out = module.apply({"params": params}, input_ids, attention_mask=attention_mask)
     lp = logprobs_of_labels(out["logits"][:, :-1], input_ids[:, 1:])
     # accumulate in fp32: a bf16 sum of hundreds of logprobs has an ulp of
     # O(1) nats — the same order as real DPO margins
     sel = (out_mask[:, 1:] * attention_mask[:, 1:]).astype(jnp.float32)
-    return jnp.sum(lp.astype(jnp.float32) * sel, axis=1)
+    return jnp.sum(lp.astype(jnp.float32) * sel, axis=1), out
 
 
 @register_trainer
@@ -72,7 +73,7 @@ class DPOTrainer(TPUBaseTrainer):
         from trlx_tpu.parallel import shard_batch
 
         ref_fn = jax.jit(
-            lambda p, ids, attn, out: _completion_logps(self.module, p, ids, attn, out)
+            lambda p, ids, attn, out: _completion_logps(self.module, p, ids, attn, out)[0]
         )
         bs = min(self.config.train.batch_size, len(self.store))
         loader = self.store.create_loader(bs, shuffle=False, drop_last=False)
@@ -108,17 +109,20 @@ class DPOTrainer(TPUBaseTrainer):
     def loss_fn(
         self, params: Any, batch: Dict[str, jax.Array], rng: jax.Array
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-        logps = _completion_logps(
+        logps, out = _completion_logps(
             self.module, params, batch["input_ids"], batch["attention_mask"],
             batch["out_mask"],
         )
         refs = batch["ref_logps"]
         # interleaved pair layout: chosen at even rows, rejected at odd
-        return self.config.method.loss(
-            policy_chosen_logps=logps[0::2],
-            policy_rejected_logps=logps[1::2],
-            ref_chosen_logps=refs[0::2],
-            ref_rejected_logps=refs[1::2],
+        return self.with_router_aux(
+            self.config.method.loss(
+                policy_chosen_logps=logps[0::2],
+                policy_rejected_logps=logps[1::2],
+                ref_chosen_logps=refs[0::2],
+                ref_rejected_logps=refs[1::2],
+            ),
+            out,
         )
 
     def prepare_learning(self) -> None:

@@ -197,10 +197,13 @@ class GRPOTrainer(PPOTrainer):
             logits_span=(Q - 1, Q + R - 1),
         )
         logprobs = logprobs_of_labels(out["logits"], responses)
-        return method.loss(
-            logprobs=logprobs,
-            old_logprobs=batch["logprobs"],
-            ref_logprobs=batch["ref_logprobs"],
-            advantages=batch["advantages"],
-            mask=batch["response_mask"],
+        return self.with_router_aux(
+            method.loss(
+                logprobs=logprobs,
+                old_logprobs=batch["logprobs"],
+                ref_logprobs=batch["ref_logprobs"],
+                advantages=batch["advantages"],
+                mask=batch["response_mask"],
+            ),
+            out,
         )

@@ -242,14 +242,17 @@ class ILQLTrainer(TPUBaseTrainer):
         actions = jnp.take_along_axis(
             action_source[:, 1:], batch["actions_ixs"], axis=1
         )
-        return self.ilql.loss(
-            logits=logits,
-            qs=qs,
-            target_qs=target_qs,
-            vs=vs,
-            actions=actions,
-            rewards=batch["rewards"],
-            dones=batch["dones"],
+        return self.with_router_aux(
+            self.ilql.loss(
+                logits=logits,
+                qs=qs,
+                target_qs=target_qs,
+                vs=vs,
+                actions=actions,
+                rewards=batch["rewards"],
+                dones=batch["dones"],
+            ),
+            backbone_out,
         )
 
     def prepare_learning(self) -> None:

@@ -425,14 +425,17 @@ class PPOTrainer(TPUBaseTrainer):
             )
             logprobs = logprobs_of_labels(out["logits"], responses)
             values_pred = out["value"]
-            return method.loss(
-                logprobs=logprobs,
-                values=values_pred,
-                old_logprobs=old_logprobs,
-                old_values=old_values,
-                advantages=advantages,
-                returns=returns,
-                mask=response_mask,
+            return self.with_router_aux(
+                method.loss(
+                    logprobs=logprobs,
+                    values=values_pred,
+                    old_logprobs=old_logprobs,
+                    old_values=old_values,
+                    advantages=advantages,
+                    returns=returns,
+                    mask=response_mask,
+                ),
+                out,
             )
 
         input_ids = jnp.concatenate([queries, responses], axis=1)
@@ -446,14 +449,17 @@ class PPOTrainer(TPUBaseTrainer):
         logprobs = logprobs_of_labels(out["logits"], responses)
         values_pred = out["value"][:, Q - 1 : Q + R - 1]
 
-        return method.loss(
-            logprobs=logprobs,
-            values=values_pred,
-            old_logprobs=old_logprobs,
-            old_values=old_values,
-            advantages=advantages,
-            returns=returns,
-            mask=response_mask,
+        return self.with_router_aux(
+            method.loss(
+                logprobs=logprobs,
+                values=values_pred,
+                old_logprobs=old_logprobs,
+                old_values=old_values,
+                advantages=advantages,
+                returns=returns,
+                mask=response_mask,
+            ),
+            out,
         )
 
     def prepare_learning(self) -> None:

@@ -44,7 +44,9 @@ class SFTTrainer(TPUBaseTrainer):
             batch["input_ids"],
             attention_mask=batch["attention_mask"],
         )
-        return self.config.method.loss(out["logits"], batch["labels"])
+        return self.with_router_aux(
+            self.config.method.loss(out["logits"], batch["labels"]), out
+        )
 
     def prepare_learning(self) -> None:
         self.train_dataloader = self.store.create_loader(
