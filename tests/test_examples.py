@@ -384,6 +384,32 @@ def test_grpo_sentiments_smoke(tmp_path, monkeypatch):
     assert trainer.iter_count == 2
 
 
+def test_grpo_moe_mixtral_smoke(tmp_path, monkeypatch):
+    """GRPO on the MoE backbone with the expert axis active (EXPERT_PARALLEL=2
+    on the 8-device CPU mesh) — router aux stats must ride the train stats."""
+    monkeypatch.delenv("MODEL_PATH", raising=False)
+    monkeypatch.setenv("EXPERT_PARALLEL", "2")
+    import grpo_moe_mixtral
+
+    trainer = grpo_moe_mixtral.main(
+        {
+            "train.total_steps": 2,
+            "train.epochs": 100,
+            "train.eval_interval": 2,
+            "train.batch_size": 8,
+            "train.seq_length": 56,
+            "train.checkpoint_dir": str(tmp_path / "ckpt"),
+            "method.num_rollouts": 8,
+            "method.chunk_size": 8,
+            "method.group_size": 4,
+            "method.ppo_epochs": 1,
+        }
+    )
+    assert trainer.iter_count == 2
+    assert trainer.mesh.shape["expert"] == 2
+    assert trainer.tcfg.num_experts > 0
+
+
 def test_dpo_sentiments_smoke(tmp_path, monkeypatch):
     monkeypatch.delenv("MODEL_PATH", raising=False)
     import dpo_sentiments

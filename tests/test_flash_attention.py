@@ -122,6 +122,60 @@ def test_gradients_match_reference(left_pad):
         )
 
 
+@pytest.mark.parametrize("window", [1, 4, 7, 16])
+@pytest.mark.parametrize("left_pad", [0, 3])
+def test_sliding_window_matches_reference(window, left_pad):
+    """Windowed masking (mistral family): forward + both gradients against
+    the oracle, across window widths from degenerate (1 = self only) to
+    no-op (>= T), with ragged left padding."""
+    q, k, v, mask = _mk(T=16, S=16, left_pad=left_pad, seed=5)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(
+            q, k, v, mask, causal=True, interpret=True, block_q=8, block_k=8,
+            window=window,
+        )
+        return jnp.sum(out * out), out
+
+    def loss_ref(q, k, v):
+        out, _ = attention_reference(q, k, v, mask, causal=True, window=window)
+        return jnp.sum(out * out), out
+
+    (_, out_f), g_flash = jax.value_and_grad(loss_flash, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    (_, out_r), g_ref = jax.value_and_grad(loss_ref, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_r), atol=2e-5, rtol=2e-5)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-5, rtol=5e-5,
+            err_msg=f"window={window} grad mismatch for {name}",
+        )
+
+
+def test_sliding_window_with_offsets():
+    """Window + slot offsets compose (the ring-attention chunk contract):
+    chunked windowed attention reproduces the monolithic windowed result."""
+    B, T, H, D = 1, 16, 2, 8
+    q, k, v, mask = _mk(B=B, T=T, S=T, H=H, D=D, seed=9)
+    full, _ = attention_reference(q, k, v, mask, causal=True, window=6)
+    qh = q[:, 8:]
+    o1, l1 = flash_attention(
+        qh, k[:, :8], v[:, :8], mask[:, :8], causal=True, q_offset=8, k_offset=0,
+        interpret=True, block_q=8, block_k=8, return_lse=True, window=6,
+    )
+    o2, l2 = flash_attention(
+        qh, k[:, 8:], v[:, 8:], mask[:, 8:], causal=True, q_offset=8, k_offset=8,
+        interpret=True, block_q=8, block_k=8, return_lse=True, window=6,
+    )
+    # combine the two chunk results with the online-softmax rule
+    m = jnp.maximum(l1, l2)
+    w1 = jnp.exp(l1 - m)[..., None].transpose(0, 2, 1, 3)
+    w2 = jnp.exp(l2 - m)[..., None].transpose(0, 2, 1, 3)
+    combined = (o1 * w1 + o2 * w2) / (w1 + w2)
+    np.testing.assert_allclose(
+        np.asarray(combined), np.asarray(full[:, 8:]), atol=2e-5, rtol=2e-5
+    )
+
+
 def test_nondivisible_lengths_pad():
     q, k, v, mask = _mk(T=13, S=13, seed=11)
     out = flash_attention(

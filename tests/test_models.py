@@ -66,6 +66,16 @@ def _tiny_hf(family: str):
         )
     elif family == "bloom":
         hf = tf.BloomForCausalLM(tf.BloomConfig(vocab_size=97, hidden_size=32, n_layer=2, n_head=4))
+    elif family == "mistral":
+        # sliding_window=8 < T=12 in the parity tests: the windowed masking
+        # itself is checked against HF's own implementation
+        hf = tf.MistralForCausalLM(
+            tf.MistralConfig(
+                vocab_size=97, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, intermediate_size=64, max_position_embeddings=64,
+                sliding_window=8, tie_word_embeddings=False, attn_implementation="eager",
+            )
+        )
     elif family == "mixtral":
         hf = tf.MixtralForCausalLM(
             tf.MixtralConfig(
@@ -82,7 +92,7 @@ def _tiny_hf(family: str):
     return hf, params, _f32(cfg)
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama", "gpt_neox", "gptj", "opt", "bloom", "mixtral"])
+@pytest.mark.parametrize("family", ["gpt2", "llama", "gpt_neox", "gptj", "opt", "bloom", "mistral", "mixtral"])
 def test_hf_logit_parity(family):
     """The flax decoder reproduces the torch reference logits exactly."""
     import torch
@@ -171,6 +181,38 @@ def test_generate_greedy_matches_naive_decode():
         m = np.concatenate([m, np.ones((toks.shape[0], 1), np.int32)], axis=1)
     assert (np.asarray(out.response_tokens) == toks[:, P:]).all()
     assert out.response_mask.sum() == out.response_mask.size  # no eos → all live
+
+
+def test_mistral_window_decode_matches_full_forward():
+    """KV-cache decode with sliding-window attention (mistral family): the
+    generated sequence grows past the window (8), and each cached decode step
+    must match the windowed full forward."""
+    from trlx_tpu.models.transformer import make_kv_cache
+
+    module, params, tcfg = build_causal_lm(
+        ModelConfig(
+            "builtin:mistral-test",
+            model_extra_kwargs=dict(dtype=jnp.float32, param_dtype=jnp.float32),
+        ),
+        head="value",
+    )
+    assert tcfg.sliding_window == 8
+    ids, mask = _padded_batch(vocab=250)
+    B, P = ids.shape
+    N = 6  # prompt(8) + 6 > window(8): the window slides during decode
+
+    apply_fn = lambda p, i, **kw: module.apply({"params": p}, i, **kw)
+    cfg = GenerationConfig(max_new_tokens=N, do_sample=False, eos_token_id=None, pad_token_id=258)
+    gen = partial(generate, apply_fn, params, lambda b, s: make_kv_cache(tcfg, b, s, jnp.float32), config=cfg)
+    out = gen(input_ids=ids, attention_mask=mask, rng=jax.random.PRNGKey(0))
+
+    toks, m = np.asarray(ids), np.asarray(mask)
+    for _ in range(N):
+        o = apply_fn(params, jnp.array(toks), attention_mask=jnp.array(m))
+        nt = np.asarray(o["logits"][:, -1].argmax(-1)).astype(np.int32)
+        toks = np.concatenate([toks, nt[:, None]], axis=1)
+        m = np.concatenate([m, np.ones((toks.shape[0], 1), np.int32)], axis=1)
+    assert (np.asarray(out.response_tokens) == toks[:, P:]).all()
 
 
 def test_generate_eos_early_stop():

@@ -281,7 +281,7 @@ def test_moe_expert_parallel_training_step():
         set_global_mesh(None)
 
 
-def test_moe_sft_e2e_loss_decreases():
+def test_moe_sft_e2e_loss_decreases(tmp_path):
     """A tiny mixtral SFT run through the real trainer: the router aux terms
     ride the loss (stats carry them) and the total loss decreases."""
     from trlx_tpu.data.default_configs import default_sft_config
@@ -299,7 +299,7 @@ def test_moe_sft_e2e_loss_decreases():
             checkpoint_interval=10**6,
             save_best=False,
             tracker=None,
-            checkpoint_dir="/tmp/trlx_tpu_moe_sft",
+            checkpoint_dir=str(tmp_path / "ckpt"),
         ),
         model=dict(
             model_path="builtin:mixtral-test",

@@ -116,10 +116,8 @@ def convert_llama(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict[str
 def convert_mixtral(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict[str, Any]:
     """Mixtral (llama-style attention + sparse MoE MLP): per-expert
     ``w1``/``w3``/``w2`` Linears stack into the ``[E, ...]`` expert kernels
-    and the router ``gate`` Linear becomes the fp32 router Dense.
-    ``sliding_window`` is ignored — full causal attention (exact for
-    sequences up to the window; the released Mixtral checkpoints ship with
-    ``sliding_window: null``)."""
+    and the router ``gate`` Linear becomes the fp32 router Dense. A declared
+    ``sliding_window`` maps onto the native windowed-attention masking."""
     p = "model."
     backbone: Dict[str, Any] = {
         "wte": {"embedding": sd[p + "embed_tokens.weight"]},
@@ -281,6 +279,7 @@ CONVERTERS: Dict[str, Callable] = {
     "gptj": convert_gptj,
     "opt": convert_opt,
     "bloom": convert_bloom,
+    "mistral": convert_llama,  # identical key layout (llama + sliding window)
     "mixtral": convert_mixtral,
 }
 
@@ -301,7 +300,9 @@ def config_from_hf(hf_config) -> TransformerConfig:
             activation="gelu_new",
             layer_norm_epsilon=hf_config.layer_norm_epsilon,
         )
-    if mt == "llama":
+    if mt in ("llama", "mistral"):
+        # mistral IS the llama mapping + head_dim override + sliding window
+        # (both getattrs are None-safe on LlamaConfig)
         return TransformerConfig(
             model_type=mt,
             vocab_size=hf_config.vocab_size,
@@ -309,6 +310,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
             num_layers=hf_config.num_hidden_layers,
             num_heads=hf_config.num_attention_heads,
             num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+            head_dim=getattr(hf_config, "head_dim", None),
             intermediate_size=hf_config.intermediate_size,
             max_position_embeddings=hf_config.max_position_embeddings,
             position_scheme="rotary",
@@ -319,18 +321,9 @@ def config_from_hf(hf_config) -> TransformerConfig:
             attn_bias=False,
             mlp_bias=False,
             tie_word_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+            sliding_window=getattr(hf_config, "sliding_window", None),
         )
     if mt == "mixtral":
-        if getattr(hf_config, "sliding_window", None):
-            from trlx_tpu.utils.logging import get_logger
-
-            get_logger(__name__).warning(
-                "Mixtral checkpoint declares sliding_window=%s; this backbone "
-                "uses full causal attention — logits are exact only for "
-                "sequences up to the window (the released Mixtral checkpoints "
-                "ship sliding_window: null)",
-                hf_config.sliding_window,
-            )
         return TransformerConfig(
             model_type=mt,
             vocab_size=hf_config.vocab_size,
@@ -352,6 +345,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
             num_experts_per_tok=hf_config.num_experts_per_tok,
             router_aux_coef=getattr(hf_config, "router_aux_loss_coef", 0.01),
             moe_group_size=512,
+            sliding_window=getattr(hf_config, "sliding_window", None),
             # HF Mixtral routes with no capacity bound (dense gather); a
             # capacity factor of E makes the einsum dispatch drop-free by
             # construction (even if every token picked the same expert), so
@@ -867,6 +861,7 @@ EXPORTERS: Dict[str, Callable] = {
     "opt": export_opt,
     "bloom": export_bloom,
     "t5": export_t5,
+    "mistral": export_llama,  # identical key layout
     "mixtral": export_mixtral,
 }
 
@@ -928,8 +923,8 @@ def hf_config_from_transformer(cfg):
             n_inner=cfg.intermediate_size,
             layer_norm_epsilon=cfg.layer_norm_epsilon,
         )
-    if mt == "llama":
-        return tf.LlamaConfig(
+    if mt in ("llama", "mistral"):
+        shared = dict(
             vocab_size=cfg.vocab_size,
             hidden_size=cfg.hidden_size,
             num_hidden_layers=cfg.num_layers,
@@ -940,6 +935,13 @@ def hf_config_from_transformer(cfg):
             rms_norm_eps=cfg.layer_norm_epsilon,
             rope_theta=cfg.rope_theta,
             tie_word_embeddings=cfg.tie_word_embeddings,
+        )
+        if mt == "llama":
+            return tf.LlamaConfig(**shared)
+        return tf.MistralConfig(
+            head_dim=cfg.dims_per_head,
+            sliding_window=cfg.sliding_window,
+            **shared,
         )
     if mt == "mixtral":
         return tf.MixtralConfig(
@@ -955,7 +957,7 @@ def hf_config_from_transformer(cfg):
             num_local_experts=cfg.num_experts,
             num_experts_per_tok=cfg.num_experts_per_tok,
             router_aux_loss_coef=cfg.router_aux_coef,
-            sliding_window=None,
+            sliding_window=cfg.sliding_window,
             tie_word_embeddings=cfg.tie_word_embeddings,
         )
     if mt == "gpt_neox":

@@ -116,6 +116,12 @@ class TransformerConfig:
     rotary_dim: Optional[int] = None  # partial rotary (gptj/neox); None = full
     rope_theta: float = 10000.0
 
+    # sliding-window attention (mistral family): each query attends only the
+    # last `sliding_window` positions. None = unbounded full causal. Slots
+    # are temporally ordered with padding only on the left, so the window is
+    # enforced on slot distance in every path (xla bias, flash kernel, ring).
+    sliding_window: Optional[int] = None
+
     norm: str = "layernorm"  # layernorm | rmsnorm
     layer_norm_epsilon: float = 1e-5
     activation: str = "gelu_new"  # gelu_new | gelu | silu | relu
@@ -215,6 +221,25 @@ class TransformerConfig:
             position_scheme="rotary",
             norm="rmsnorm",
             layer_norm_epsilon=1e-6,
+            activation="silu",
+            attn_bias=False,
+            mlp_bias=False,
+            tie_word_embeddings=False,
+        )
+
+    @staticmethod
+    def mistral(size: str = "7b", **overrides) -> "TransformerConfig":
+        dims = {
+            "test": dict(vocab_size=259, hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2, intermediate_size=128, max_position_embeddings=128, sliding_window=8),
+            "7b": dict(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=8, intermediate_size=14336, max_position_embeddings=32768, sliding_window=4096),
+        }[size]
+        return _make_preset(
+            dims,
+            overrides,
+            model_type="mistral",
+            position_scheme="rotary",
+            norm="rmsnorm",
+            layer_norm_epsilon=1e-5,
             activation="silu",
             attn_bias=False,
             mlp_bias=False,
@@ -525,6 +550,7 @@ class Attention(nn.Module):
                 q_positions=flash_args.get("q_positions"),
                 k_positions=flash_args.get("k_positions"),
                 alibi_slopes=flash_args.get("alibi_slopes"),
+                window=flash_args.get("window"),
             ).reshape(B, T, H * D)
         elif flash_args is not None:
             # fused flash-attention kernel; masking semantics identical to the
@@ -541,6 +567,7 @@ class Attention(nn.Module):
                 q_positions=flash_args.get("q_positions"),
                 k_positions=flash_args.get("k_positions"),
                 alibi_slopes=flash_args.get("alibi_slopes"),
+                window=flash_args.get("window"),
             ).reshape(B, T, H * D)
         else:
             if KV < H:  # flash/ring kernels consume unrepeated K/V (GQA-aware)
@@ -910,6 +937,11 @@ class CausalTransformer(nn.Module):
         S = key_mask.shape[1]
         key_slots = jnp.arange(S)[None, None, :]  # [1, 1, S]
         visible = (key_slots <= query_slots[:, :, None]) & (key_mask[:, None, :] > 0)
+        if cfg.sliding_window:
+            # slot distance ≡ position distance (padding is left-only)
+            visible = visible & (
+                query_slots[:, :, None] - key_slots < cfg.sliding_window
+            )
         bias = jnp.where(visible[:, None, :, :], 0.0, -1e9)
         if cfg.position_scheme == "alibi":
             slopes = jnp.asarray(alibi_slopes(cfg.num_heads), dtype=jnp.float32)
@@ -938,6 +970,8 @@ class CausalTransformer(nn.Module):
         bias tensor is ever materialised)."""
         cfg = self.config
         args: Dict[str, Any] = {"key_mask": key_mask, "q_offset": q_offset}
+        if cfg.sliding_window:
+            args["window"] = cfg.sliding_window
         if cfg.position_scheme == "alibi":
             args["alibi_slopes"] = jnp.asarray(alibi_slopes(cfg.num_heads), jnp.float32)
             args["q_positions"] = query_positions
@@ -1215,6 +1249,7 @@ def unstack_layer_params(backbone: Dict[str, Any], prefix: str = "h_") -> Dict[s
 BUILTIN_SPECS = {
     "gpt2": TransformerConfig.gpt2,
     "llama": TransformerConfig.llama,
+    "mistral": TransformerConfig.mistral,
     "mixtral": TransformerConfig.mixtral,
     "gptj": TransformerConfig.gptj,
     "gptneox": TransformerConfig.gptneox,

@@ -74,6 +74,7 @@ def _fwd_kernel(
     block_k: int,
     seq_k: int,
     block_q: int,
+    window: int,  # sliding-window width in slots (0 = unbounded)
 ):
     iq = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (bQ, D)
@@ -95,6 +96,10 @@ def _fwd_kernel(
         )
     else:
         hi = n_k
+    lo = 0
+    if window:
+        # first k block any query here can see: k_slot > q_slot - window
+        lo = jnp.clip((qoff + iq * block_q - (window - 1) - koff) // block_k, 0, hi)
 
     def body(ik, carry):
         acc, m, l = carry
@@ -113,6 +118,10 @@ def _fwd_kernel(
         visible = kmask > 0.5
         if causal:
             visible = visible & (k_slots <= q_slots)
+        if window:
+            # slots are laid out in temporal order with padding only on the
+            # left, so slot distance ≡ position distance for real pairs
+            visible = visible & (q_slots - k_slots < window)
         if alibi:
             k_pos = kpos_ref[0, 0, pl.ds(ik * block_k, block_k)].astype(
                 jnp.float32
@@ -136,7 +145,7 @@ def _fwd_kernel(
     acc = jnp.zeros((block_q, d), jnp.float32)
     m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc, m, l))
+    acc, m, l = jax.lax.fori_loop(lo, hi, body, (acc, m, l))
 
     safe_l = jnp.where(l > 0.0, l, 1.0)
     o_ref[0, 0] = (acc / safe_l).astype(o_ref.dtype)
@@ -172,6 +181,7 @@ def _bwd_fused_kernel(
     block_q: int,
     seq_q: int,
     block_k: int,
+    window: int,  # sliding-window width in slots (0 = unbounded)
 ):
     """Fused backward: one pass over (k-block × q-blocks) produces dk/dv for
     the k block AND accumulates dq into its full-sequence buffer — the TPU
@@ -201,6 +211,12 @@ def _bwd_fused_kernel(
         lo = jnp.clip((koff + ik * block_k - qoff) // block_q, 0, n_q)
     else:
         lo = 0
+    hi = n_q
+    if window:
+        # last q block that can still see this k block: q_slot < k_slot + W
+        hi = jnp.clip(
+            (koff + (ik + 1) * block_k + window - 2 - qoff) // block_q + 1, lo, n_q
+        )
 
     def body(iq, carry):
         dk, dv = carry
@@ -218,6 +234,8 @@ def _bwd_fused_kernel(
         visible = kmask > 0.5
         if causal:
             visible = visible & (k_slots <= q_slots)
+        if window:
+            visible = visible & (q_slots - k_slots < window)
         if alibi:
             q_pos = qpos_ref[0, 0, pl.ds(iq * block_q, block_q)].astype(
                 jnp.float32
@@ -245,7 +263,7 @@ def _bwd_fused_kernel(
 
     d = q_ref.shape[-1]
     zeros = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, n_q, body, (zeros, zeros))
+    dk, dv = jax.lax.fori_loop(lo, hi, body, (zeros, zeros))
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
@@ -272,7 +290,7 @@ def _smem_spec():
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12, 13)
+    jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12, 13, 14)
 )
 def _flash(
     q,  # (B, H, T, D)
@@ -289,17 +307,18 @@ def _flash(
     block_q: int,
     block_k: int,
     interpret: bool,
+    window: int,
 ):
     out, _ = _flash_fwd_impl(
         q, k, v, kmask, qpos, kpos, slopes, offsets,
-        sm_scale, causal, alibi, block_q, block_k, interpret,
+        sm_scale, causal, alibi, block_q, block_k, interpret, window,
     )
     return out
 
 
 def _flash_fwd_impl(
     q, k, v, kmask, qpos, kpos, slopes, offsets,
-    sm_scale, causal, alibi, block_q, block_k, interpret,
+    sm_scale, causal, alibi, block_q, block_k, interpret, window=0,
 ):
     B, H, T, D = q.shape
     KV, S = k.shape[1], k.shape[2]
@@ -315,6 +334,7 @@ def _flash_fwd_impl(
         block_k=block_k,
         seq_k=S,
         block_q=block_q,
+        window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -345,11 +365,11 @@ def _flash_fwd_impl(
 
 def _flash_fwd_rule(
     q, k, v, kmask, qpos, kpos, slopes, offsets,
-    sm_scale, causal, alibi, block_q, block_k, interpret,
+    sm_scale, causal, alibi, block_q, block_k, interpret, window,
 ):
     out, lse = _flash_fwd_impl(
         q, k, v, kmask, qpos, kpos, slopes, offsets,
-        sm_scale, causal, alibi, block_q, block_k, interpret,
+        sm_scale, causal, alibi, block_q, block_k, interpret, window,
     )
     res = (q, k, v, kmask, qpos, kpos, slopes, offsets, out, lse)
     return out, res
@@ -357,7 +377,7 @@ def _flash_fwd_rule(
 
 def _bwd_fused_call(
     qoff, koff, q, k, v, kmask, qpos, kpos, slopes, lse, delta, do,
-    sm_scale, causal, alibi, block_q, block_k, interpret,
+    sm_scale, causal, alibi, block_q, block_k, interpret, window=0,
 ):
     """Single fused pallas call producing (dq, dk, dv) on kernel-layout
     padded inputs. dq accumulates in f32 across the sequential k-block grid
@@ -373,6 +393,7 @@ def _bwd_fused_call(
         block_q=block_q,
         seq_q=T,
         block_k=block_k,
+        window=window,
     )
     dq, dk, dv = pl.pallas_call(
         kernel,
@@ -410,7 +431,7 @@ def _bwd_fused_call(
 
 
 def _flash_bwd_rule(
-    sm_scale, causal, alibi, block_q, block_k, interpret, res, do
+    sm_scale, causal, alibi, block_q, block_k, interpret, window, res, do
 ):
     q, k, v, kmask, qpos, kpos, slopes, offsets, out, lse = res
     B, H, T, D = q.shape
@@ -421,7 +442,7 @@ def _flash_bwd_rule(
     delta = jnp.broadcast_to(delta[..., None], (B, H, T, LANES))
 
     args = (qoff, koff, q, k, v, kmask, qpos, kpos, slopes, lse, delta, do)
-    opts = (sm_scale, causal, alibi, block_q, block_k, interpret)
+    opts = (sm_scale, causal, alibi, block_q, block_k, interpret, window)
     dq, dk, dv = _bwd_fused_call(*args, *opts)
 
     zeros_like = jax.tree_util.tree_map(jnp.zeros_like, (kmask, qpos, kpos, slopes, offsets))
@@ -450,6 +471,7 @@ def flash_attention_bwd_chunk(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,  # sliding-window width (None = unbounded)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One (q-chunk × kv-chunk) term of the flash backward, in model layout.
 
@@ -502,7 +524,7 @@ def flash_attention_bwd_chunk(
     )
 
     args = (offsets[0], offsets[1], qt, kt, vt, kmask, qpos, kpos, slopes, lse_p, delta_p, dot)
-    opts = (sm_scale, causal, alibi, block_q, block_k, interpret)
+    opts = (sm_scale, causal, alibi, block_q, block_k, interpret, int(window or 0))
     dq, dk, dv = _bwd_fused_call(*args, *opts)
     return (
         dq[:, :, :T, :].transpose(0, 2, 1, 3),
@@ -528,6 +550,7 @@ def flash_attention(
     block_k: int = 128,
     interpret: Optional[bool] = None,
     return_lse: bool = False,
+    window: Optional[int] = None,  # sliding-window width (None = unbounded)
 ):
     """Flash attention over ``[B, T, H, D]`` tensors (model layout).
 
@@ -579,10 +602,11 @@ def flash_attention(
         jnp.asarray(k_offset, jnp.int32).reshape(1),
     )
 
+    win = int(window or 0)
     if return_lse:
         out, lse = _flash_fwd_impl(
             qt, kt, vt, kmask, qpos, kpos, slopes, offsets,
-            sm_scale, causal, alibi, block_q, block_k, interpret,
+            sm_scale, causal, alibi, block_q, block_k, interpret, win,
         )
         return (
             out[:, :, :T, :].transpose(0, 2, 1, 3),
@@ -590,7 +614,7 @@ def flash_attention(
         )
     out = _flash(
         qt, kt, vt, kmask, qpos, kpos, slopes, offsets,
-        sm_scale, causal, alibi, block_q, block_k, interpret,
+        sm_scale, causal, alibi, block_q, block_k, interpret, win,
     )
     return out[:, :, :T, :].transpose(0, 2, 1, 3)
 
@@ -598,7 +622,7 @@ def flash_attention(
 def attention_reference(
     q, k, v, key_mask, *, causal=True, sm_scale=None,
     q_offset=0, k_offset=0, q_positions=None, k_positions=None,
-    alibi_slopes=None,
+    alibi_slopes=None, window=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Naive XLA attention with identical masking semantics (test oracle).
 
@@ -612,10 +636,12 @@ def attention_reference(
         "bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * sm_scale
     visible = key_mask[:, None, None, :] > 0.5
+    q_slots = jnp.arange(T)[:, None] + jnp.asarray(q_offset)
+    k_slots = jnp.arange(S)[None, :] + jnp.asarray(k_offset)
     if causal:
-        q_slots = jnp.arange(T)[:, None] + jnp.asarray(q_offset)
-        k_slots = jnp.arange(S)[None, :] + jnp.asarray(k_offset)
         visible = visible & (k_slots <= q_slots)[None, None, :, :]
+    if window:
+        visible = visible & (q_slots - k_slots < window)[None, None, :, :]
     if alibi_slopes is not None:
         dist = (
             k_positions[:, None, :] - q_positions[:, :, None]

@@ -118,7 +118,7 @@ def ring_schedule_work(n: int, placement: str) -> Tuple[List[float], float, floa
     return per_step, sum(per_step), total_work
 
 
-def _make_ring_fn(axis, n, causal, alibi, zigzag, sm_scale, block_q, block_k, interpret):
+def _make_ring_fn(axis, n, causal, alibi, zigzag, sm_scale, block_q, block_k, interpret, window=None):
     """Build the per-shard ring function (a custom-VJP closure)."""
 
     def segments(dev, Tl):
@@ -165,6 +165,7 @@ def _make_ring_fn(axis, n, causal, alibi, zigzag, sm_scale, block_q, block_k, in
                         block_k=block_k,
                         interpret=interpret,
                         return_lse=True,
+                        window=window,
                     )
                     outs[qi], lses[qi] = _combine(
                         outs[qi], lses[qi], o_s.astype(jnp.float32), l_s
@@ -218,6 +219,7 @@ def _make_ring_fn(axis, n, causal, alibi, zigzag, sm_scale, block_q, block_k, in
                         block_q=block_q,
                         block_k=block_k,
                         interpret=interpret,
+                        window=window,
                     )
                     dq = dq.at[:, qs : qs + ql].add(dq_s.astype(jnp.float32))
                     dkc = dkc.at[:, ks : ks + kl].add(dk_s.astype(jnp.float32))
@@ -258,6 +260,7 @@ def ring_flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,  # sliding-window width (slot distance)
 ) -> jax.Array:
     """Exact attention with K/V rotating over the ``axis`` mesh ring.
 
@@ -277,6 +280,7 @@ def ring_flash_attention(
             q_positions=q_positions, k_positions=k_positions,
             alibi_slopes=alibi_slopes,
             block_q=block_q, block_k=block_k, interpret=interpret,
+            window=window,
         )
     B, T, H, D = q.shape
     if T % n:
@@ -310,7 +314,8 @@ def ring_flash_attention(
         kpos = jnp.take(kpos, order, axis=1)
 
     ring = _make_ring_fn(
-        axis, n, causal, alibi, zigzag, sm_scale, block_q, block_k, interpret
+        axis, n, causal, alibi, zigzag, sm_scale, block_q, block_k, interpret,
+        window,
     )
     shard = P(None, axis, None, None)
     f = jax.shard_map(

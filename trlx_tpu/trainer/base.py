@@ -258,8 +258,11 @@ class TPUBaseTrainer(BaseRLTrainer):
         stats = dict(stats)
         stats["losses/router_load_balance"] = aux[0]
         stats["losses/router_z"] = aux[1]
-        # keep the logged total in sync with what is actually optimized
-        # (PPO/ILQL/GRPO/DPO flatten to losses/total_loss, SFT to losses/loss)
+        # keep the logged total in sync with what is actually optimized.
+        # Contract: every method.loss must report its headline total under
+        # one of these canonical keys (PPO/ILQL/GRPO/DPO flatten to
+        # losses/total_loss, SFT to losses/loss) — a new method using a
+        # different name would log a total that excludes the router terms
         for key in ("losses/total_loss", "losses/loss"):
             if key in stats:
                 stats[key] = new_loss
