@@ -1,0 +1,223 @@
+"""Speculative decoding (draft-and-verify rollout generation).
+
+Beyond the reference, whose generation loop is plain HF ``generate``
+(SURVEY.md §3.2). Exactness contract of
+``trlx_tpu/ops/speculative.py::generate_speculative``:
+
+- greedy output is bit-identical to the plain sampler for ANY draft;
+- draft == target accepts (nearly) every proposal;
+- sampling remains distribution-exact (rejection-sampling identity);
+- logprobs/values carry the plain sampler's PPO semantics.
+"""
+
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.configs import ModelConfig
+from trlx_tpu.models.builder import build_causal_lm
+from trlx_tpu.models.transformer import make_kv_cache
+from trlx_tpu.ops.sampling import GenerationConfig, generate
+from trlx_tpu.ops.speculative import generate_speculative
+
+
+def _models(draft_seed=1):
+    kw = dict(model_extra_kwargs=dict(dtype=jnp.float32, param_dtype=jnp.float32))
+    t_mod, t_params, t_cfg = build_causal_lm(
+        ModelConfig("builtin:gpt2-test", **kw), head="value"
+    )
+    d_mod, d_params, d_cfg = build_causal_lm(
+        ModelConfig("builtin:gpt2-test", **kw), head=None, seed=draft_seed
+    )
+    t_apply = lambda p, i, **k: t_mod.apply({"params": p}, i, **k)
+    d_apply = lambda p, i, **k: d_mod.apply({"params": p}, i, **k)
+    return (t_apply, t_params, t_cfg), (d_apply, d_params, d_cfg)
+
+
+def _prompts(B=3, P=8, vocab=250):
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (B, P)).astype(np.int32)
+    mask = np.ones((B, P), np.int32)
+    mask[0, :3] = 0
+    if B > 2:
+        mask[2, :5] = 0
+    ids[mask == 0] = 258
+    return jnp.asarray(ids), jnp.asarray(mask)
+
+
+def _spec(t, d, ids, mask, cfg, gamma, rng=0, **kw):
+    (t_apply, t_params, t_cfg), (d_apply, d_params, d_cfg) = t, d
+    return generate_speculative(
+        t_apply, t_params, d_apply, d_params,
+        lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+        lambda b, s: make_kv_cache(d_cfg, b, s, jnp.float32),
+        ids, mask, jax.random.PRNGKey(rng), cfg, gamma=gamma, **kw,
+    )
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 5])
+def test_greedy_exactly_matches_plain_sampler(gamma):
+    """For any draft, greedy speculative output (tokens, mask, logprobs,
+    values) equals the plain sampler's greedy decode."""
+    t, d = _models(draft_seed=1)  # draft is a DIFFERENT random model
+    ids, mask = _prompts()
+    cfg = GenerationConfig(
+        max_new_tokens=10, do_sample=False, eos_token_id=None, pad_token_id=258
+    )
+    t_apply, t_params, t_cfg = t
+    ref = generate(
+        t_apply, t_params, lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+        ids, mask, jax.random.PRNGKey(0), cfg,
+    )
+    out = jax.jit(
+        partial(_spec, t, d, cfg=cfg, gamma=gamma)
+    )(ids, mask)
+    assert (np.asarray(out.response_tokens) == np.asarray(ref.response_tokens)).all()
+    assert (np.asarray(out.response_mask) == np.asarray(ref.response_mask)).all()
+    np.testing.assert_allclose(
+        np.asarray(out.response_logprobs), np.asarray(ref.response_logprobs), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.response_values), np.asarray(ref.response_values), atol=1e-5
+    )
+
+
+def test_greedy_eos_early_stop_matches():
+    t, d = _models()
+    ids, mask = _prompts()
+    t_apply, t_params, t_cfg = t
+    base = generate(
+        t_apply, t_params, lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+        ids, mask, jax.random.PRNGKey(0),
+        GenerationConfig(max_new_tokens=10, do_sample=False, eos_token_id=None, pad_token_id=258),
+    )
+    # declare the token row 0 greedily emits at step 2 as eos → early stop
+    eos = int(np.asarray(base.response_tokens)[0, 2])
+    cfg = GenerationConfig(
+        max_new_tokens=10, do_sample=False, eos_token_id=eos, pad_token_id=258
+    )
+    ref = generate(
+        t_apply, t_params, lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+        ids, mask, jax.random.PRNGKey(0), cfg,
+    )
+    out = _spec(t, d, ids, mask, cfg, gamma=3)
+    assert (np.asarray(out.response_tokens) == np.asarray(ref.response_tokens)).all()
+    assert (np.asarray(out.response_mask) == np.asarray(ref.response_mask)).all()
+
+
+def test_identical_draft_accepts_everything():
+    """Draft == target (same backbone params): the acceptance rate must be
+    ~1 and the round count collapses to ~N/(gamma+1)."""
+    t, _ = _models()
+    t_apply, t_params, t_cfg = t
+    # headless apply over the same backbone params as the target policy
+    from trlx_tpu.models.transformer import CausalTransformer
+
+    bare = CausalTransformer(t_cfg)
+    d = (lambda p, i, **k: bare.apply({"params": p}, i, **k), t_params["backbone"], t_cfg)
+    ids, mask = _prompts()
+    cfg = GenerationConfig(
+        max_new_tokens=12, do_sample=True, temperature=1.0, eos_token_id=None,
+        pad_token_id=258,
+    )
+    out, stats = _spec(t, d, ids, mask, cfg, gamma=4, return_stats=True)
+    assert np.asarray(out.response_mask).all()
+    rate = float(stats["acceptance_rate"])
+    rounds = int(stats["rounds"])
+    assert rate > 0.95, rate
+    # full acceptance commits gamma+1 = 5 per round → ~3 rounds for N=12
+    assert rounds <= 5, rounds
+
+
+def test_identical_draft_greedy_minimal_rounds():
+    """Greedy + draft == target: every round fully accepts, so generation
+    takes exactly ceil(N/(gamma+1)) rounds. Catches any draft-cache
+    corruption across rounds (e.g. a missing d_G K/V write after a fully
+    accepted round) as extra rejection rounds."""
+    t, _ = _models()
+    t_apply, t_params, t_cfg = t
+    from trlx_tpu.models.transformer import CausalTransformer
+
+    bare = CausalTransformer(t_cfg)
+    d = (lambda p, i, **k: bare.apply({"params": p}, i, **k), t_params["backbone"], t_cfg)
+    ids, mask = _prompts()
+    N, G = 24, 3
+    cfg = GenerationConfig(
+        max_new_tokens=N, do_sample=False, eos_token_id=None, pad_token_id=258
+    )
+    out, stats = _spec(t, d, ids, mask, cfg, gamma=G, return_stats=True)
+    assert np.asarray(out.response_mask).all()
+    assert int(stats["rounds"]) == -(-N // (G + 1)), int(stats["rounds"])
+
+
+def test_sampling_first_token_distribution_matches_target():
+    """Distribution exactness smoke: over many rows of the same prompt, the
+    speculative first token's empirical distribution matches the plain
+    target sampler's (total variation within sampling noise)."""
+    t, d = _models(draft_seed=7)
+    B = 512
+    ids = jnp.tile(jnp.asarray([[5, 9, 17, 23]], jnp.int32), (B, 1))
+    mask = jnp.ones((B, 4), jnp.int32)
+    cfg = GenerationConfig(
+        max_new_tokens=2, do_sample=True, temperature=1.0, top_k=4,
+        eos_token_id=None, pad_token_id=258,
+    )
+    t_apply, t_params, t_cfg = t
+    ref = generate(
+        t_apply, t_params, lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+        ids, mask, jax.random.PRNGKey(3), cfg,
+    )
+    out = _spec(t, d, ids, mask, cfg, gamma=2, rng=11)
+    a = np.bincount(np.asarray(ref.response_tokens)[:, 0], minlength=259) / B
+    b = np.bincount(np.asarray(out.response_tokens)[:, 0], minlength=259) / B
+    tv = 0.5 * np.abs(a - b).sum()
+    assert tv < 0.15, tv  # top_k=4, n=512 → noise floor ≈ 0.06
+
+
+def test_trainer_speculative_rollouts_e2e(tmp_path):
+    """PPO make_experience + learn with a draft model configured: the
+    speculative sampler slots in transparently (same GenerationOutput
+    contract) and training runs."""
+    import trlx_tpu.trainer.ppo  # noqa: F401
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            seq_length=24, batch_size=8, total_steps=2, eval_interval=2,
+            checkpoint_interval=10**6, save_best=False, tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+        ),
+        model=dict(
+            model_path="builtin:gpt2-test",
+            num_layers_unfrozen=1,
+            draft_model_path="builtin:gpt2-test",
+            draft_gamma=3,
+        ),
+        method=dict(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    trainer = get_trainer(config.train.trainer)(
+        config=config,
+        reward_fn=lambda samples, prompts, outputs, **kw: [float(len(o)) for o in outputs],
+        metric_fn=None,
+        stop_sequences=[],
+    )
+    assert trainer.draft_module is not None
+    pipeline = get_pipeline(config.train.pipeline)(
+        ["hello world", "foo", "bar baz", "qux"] * 2, 12, trainer.tokenizer
+    )
+    trainer.add_prompt_pipeline(pipeline)
+    trainer.make_experience(8)
+    assert len(trainer.store) == 8
+    trainer.prepare_learning()
+    stats = trainer.train_step(next(iter(trainer.store.create_loader(8, shuffle=True))))
+    assert np.isfinite(float(np.asarray(stats["losses/total_loss"])))

@@ -79,6 +79,13 @@ class ModelConfig:
     peft_kwargs: Optional[Dict[str, Any]] = None
     # Extra kwargs forwarded to the model builder (vocab override etc.)
     model_extra_kwargs: Dict[str, Any] = field(default_factory=dict)
+    # Speculative decoding for rollout generation: a small same-vocab draft
+    # model proposes ``draft_gamma`` tokens per round and the policy verifies
+    # them in one forward (lossless — the sampled distribution is the
+    # policy's; ``trlx_tpu/ops/speculative.py``). None disables.
+    draft_model_path: Optional[str] = None
+    draft_gamma: int = 4
+    draft_model_extra_kwargs: Dict[str, Any] = field(default_factory=dict)
 
     from_dict = classmethod(_strict_from_dict)
 

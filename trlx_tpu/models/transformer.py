@@ -528,9 +528,19 @@ class Attention(nn.Module):
 
         new_cache = None
         if cache is not None:
-            # decode: write this step's k/v into the cache at cache_index
-            k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, cache_index, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, cache_index, 0, 0))
+            # decode: write this step's k/v into the cache at cache_index —
+            # a scalar (all rows aligned) or a [B] vector (speculative
+            # decoding: rows rewind to different accepted lengths)
+            ci = jnp.asarray(cache_index)
+            if ci.ndim == 0:
+                k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, ci, 0, 0))
+                v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, ci, 0, 0))
+            else:
+                row_write = jax.vmap(
+                    lambda c, x, i: jax.lax.dynamic_update_slice(c, x, (i, 0, 0))
+                )
+                k_cache = row_write(cache["k"], k.astype(cache["k"].dtype), ci)
+                v_cache = row_write(cache["v"], v.astype(cache["v"].dtype), ci)
             k, v = k_cache, v_cache
             new_cache = {"k": k_cache, "v": v_cache}
 
@@ -773,11 +783,19 @@ def router_aux_summary(aux: jax.Array) -> jax.Array:
     return aux[:2] / jnp.maximum(aux[2], 1.0)
 
 
+def _query_slots(q_offset, B: int, T: int) -> jax.Array:
+    """[B, T] slot indices of queries at ``q_offset`` (scalar, or [B] when
+    rows sit at different cache depths — speculative decoding)."""
+    off = jnp.asarray(q_offset)
+    if off.ndim == 1:
+        off = off[:, None]
+    return jnp.broadcast_to(off + jnp.arange(T)[None, :], (B, T))
+
+
 def _token_validity(slot_mask: jax.Array, q_offset, T: int) -> jax.Array:
     """[B, T] validity of the query tokens occupying cache slots
     ``[q_offset, q_offset + T)`` of a [B, S] slot mask."""
-    B = slot_mask.shape[0]
-    qs = jnp.broadcast_to(q_offset + jnp.arange(T)[None, :], (B, T))
+    qs = _query_slots(q_offset, slot_mask.shape[0], T)
     return jax.vmap(lambda m, q: m[q])(slot_mask, qs)
 
 
@@ -961,7 +979,7 @@ class CausalTransformer(nn.Module):
         if use_flash:
             return None, self._flash_args(key_mask, positions, q_offset=q_offset)
         B, T = positions.shape
-        query_slots = q_offset + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        query_slots = _query_slots(q_offset, B, T)
         return self._attention_bias(key_mask, query_slots, positions), None
 
     def _flash_args(self, key_mask, query_positions, q_offset=0) -> Dict[str, Any]:
@@ -1004,7 +1022,7 @@ class CausalTransformer(nn.Module):
             # queries occupy slots [cache_index, cache_index + T)
             if positions is None:
                 offset = cache_index if cache_index is not None else 0
-                query_slots = offset + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+                query_slots = _query_slots(offset, B, T)
                 key_pos = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
                 positions = jax.vmap(lambda kp, qs: kp[qs])(key_pos, query_slots)
 
@@ -1019,8 +1037,18 @@ class CausalTransformer(nn.Module):
                 token_mask = _token_validity(attention_mask, offset, T)
 
         x = self._embed(input_ids, positions)
-        use_flash = cfg.resolved_attention_impl() == "pallas" and T > 1
+        # flash kernels take a scalar slot offset; per-row cache depths
+        # (speculative decoding) go through the bias path (T is tiny there)
+        vector_ci = cache_index is not None and jnp.asarray(cache_index).ndim > 0
+        use_flash = cfg.resolved_attention_impl() == "pallas" and T > 1 and not vector_ci
         pipe_mesh = None if self.is_initializing() else _maybe_pipeline_mesh(cfg)
+        if pipe_mesh is not None and vector_ci:
+            raise NotImplementedError(
+                "per-row cache indices (speculative decoding) are not "
+                "supported through the pipeline engine — the microbatch "
+                "schedule would need per-microbatch index slicing; run the "
+                "draft/policy over data/fsdp/model axes instead"
+            )
         if pipe_mesh is not None:
             x, branch_input, new_cache, aux = self._pipelined_blocks(
                 pipe_mesh, x, attention_mask, positions, use_flash,

@@ -1,0 +1,324 @@
+"""Speculative decoding for rollout generation (draft-and-verify).
+
+Beyond the reference (whose generation hot loop is plain HF ``generate``,
+SURVEY.md §3.2): a small draft model proposes ``gamma`` tokens
+autoregressively, the target model scores all of them in ONE forward, and a
+rejection-sampling acceptance rule keeps a prefix — provably sampling from
+the target distribution (Leviathan et al. 2023; Chen et al. 2023). Per
+round the target runs one length-``gamma+1`` forward instead of up to
+``gamma+1`` single-token decodes, so rollout wall-clock approaches the
+draft's cost when the draft approximates the target well.
+
+TPU-first structure: the whole sampler is one jitted program — a
+``lax.while_loop`` over rounds with static shapes throughout. Rows accept
+different prefix lengths, so both KV caches use per-row write indices (the
+``[B]``-vector ``cache_index`` path of ``models/transformer.py::Attention``)
+and committed-token bookkeeping is per row. Rounds are stateless: each
+starts by re-feeding the last committed token (whose K/V the caches lack —
+it was sampled from a residual/bonus distribution, never forwarded), which
+also re-derives both models' next-token distributions, so no logits are
+carried across rounds and cache rewinds are just index arithmetic.
+
+Exactness properties (tested in ``tests/test_speculative.py``):
+
+- greedy (``do_sample=False``) output is bit-identical to the plain
+  sampler's greedy output, for ANY draft;
+- with draft == target every proposal is accepted (acceptance ratio 1);
+- returned logprobs/values are the TARGET's, with the same semantics as
+  :func:`trlx_tpu.ops.sampling.generate` (behavior logprob of the chosen
+  token under the unfiltered target distribution; value of the state the
+  token was sampled from), so PPO's ``make_experience`` is agnostic to
+  which sampler produced the rollout.
+
+The ``adjust_logits`` hook (ILQL) is not supported here — ILQL's reshaped
+sampling keeps the plain sampler.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.ops.sampling import GenerationConfig, GenerationOutput, process_logits
+
+
+def _filtered_probs(logits: jax.Array, config: GenerationConfig) -> jax.Array:
+    """The actual sampling distribution: temperature/top-k/top-p filtered
+    softmax (matches ``sample_token_from_logits``'s sampling path)."""
+    return jax.nn.softmax(
+        process_logits(logits, config.temperature, config.top_k, config.top_p),
+        axis=-1,
+    )
+
+
+def generate_speculative(
+    target_apply: Callable[..., Any],
+    target_params: Any,
+    draft_apply: Callable[..., Any],
+    draft_params: Any,
+    init_target_cache: Callable[[int, int], Any],
+    init_draft_cache: Callable[[int, int], Any],
+    input_ids: jax.Array,  # [B, P] left-padded prompts
+    attention_mask: jax.Array,  # [B, P]
+    rng: jax.Array,
+    config: GenerationConfig,
+    gamma: int = 4,
+    return_stats: bool = False,
+):
+    """Sample ``config.max_new_tokens`` continuations via draft-and-verify.
+
+    ``*_apply(params, input_ids, attention_mask, positions, cache,
+    cache_index, **kw)`` follow the model wrappers' ``__call__`` contract;
+    the target's outputs must include ``logits`` (+ ``value`` when a value
+    head is attached), the draft's just ``logits``. Fully jittable with
+    static ``config``/``gamma``.
+    """
+    if config.min_new_tokens > 0:
+        raise NotImplementedError(
+            "min_new_tokens is unsupported in speculative decoding"
+        )
+    B, P = input_ids.shape
+    N = config.max_new_tokens
+    G = gamma
+    NB = N + G + 1  # token buffer padded so block writes never clip
+    S = P + N + G  # cache slots: commits cap at P+N, probes run G past c-1
+    V_pad = config.pad_token_id
+    input_ids = input_ids.astype(jnp.int32)
+    prompt_mask = attention_mask.astype(jnp.int32)
+
+    t_cache = init_target_cache(B, S)
+    d_cache = init_draft_cache(B, S)
+
+    # ---- prefill both caches over the prompt block ----
+    slot0 = jnp.concatenate([prompt_mask, jnp.zeros((B, NB - 1), jnp.int32)], axis=1)
+    t_pre = target_apply(
+        target_params, input_ids, attention_mask=slot0, positions=None,
+        cache=t_cache, cache_index=jnp.asarray(0, jnp.int32), logits_span=(P - 1, P),
+    )
+    d_pre = draft_apply(
+        draft_params, input_ids, attention_mask=slot0, positions=None,
+        cache=d_cache, cache_index=jnp.asarray(0, jnp.int32), logits_span=(P - 1, P),
+    )
+
+    def round_step(carry):
+        rng, sub = jax.random.split(carry["rng"])
+        n_out = carry["n_out"]  # [B] committed generated tokens
+        done = carry["done"]
+        t_last = carry["t_last"]  # [B] last committed token (slot c-1)
+        c = P + n_out  # [B] next free slot per row
+
+        # slot mask for this round's forwards: committed slots + the G
+        # proposal slots [c, c+G) — slot-causality inside the models keeps
+        # stale/future slots invisible to each query
+        gen_slots = jnp.arange(NB - 1)[None, :]
+        committed = jnp.concatenate(
+            [prompt_mask, (gen_slots < n_out[:, None]).astype(jnp.int32)], axis=1
+        )
+        probe = (gen_slots >= n_out[:, None]) & (gen_slots < (n_out + G)[:, None])
+        mask_round = committed + jnp.concatenate(
+            [jnp.zeros((B, P), jnp.int32), probe.astype(jnp.int32)], axis=1
+        )
+
+        # ---- draft proposes G tokens (G single-token forwards, unrolled:
+        # G is small and static) ----
+        d_cache_r, tok_r = carry["d_cache"], t_last
+        d_toks = jnp.zeros((B, G), jnp.int32)
+        q_sel = jnp.zeros((B, G), jnp.float32)
+        # [B, G, V] full draft dists for the residual resample — f32: the
+        # rejection-sampling identity needs the SAME q as the accept test
+        # (a rounded copy would sample the extra token from rounding noise
+        # when p ≈ q, precisely the good-draft case)
+        q_probs = None
+        for j in range(G):
+            out_j = draft_apply(
+                draft_params, tok_r[:, None], attention_mask=mask_round,
+                positions=None, cache=d_cache_r, cache_index=c - 1 + j,
+            )
+            logits_j = out_j["logits"][:, -1, :].astype(jnp.float32)
+            probs_j = _filtered_probs(logits_j, config)
+            rng, rj = jax.random.split(rng)
+            if config.do_sample:
+                tok_r = jax.random.categorical(
+                    rj, jnp.log(jnp.maximum(probs_j, 1e-30)), axis=-1
+                ).astype(jnp.int32)
+            else:
+                tok_r = jnp.argmax(probs_j, axis=-1).astype(jnp.int32)
+            if q_probs is None:
+                q_probs = jnp.zeros((B, G) + probs_j.shape[-1:], jnp.float32)
+            d_toks = d_toks.at[:, j].set(tok_r)
+            q_sel = q_sel.at[:, j].set(
+                jnp.take_along_axis(probs_j, tok_r[:, None], axis=-1)[:, 0]
+            )
+            q_probs = q_probs.at[:, j].set(probs_j)
+            d_cache_r = out_j["cache"]
+        # one more draft forward to write d_G's K/V (logits discarded):
+        # after a fully-accepted round the NEXT round marks d_G's slot
+        # committed, and a zero-K/V hole there would quietly degrade every
+        # subsequent proposal — exactly in the high-acceptance regime
+        d_cache_new = draft_apply(
+            draft_params, tok_r[:, None], attention_mask=mask_round,
+            positions=None, cache=d_cache_r, cache_index=c - 1 + G,
+            logits_span=(0, 0),
+        )["cache"]
+
+        # ---- one target forward verifies everything ----
+        verify_in = jnp.concatenate([t_last[:, None], d_toks], axis=1)  # [B, G+1]
+        t_out = target_apply(
+            target_params, verify_in, attention_mask=mask_round,
+            positions=None, cache=carry["t_cache"], cache_index=c - 1,
+        )
+        t_cache_new = t_out["cache"]
+        t_logits = t_out["logits"].astype(jnp.float32)  # [B, G+1, V]
+        p_probs = _filtered_probs(t_logits, config)  # p_0 .. p_G
+        t_logprobs_all = jax.nn.log_softmax(t_logits, axis=-1)
+        t_values = t_out.get("value")
+        if t_values is None:
+            t_values = jnp.zeros(verify_in.shape, jnp.float32)
+        t_values = t_values.astype(jnp.float32)  # [B, G+1]
+
+        # ---- acceptance ----
+        p_sel = jnp.take_along_axis(
+            p_probs[:, :G, :], d_toks[..., None], axis=-1
+        )[..., 0]  # p_{i-1}(d_i), [B, G]
+        if config.do_sample:
+            rng, ru = jax.random.split(rng)
+            u = jax.random.uniform(ru, (B, G))
+            accept = u * q_sel <= p_sel
+        else:
+            accept = d_toks == jnp.argmax(p_probs[:, :G, :], axis=-1)
+        acc_prefix = jnp.cumprod(accept.astype(jnp.int32), axis=1)  # [B, G]
+        k = jnp.sum(acc_prefix, axis=1)  # accepted draft tokens per row
+
+        # extra token: residual resample at the rejection position, or a
+        # bonus sample from p_G when everything was accepted
+        p_row_at_k = jnp.take_along_axis(p_probs, k[:, None, None], axis=1)[:, 0, :]
+        if config.do_sample:
+            res_probs = jnp.maximum(p_probs[:, :G, :] - q_probs, 0.0)  # [B, G, V]
+            res_at_k = jnp.take_along_axis(
+                res_probs, jnp.minimum(k, G - 1)[:, None, None], axis=1
+            )[:, 0, :]
+            res_sum = jnp.sum(res_at_k, axis=-1, keepdims=True)
+            # bonus (k == G) samples p_G; degenerate residual (p == q
+            # exactly) also falls back to p — both are distribution-exact
+            extra_dist = jnp.where(
+                (k[:, None] < G) & (res_sum > 1e-20),
+                res_at_k / jnp.maximum(res_sum, 1e-20),
+                p_row_at_k,
+            )
+            rng, re = jax.random.split(rng)
+            extra_tok = jax.random.categorical(
+                re, jnp.log(jnp.maximum(extra_dist, 1e-30)), axis=-1
+            ).astype(jnp.int32)
+        else:
+            # greedy: the target would deterministically pick argmax p_k
+            extra_tok = jnp.argmax(p_row_at_k, axis=-1).astype(jnp.int32)
+
+        # ---- tentative committed block: d_1..d_k, extra ----
+        j_iota = jnp.arange(G + 1)[None, :]
+        block_toks = jnp.concatenate([d_toks, jnp.zeros((B, 1), jnp.int32)], axis=1)
+        block_toks = jnp.where(j_iota == k[:, None], extra_tok[:, None], block_toks)
+        block_lp = jnp.take_along_axis(
+            t_logprobs_all, block_toks[..., None], axis=-1
+        )[..., 0]  # log p_j(x_j) — target logprob of each committed token
+        block_val = t_values  # v before sampling x_j is at index j
+
+        valid = j_iota <= k[:, None]
+        # respect the N budget and prior completion
+        valid = valid & ((n_out[:, None] + j_iota) < N) & (~done[:, None])
+        if config.eos_token_id is not None:
+            is_eos = block_toks == config.eos_token_id
+            eos_before = jnp.cumsum(
+                jnp.pad(is_eos.astype(jnp.int32), ((0, 0), (1, 0)))[:, :-1], axis=1
+            )
+            valid = valid & (eos_before == 0)
+        commit_len = jnp.sum(valid.astype(jnp.int32), axis=1)  # [B]
+        block_toks_w = jnp.where(valid, block_toks, V_pad)
+        block_lp_w = jnp.where(valid, block_lp, 0.0)
+        block_val_w = jnp.where(valid, block_val, 0.0)
+        block_mask_w = valid.astype(jnp.int32)
+
+        # ---- per-row block write into the output buffers ----
+        def row_write(buf, blk, i):
+            return jax.vmap(
+                lambda b, x, o: jax.lax.dynamic_update_slice(b, x.astype(b.dtype), (o,))
+            )(buf, blk, i)
+
+        # never write past the buffer; done rows re-write pads over pads
+        off = jnp.minimum(n_out, NB - (G + 1))
+        tokens = row_write(carry["tokens"], block_toks_w, off)
+        logprobs = row_write(carry["logprobs"], block_lp_w, off)
+        values = row_write(carry["values"], block_val_w, off)
+        out_mask = row_write(carry["mask"], block_mask_w, off)
+
+        n_new = n_out + commit_len
+        done_new = done | (n_new >= N)
+        if config.eos_token_id is not None:
+            done_new = done_new | jnp.any(
+                (block_toks_w == config.eos_token_id) & (valid), axis=1
+            )
+        last_idx = jnp.maximum(commit_len - 1, 0)
+        t_last_new = jnp.where(
+            commit_len > 0,
+            jnp.take_along_axis(block_toks_w, last_idx[:, None], axis=1)[:, 0],
+            t_last,
+        )
+
+        return {
+            "rng": rng,
+            "n_out": n_new,
+            "done": done_new,
+            "t_last": t_last_new,
+            "t_cache": t_cache_new,
+            "d_cache": d_cache_new,
+            "tokens": tokens,
+            "logprobs": logprobs,
+            "values": values,
+            "mask": out_mask,
+            "rounds": carry["rounds"] + 1,
+            # accepted draft tokens this round, live rows only — k is
+            # PRE-truncation acceptance (budget/eos clipping is not
+            # rejection), so the rate reflects draft quality alone
+            "accepted": carry["accepted"] + jnp.sum(jnp.where(~done, k, 0)),
+            "live_rounds": carry["live_rounds"] + jnp.sum((~done).astype(jnp.int32)),
+        }
+
+    def cond(carry):
+        return ~jnp.all(carry["done"])
+
+    init = {
+        "rng": rng,
+        "n_out": jnp.zeros((B,), jnp.int32),
+        "done": jnp.zeros((B,), bool),
+        "t_last": input_ids[:, -1],
+        "t_cache": t_pre["cache"],
+        "d_cache": d_pre["cache"],
+        "tokens": jnp.full((B, NB), V_pad, jnp.int32),
+        "logprobs": jnp.zeros((B, NB), jnp.float32),
+        "values": jnp.zeros((B, NB), jnp.float32),
+        "mask": jnp.zeros((B, NB), jnp.int32),
+        "rounds": jnp.asarray(0, jnp.int32),
+        "accepted": jnp.asarray(0, jnp.int32),
+        "live_rounds": jnp.asarray(0, jnp.int32),
+    }
+    final = jax.lax.while_loop(cond, round_step, init)
+
+    tokens = final["tokens"][:, :N]
+    sequences = jnp.concatenate([input_ids, tokens], axis=1)
+    out = GenerationOutput(
+        sequences=sequences,
+        response_tokens=tokens,
+        response_mask=final["mask"][:, :N],
+        response_logprobs=final["logprobs"][:, :N],
+        response_values=final["values"][:, :N],
+        prompt_mask=prompt_mask,
+    )
+    if return_stats:
+        stats = {
+            "rounds": final["rounds"],
+            "accepted_draft_tokens": final["accepted"],
+            # fraction of proposed draft tokens accepted (per live row-round)
+            "acceptance_rate": final["accepted"]
+            / jnp.maximum(final["live_rounds"] * G, 1),
+        }
+        return out, stats
+    return out

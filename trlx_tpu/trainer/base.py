@@ -175,6 +175,38 @@ class TPUBaseTrainer(BaseRLTrainer):
             self.param_mask = trainable_mask(
                 params, self.tcfg, config.model.num_layers_unfrozen
             )
+        self.draft_module = self.draft_params = self.draft_tcfg = None
+        if config.model.draft_model_path and self.is_seq2seq:
+            logger.warning(
+                "model.draft_model_path is ignored for seq2seq models: "
+                "speculative decoding is implemented for causal LMs only"
+            )
+        if config.model.draft_model_path and self.mesh.shape.get("pipe", 1) > 1:
+            logger.warning(
+                "model.draft_model_path is ignored with pipeline parallelism "
+                "(pipe > 1): per-row cache rewinds don't fit the microbatch "
+                "schedule — rollouts use the plain sampler"
+            )
+        elif config.model.draft_model_path and not self.is_seq2seq:
+            from trlx_tpu.data.configs import ModelConfig as _MC
+
+            self.draft_module, draft_params, self.draft_tcfg = build_causal_lm(
+                _MC(
+                    model_path=config.model.draft_model_path,
+                    model_extra_kwargs=dict(config.model.draft_model_extra_kwargs),
+                ),
+                config.parallel,
+                head=None,
+                seed=config.train.seed + 1,
+            )
+            if self.draft_tcfg.vocab_size != self.tcfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {self.draft_tcfg.vocab_size} != policy vocab "
+                    f"{self.tcfg.vocab_size}: speculative decoding needs a "
+                    "same-tokenizer draft"
+                )
+            self.draft_params = shard_params(draft_params, self.mesh)
+
         default_lr = config.optimizer.kwargs.get("lr")
         self.schedule = get_scheduler(
             config.scheduler.name, dict(config.scheduler.kwargs), default_lr=default_lr
@@ -479,7 +511,44 @@ class TPUBaseTrainer(BaseRLTrainer):
                         adjust_logits=adjust,
                     )
 
+            elif self.draft_module is not None and adjust is None:
+                # speculative decoding: draft proposes, the policy verifies
+                # γ tokens per forward — lossless, so the rollout semantics
+                # (tokens/logprobs/values under the policy) are unchanged
+                from trlx_tpu.ops.speculative import generate_speculative
+
+                apply_fn = self._apply_fn()
+                draft_module = self.draft_module
+                draft_params = self.draft_params
+                tcfg, dcfg = self.tcfg, self.draft_tcfg
+                gamma = self.config.model.draft_gamma
+
+                def draft_apply(p, ids, **kw):
+                    return draft_module.apply({"params": p}, ids, **kw)
+
+                def fn(params, input_ids, attention_mask, rng):
+                    return generate_speculative(
+                        apply_fn,
+                        params,
+                        draft_apply,
+                        draft_params,
+                        lambda B, S: make_kv_cache(tcfg, B, S),
+                        lambda B, S: make_kv_cache(dcfg, B, S),
+                        input_ids,
+                        attention_mask,
+                        rng,
+                        gen_config,
+                        gamma=gamma,
+                    )
+
             else:
+                if self.draft_module is not None and adjust is not None:
+                    logger.warning(
+                        "draft_model_path set but this sampler has an "
+                        "adjust-logits hook (ILQL advantage reshaping or a "
+                        "logit mask): speculative decoding disabled for this "
+                        "generate path — rollouts use the plain sampler"
+                    )
                 apply_fn = self._apply_fn()
                 tcfg = self.tcfg
 
