@@ -1,0 +1,88 @@
+"""PPO sentiment steering with speculative rollout decoding.
+
+Same task as ``ppo_sentiments.py``, but rollout generation runs
+draft-and-verify (``trlx_tpu/ops/speculative.py``): a small same-tokenizer
+draft model proposes ``draft_gamma`` tokens per round and the policy scores
+them in one forward. The acceptance rule is lossless — rollouts are drawn
+from exactly the policy's distribution, so learning dynamics are unchanged;
+only wall-clock per collected sample drops (toward the draft's cost times
+1/acceptance-rate). Beyond the reference, whose hot loop is plain HF
+``generate`` (SURVEY.md §3.2).
+
+Model resolution mirrors ``ppo_sentiments.py``; the draft defaults to
+``distilgpt2`` (same GPT-2 tokenizer) with an offline fallback of a random
+tiny GPT-2 — useful for wiring checks, though a random draft's acceptance
+rate makes speculation pointless for actual speed (set ``DRAFT_PATH`` to a
+real distilled/small checkpoint of the policy's family).
+"""
+
+import os
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ppo_config
+
+from sentiment_util import get_positive_sentiment_fn, review_prompts
+
+
+def resolve_models():
+    path = os.environ.get("MODEL_PATH")
+    draft = os.environ.get("DRAFT_PATH")
+    if path:
+        return path, path, draft or "builtin:gpt2-test"
+    try:
+        from transformers import AutoConfig
+
+        AutoConfig.from_pretrained("lvwerra/gpt2-imdb")
+        AutoConfig.from_pretrained("distilgpt2")
+        return "lvwerra/gpt2-imdb", "lvwerra/gpt2-imdb", draft or "distilgpt2"
+    except Exception:
+        return "builtin:gpt2-small", "builtin:bytes", draft or "builtin:gpt2-test"
+
+
+def main(hparams=None):
+    model_path, tokenizer_path, draft_path = resolve_models()
+    sentiment = get_positive_sentiment_fn()
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            seq_length=128,
+            batch_size=32,
+            total_steps=10000,
+            eval_interval=100,
+            checkpoint_interval=10000,
+            checkpoint_dir="ckpts/ppo_speculative",
+        ),
+        model=dict(
+            model_path=model_path,
+            num_layers_unfrozen=2,
+            draft_model_path=draft_path,
+            draft_gamma=int(os.environ.get("DRAFT_GAMMA", 4)),
+        ),
+        tokenizer=dict(tokenizer_path=tokenizer_path),
+        method=dict(
+            num_rollouts=128,
+            chunk_size=128,
+            gen_kwargs=dict(max_new_tokens=40, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        return sentiment(samples)
+
+    return trlx.train(
+        reward_fn=reward_fn,
+        prompts=review_prompts(256, seed=0),
+        eval_prompts=review_prompts(64, seed=1),
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)

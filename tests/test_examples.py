@@ -384,6 +384,31 @@ def test_grpo_sentiments_smoke(tmp_path, monkeypatch):
     assert trainer.iter_count == 2
 
 
+def test_ppo_speculative_smoke(tmp_path, monkeypatch):
+    monkeypatch.delenv("MODEL_PATH", raising=False)
+    monkeypatch.delenv("DRAFT_PATH", raising=False)
+    import ppo_speculative
+
+    trainer = ppo_speculative.main(
+        {
+            "tokenizer.tokenizer_path": "builtin:bytes",
+            "train.total_steps": 2,
+            "train.epochs": 100,
+            "train.eval_interval": 2,
+            "train.batch_size": 8,
+            "train.seq_length": 48,
+            "train.checkpoint_dir": str(tmp_path / "ckpt"),
+            "model.model_path": "builtin:gpt2-test",
+            "method.num_rollouts": 8,
+            "method.chunk_size": 8,
+            "method.ppo_epochs": 1,
+            "method.gen_kwargs.max_new_tokens": 8,
+        }
+    )
+    assert trainer.iter_count == 2
+    assert trainer.draft_module is not None
+
+
 def test_grpo_moe_mixtral_smoke(tmp_path, monkeypatch):
     """GRPO on the MoE backbone with the expert axis active (EXPERT_PARALLEL=2
     on the 8-device CPU mesh) — router aux stats must ride the train stats."""

@@ -327,6 +327,53 @@ def test_moe_sft_e2e_loss_decreases(tmp_path):
     assert losses[-1] < losses[0]
 
 
+def test_mixtral_8x7b_config_partitions():
+    """Scale honesty for the MoE family (the dense analogue of the 6B/20B
+    tests in tests/test_scan.py): the real mixtral-8x7b preset (~47B params)
+    shape-initializes under scan_layers and its stacked expert kernels
+    partition over an 8-device fsdp×model×expert mesh — no weights
+    materialized."""
+    from trlx_tpu.data.configs import ParallelConfig
+    from trlx_tpu.models.heads import CausalLMWithValueHead
+    from trlx_tpu.parallel.mesh import make_mesh
+    from trlx_tpu.parallel.sharding import param_specs
+
+    cfg = TransformerConfig.mixtral("8x7b", scan_layers=True)
+    module = CausalLMWithValueHead(cfg)
+    shapes = jax.eval_shape(
+        lambda rng: module.init(rng, jnp.zeros((1, 8), jnp.int32))["params"],
+        jax.random.PRNGKey(0),
+    )
+    total = sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(shapes))
+    assert total > 45e9  # mixtral-8x7b really is ~47B params
+
+    mesh = make_mesh(ParallelConfig(data=1, fsdp=2, model=2, expert=2))
+    specs = param_specs(shapes, mesh)
+
+    def sharded_size(leaf, spec):
+        denom = 1
+        for axis in tuple(spec):
+            if axis is not None:
+                denom *= int(
+                    np.prod([mesh.shape[a] for a in (axis if isinstance(axis, tuple) else (axis,))])
+                )
+        return np.prod(leaf.shape) / denom
+
+    per_device = sum(
+        sharded_size(l, s)
+        for (_, l), (_, s) in zip(
+            jax.tree_util.tree_leaves_with_path(shapes),
+            jax.tree_util.tree_leaves_with_path(
+                specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)
+            ),
+        )
+    )
+    # expert kernels are ~27/28 of all params; they must shard 8-way
+    assert per_device < total / 6, f"per-device {per_device:.2e} vs total {total:.2e}"
+    w = specs["backbone"]["h_scan"]["block"]["mlp"]["w_gate"]
+    assert tuple(w) == ("pipe", "expert", "fsdp", "model")
+
+
 @pytest.mark.slow
 def test_moe_through_pipeline_parity():
     """MoE blocks through the GPipe schedule (pipe=2): logits and the router

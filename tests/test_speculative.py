@@ -218,6 +218,7 @@ def test_trainer_speculative_rollouts_e2e(tmp_path):
     trainer.add_prompt_pipeline(pipeline)
     trainer.make_experience(8)
     assert len(trainer.store) == 8
+    assert 0.0 <= trainer.make_experience_stats["rollout/spec_acceptance_rate"] <= 1.0
     trainer.prepare_learning()
     stats = trainer.train_step(next(iter(trainer.store.create_loader(8, shuffle=True))))
     assert np.isfinite(float(np.asarray(stats["losses/total_loss"])))

@@ -18,6 +18,12 @@ import numpy as np
 from trlx_tpu.models.transformer import TransformerConfig
 
 
+class UnsupportedHFExport(ValueError):
+    """Raised when an architecture has no transformers family mapping —
+    the one 'skip HF export, keep the native msgpack' case. Genuine
+    conversion bugs raise plain ValueError and must propagate."""
+
+
 def _t(x: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(x.T)
 
@@ -1006,7 +1012,7 @@ def hf_config_from_transformer(cfg):
             n_head=cfg.num_heads,
             layer_norm_epsilon=cfg.layer_norm_epsilon,
         )
-    raise ValueError(
+    raise UnsupportedHFExport(
         f"No HF export mapping for model_type={mt!r} "
         "(set TransformerConfig.model_type to an HF family)"
     )
@@ -1023,7 +1029,7 @@ def params_to_hf_state_dict(params: Dict[str, Any], cfg) -> Dict[str, np.ndarray
     from trlx_tpu.models.transformer import unstack_layer_params
 
     if cfg.model_type not in EXPORTERS:
-        raise ValueError(
+        raise UnsupportedHFExport(
             f"No HF exporter for model_type={cfg.model_type!r}; known: {sorted(EXPORTERS)}"
         )
     backbone = params.get("backbone", params)

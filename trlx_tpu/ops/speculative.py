@@ -101,7 +101,7 @@ def generate_speculative(
     )
 
     def round_step(carry):
-        rng, sub = jax.random.split(carry["rng"])
+        rng = carry["rng"]
         n_out = carry["n_out"]  # [B] committed generated tokens
         done = carry["done"]
         t_last = carry["t_last"]  # [B] last committed token (slot c-1)
@@ -183,7 +183,10 @@ def generate_speculative(
         if config.do_sample:
             rng, ru = jax.random.split(rng)
             u = jax.random.uniform(ru, (B, G))
-            accept = u * q_sel <= p_sel
+            # strict <: u ∈ [0,1) can be exactly 0, and `0·q <= 0` would
+            # accept a token with ZERO target probability (outside the
+            # target's top-k/top-p support). Accept iff u < p/q.
+            accept = u * q_sel < p_sel
         else:
             accept = d_toks == jnp.argmax(p_probs[:, :G, :], axis=-1)
         acc_prefix = jnp.cumprod(accept.astype(jnp.int32), axis=1)  # [B, G]

@@ -55,6 +55,7 @@ from trlx_tpu.utils.checkpoint import (
     restore_state,
     save_pretrained,
     save_state,
+    wait_for_saves,
 )
 from trlx_tpu.utils.trackers import make_tracker
 
@@ -176,6 +177,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                 params, self.tcfg, config.model.num_layers_unfrozen
             )
         self.draft_module = self.draft_params = self.draft_tcfg = None
+        self.last_spec_stats: Dict[str, float] = {}
         if config.model.draft_model_path and self.is_seq2seq:
             logger.warning(
                 "model.draft_model_path is ignored for seq2seq models: "
@@ -511,7 +513,11 @@ class TPUBaseTrainer(BaseRLTrainer):
                         adjust_logits=adjust,
                     )
 
-            elif self.draft_module is not None and adjust is None:
+            elif (
+                self.draft_module is not None
+                and adjust is None
+                and gen_config.min_new_tokens == 0
+            ):
                 # speculative decoding: draft proposes, the policy verifies
                 # γ tokens per forward — lossless, so the rollout semantics
                 # (tokens/logprobs/values under the policy) are unchanged
@@ -539,6 +545,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                         rng,
                         gen_config,
                         gamma=gamma,
+                        return_stats=True,
                     )
 
             else:
@@ -548,6 +555,12 @@ class TPUBaseTrainer(BaseRLTrainer):
                         "adjust-logits hook (ILQL advantage reshaping or a "
                         "logit mask): speculative decoding disabled for this "
                         "generate path — rollouts use the plain sampler"
+                    )
+                elif self.draft_module is not None and gen_config.min_new_tokens > 0:
+                    logger.warning(
+                        "draft_model_path set but min_new_tokens > 0 is "
+                        "unsupported by the speculative sampler — rollouts "
+                        "use the plain sampler"
                     )
                 apply_fn = self._apply_fn()
                 tcfg = self.tcfg
@@ -612,7 +625,21 @@ class TPUBaseTrainer(BaseRLTrainer):
             {"input_ids": input_ids, "attention_mask": np.asarray(attention_mask, np.int32)},
             self.mesh,
         )
-        return fn(self.state.params, batch["input_ids"], batch["attention_mask"], rng)
+        out = fn(self.state.params, batch["input_ids"], batch["attention_mask"], rng)
+        if type(out) is tuple:  # speculative sampler: (output, stats) —
+            # GenerationOutput itself is a NamedTuple, hence the exact check
+            out, spec_stats = out
+            # recorded for make_experience's stats (rollout observability:
+            # the knob this informs is model.draft_gamma)
+            self.last_spec_stats = {
+                "rollout/spec_acceptance_rate": float(
+                    np.asarray(jax.device_get(spec_stats["acceptance_rate"]))
+                ),
+                "rollout/spec_rounds": int(
+                    np.asarray(jax.device_get(spec_stats["rounds"]))
+                ),
+            }
+        return out
 
     def generate_eval(self, input_ids, attention_mask=None, **kwargs) -> GenerationOutput:
         return self.generate(input_ids, attention_mask, eval_mode=True, **kwargs)
@@ -841,6 +868,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                         subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
                         self.save(os.path.join(self.config.train.checkpoint_dir, subfolder))
                         tbar.close()
+                        wait_for_saves()  # async saves must land before exit
                         return results
 
                     self.tracker.log(stats, step=self.iter_count)
@@ -851,6 +879,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         if profiling:
             jax.profiler.stop_trace()
         tbar.close()
+        wait_for_saves()  # async saves must land before exit
         return results
 
     # ------------------------------------------------------------------

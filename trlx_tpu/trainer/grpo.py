@@ -168,6 +168,7 @@ class GRPOTrainer(PPOTrainer):
         self.mean_kl = kl_sum / max(kl_batches, 1)
         stats["policy/sqrt_ref_kl"] = float(np.sqrt(max(self.mean_kl, 0.0)))
         stats["time/exp_generate"] = gen_time_sum
+        stats.update(self.last_spec_stats)
         stats["time/exp_score"] = score_time_sum
         pooled = np.concatenate(all_scores) if all_scores else np.zeros((0,), np.float32)
         stats["exp_scores/mean"] = float(pooled.mean()) if pooled.size else 0.0

@@ -320,6 +320,7 @@ class PPOTrainer(TPUBaseTrainer):
             response_tokens = np.asarray(host_gen["response_tokens"])
             response_mask = np.asarray(host_gen["response_mask"])
             stats["time/exp_generate"] = time() - gen_time
+            stats.update(self.last_spec_stats)
 
             samples, prompts, outputs = self.decode(
                 prompt_ids, response_tokens, append_eos_token=True

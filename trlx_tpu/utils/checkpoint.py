@@ -16,18 +16,53 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+# Process-wide async checkpointer: device arrays are snapshotted
+# synchronously but serialization/IO runs on background threads, so the
+# train loop resumes immediately (the reference's accelerator.save_state
+# blocks; at multi-GB states that is seconds-to-minutes per interval).
+_ASYNC_CKPTR = None
 
-def save_state(directory: str, state: Any, extra: Optional[Dict] = None) -> None:
-    """Save a train-state pytree (+ small JSON ``extra``) to ``directory``."""
+
+def _async_checkpointer():
+    global _ASYNC_CKPTR
+    if _ASYNC_CKPTR is None:
+        import orbax.checkpoint as ocp
+
+        _ASYNC_CKPTR = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    return _ASYNC_CKPTR
+
+
+def wait_for_saves() -> None:
+    """Block until every in-flight async save has committed to disk. Called
+    before reads/overwrites of checkpoint directories and at end of
+    training — an unawaited final save could otherwise be lost with the
+    process."""
+    if _ASYNC_CKPTR is not None:
+        _ASYNC_CKPTR.wait_until_finished()
+
+
+def save_state(
+    directory: str, state: Any, extra: Optional[Dict] = None, async_save: bool = True
+) -> None:
+    """Save a train-state pytree (+ small JSON ``extra``) to ``directory``.
+
+    ``async_save`` returns as soon as the device arrays are snapshotted;
+    IO completes in the background (``wait_for_saves`` joins it).
+    """
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
     tree_dir = os.path.join(directory, "state")
+    # never rmtree under an in-flight write to the same tree
+    wait_for_saves()
     if os.path.exists(tree_dir):
         shutil.rmtree(tree_dir)
     os.makedirs(directory, exist_ok=True)
-    with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(tree_dir, state)
+    if async_save:
+        _async_checkpointer().save(tree_dir, state)
+    else:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(tree_dir, state)
     if extra is not None:
         with open(os.path.join(directory, "trainer_state.json"), "w") as f:
             json.dump(extra, f)
@@ -41,6 +76,7 @@ def restore_state(directory: str, template: Any) -> Any:
     """
     import orbax.checkpoint as ocp
 
+    wait_for_saves()  # the checkpoint being restored may still be in flight
     directory = os.path.abspath(directory)
     tree_dir = os.path.join(directory, "state")
 
@@ -101,9 +137,20 @@ def save_pretrained(
     # must survive without them.
     if getattr(transformer_config, "model_type", None) is not None:
         try:
-            from trlx_tpu.models.hf_interop import save_pretrained_hf
+            from trlx_tpu.models.hf_interop import UnsupportedHFExport, save_pretrained_hf
 
-            save_pretrained_hf(directory, host_params, transformer_config, tokenizer_path)
+            try:
+                save_pretrained_hf(
+                    directory, host_params, transformer_config, tokenizer_path
+                )
+            except UnsupportedHFExport as e:
+                # no transformers family mapping — the native msgpack export
+                # above stands alone; genuine conversion bugs still propagate
+                from trlx_tpu.utils import logging
+
+                logging.get_logger(__name__).warning(
+                    f"Skipping HF-format export ({e}); flax_model.msgpack was written"
+                )
         except ImportError as e:
             from trlx_tpu.utils import logging
 
