@@ -22,7 +22,8 @@ def test_task_table_covers_benchmark_sh_suite():
     # the reference suite: randomwalks anchors + the sentiment quartet
     assert {"ppo_randomwalks", "ilql_randomwalks", "ppo_sentiments",
             "ilql_sentiments", "sft_sentiments", "ppo_sentiments_t5",
-            "grpo_sentiments"} <= set(TASKS)
+            "grpo_sentiments", "dpo_sentiments", "grpo_moe_mixtral",
+            "ppo_speculative"} <= set(TASKS)
     for name, (script, _) in TASKS.items():
         assert os.path.exists(script), script
 

@@ -92,6 +92,32 @@ TASKS: Dict[str, Tuple[str, Dict[str, Any]]] = {
             "model.model_path": "builtin:gpt2-test", "tokenizer.tokenizer_path": "builtin:bytes",
         },
     ),
+    "dpo_sentiments": (
+        os.path.join(_EXAMPLES, "dpo_sentiments.py"),
+        {
+            "train.total_steps": 2, "train.batch_size": 4, "train.eval_interval": 2,
+            "train.seq_length": 48,
+            "model.model_path": "builtin:gpt2-test", "tokenizer.tokenizer_path": "builtin:bytes",
+        },
+    ),
+    "grpo_moe_mixtral": (
+        os.path.join(_EXAMPLES, "grpo_moe_mixtral.py"),
+        {
+            "train.total_steps": 2, "train.batch_size": 8, "train.eval_interval": 2,
+            "train.seq_length": 56, "method.num_rollouts": 8, "method.chunk_size": 8,
+            "method.group_size": 4, "method.ppo_epochs": 1,
+            "method.gen_kwargs.max_new_tokens": 8,
+        },
+    ),
+    "ppo_speculative": (
+        os.path.join(_EXAMPLES, "ppo_speculative.py"),
+        {
+            "train.total_steps": 2, "train.batch_size": 8, "train.eval_interval": 2,
+            "train.seq_length": 48, "method.num_rollouts": 8, "method.chunk_size": 8,
+            "method.ppo_epochs": 1, "method.gen_kwargs.max_new_tokens": 8,
+            "model.model_path": "builtin:gpt2-test", "tokenizer.tokenizer_path": "builtin:bytes",
+        },
+    ),
 }
 
 

@@ -15,6 +15,16 @@ from trlx_tpu.data.method_configs import MethodConfig, register_method
 IGNORE_INDEX = -100
 
 
+def _token_nll(logits: jax.Array, labels: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-token NLL with IGNORE_INDEX masking — the single definition of
+    the CE body, shared by the full and chunked loss paths."""
+    mask = (labels != IGNORE_INDEX).astype(jnp.float32)
+    safe = jnp.where(labels == IGNORE_INDEX, 0, labels)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return nll, mask
+
+
 @dataclass
 @register_method("SFTConfig")
 class SFTConfig(MethodConfig):
@@ -23,6 +33,11 @@ class SFTConfig(MethodConfig):
 
     name: str = "SFTConfig"
     gen_kwargs: Dict[str, Any] = field(default_factory=dict)
+    # stream the vocab projection + CE in T-chunks of this size instead of
+    # materializing [B, T, V] logits (0 = off). At BLOOM's 250k vocab the
+    # logits tensor dominates peak training memory; chunking bounds it at
+    # [B, logit_chunk, V] (backward rematerializes per chunk).
+    logit_chunk: int = 0
 
     def loss(
         self,
@@ -30,12 +45,54 @@ class SFTConfig(MethodConfig):
         labels: jax.Array,  # [B, T]; IGNORE_INDEX positions excluded
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
         # standard causal shift: logits at t predict labels at t+1
-        shift_logits = logits[:, :-1].astype(jnp.float32)
-        shift_labels = labels[:, 1:]
-        mask = (shift_labels != IGNORE_INDEX).astype(jnp.float32)
-        safe_labels = jnp.where(shift_labels == IGNORE_INDEX, 0, shift_labels)
-        logp = jax.nn.log_softmax(shift_logits, axis=-1)
-        token_nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        token_nll, mask = _token_nll(logits[:, :-1], labels[:, 1:])
         n = jnp.maximum(mask.sum(), 1.0)
         loss = jnp.sum(token_nll * mask) / n
+        return loss, {"losses/loss": loss, "losses/ppl": jnp.exp(loss)}
+
+    def chunked_loss(
+        self,
+        module,
+        params,
+        hidden: jax.Array,  # [B, T, E] final-normed hidden states
+        labels: jax.Array,  # [B, T]
+        chunk: int,
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """Numerically identical to :meth:`loss`, but the full ``[B, T, V]``
+        logits are never materialized: hidden chunks stream through the
+        model's ``project_logits`` under ``jax.checkpoint`` (forward AND
+        backward peak at ``[B, chunk, V]``)."""
+        shift_hidden = hidden[:, :-1]
+        shift_labels = labels[:, 1:]
+        B, T, E = shift_hidden.shape
+        # pad up to a chunk multiple (IGNORE_INDEX labels contribute
+        # nothing) so the chunk size is honored for ANY T — the shifted
+        # length T = seq_length - 1 is frequently odd/prime, and a
+        # divisor-only fallback would quietly degrade to token-at-a-time
+        C = min(chunk, T)
+        pad = (-T) % C
+        if pad:
+            shift_hidden = jnp.pad(shift_hidden, ((0, 0), (0, pad), (0, 0)))
+            shift_labels = jnp.pad(
+                shift_labels, ((0, 0), (0, pad)), constant_values=IGNORE_INDEX
+            )
+        n_chunks = (T + pad) // C
+        hc = shift_hidden.reshape(B, n_chunks, C, E).transpose(1, 0, 2, 3)
+        lc = shift_labels.reshape(B, n_chunks, C).transpose(1, 0, 2)
+
+        def body(carry, xs):
+            h, l = xs
+            logits = module.apply(
+                {"params": params}, h, method=type(module).project_logits
+            )
+            nll, m = _token_nll(logits, l)
+            s, n = carry
+            return (s + jnp.sum(nll * m), n + jnp.sum(m)), None
+
+        (s, n), _ = jax.lax.scan(
+            jax.checkpoint(body),
+            (jnp.asarray(0.0, jnp.float32), jnp.asarray(0.0, jnp.float32)),
+            (hc, lc),
+        )
+        loss = s / jnp.maximum(n, 1.0)
         return loss, {"losses/loss": loss, "losses/ppl": jnp.exp(loss)}

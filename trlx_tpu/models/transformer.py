@@ -1221,6 +1221,14 @@ class CausalTransformer(nn.Module):
         logits = self._logits(h if logits_span is None else h[:, logits_span[0] : logits_span[1]])
         return {"logits": logits, "hidden_states": h}
 
+    def project_logits(self, hidden: jax.Array) -> jax.Array:
+        """Vocab projection of (already final-normed) hidden states — lets
+        loss code stream chunks through the lm head instead of
+        materializing the full ``[B, T, V]`` logits (``SFTConfig.
+        chunked_loss``; the [B,T,V] tensor is the peak-memory item at
+        BLOOM-scale vocabularies)."""
+        return self._logits(hidden)
+
     def init_cache(self, batch_size: int, max_length: int, dtype=None) -> List[Dict[str, jax.Array]]:
         """Allocate an all-zeros KV cache pytree."""
         return make_kv_cache(self.config, batch_size, max_length, dtype)

@@ -16,6 +16,9 @@ from trlx_tpu.models.sft import SFTConfig
 from trlx_tpu.pipeline.offline_pipeline import DialogStore, tokenize_dialogue
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base import TPUBaseTrainer
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
 
 
 @register_trainer
@@ -39,6 +42,22 @@ class SFTTrainer(TPUBaseTrainer):
     def loss_fn(
         self, params: Any, batch: Dict[str, jax.Array], rng: jax.Array
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        chunk = getattr(self.config.method, "logit_chunk", 0)
+        if chunk and hasattr(type(self.module), "project_logits"):
+            # stream the vocab projection: logits_span=(0,0) returns hidden
+            # states with an empty logits tensor, chunked_loss does the rest
+            out = self.module.apply(
+                {"params": params},
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                logits_span=(0, 0),
+            )
+            return self.with_router_aux(
+                self.config.method.chunked_loss(
+                    self.module, params, out["hidden_states"], batch["labels"], chunk
+                ),
+                out,
+            )
         out = self.module.apply(
             {"params": params},
             batch["input_ids"],
@@ -49,6 +68,14 @@ class SFTTrainer(TPUBaseTrainer):
         )
 
     def prepare_learning(self) -> None:
+        chunk = getattr(self.config.method, "logit_chunk", 0)
+        if chunk and not hasattr(type(self.module), "project_logits"):
+            logger.warning(
+                "method.logit_chunk=%d is IGNORED: %s has no project_logits — "
+                "the full [B, T, V] logits will be materialized",
+                chunk,
+                type(self.module).__name__,
+            )
         self.train_dataloader = self.store.create_loader(
             self.config.train.batch_size, shuffle=True, seed=self.config.train.seed
         )
