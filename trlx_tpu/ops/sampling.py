@@ -42,6 +42,32 @@ class GenerationConfig:
         return GenerationConfig(**clean)
 
 
+def apply_transition_mask(
+    mask: jax.Array,  # [Vm, Vm'] bool: allowed next-token per last-token
+    last_tokens: jax.Array,  # [B] or [B, T] the conditioning token(s)
+    logits: jax.Array,  # [..., V] matching last_tokens' leading dims
+) -> jax.Array:
+    """Disallow transitions: ``mask[last, next] == False`` → −inf-ish logits.
+
+    Masks smaller than the vocab disallow out-of-range *next* tokens;
+    out-of-range *last* tokens (no transition row exists) sample
+    unconstrained rather than borrowing an unrelated row's constraints.
+    Shared by the step sampler's logit-mask hook and the speculative
+    decoder (both must agree exactly for lossless verification).
+    """
+    last = jnp.clip(last_tokens, 0, mask.shape[0] - 1)
+    sel = mask[last]  # [..., mask_vocab]
+    V = logits.shape[-1]
+    if mask.shape[1] >= V:  # mask over a padded/larger vocab: truncate
+        allowed = sel[..., :V]
+    else:  # mask narrower than vocab: out-of-range tokens disallowed
+        allowed = jnp.zeros(logits.shape, bool)
+        allowed = allowed.at[..., : mask.shape[1]].set(sel)
+    row_known = (last_tokens >= 0) & (last_tokens < mask.shape[0])
+    allowed = allowed | ~row_known[..., None]
+    return jnp.where(allowed, logits, -1e10)
+
+
 def process_logits(
     logits: jax.Array,  # [B, V]
     temperature: float,

@@ -30,8 +30,11 @@ Exactness properties (tested in ``tests/test_speculative.py``):
   token was sampled from), so PPO's ``make_experience`` is agnostic to
   which sampler produced the rollout.
 
-The ``adjust_logits`` hook (ILQL) is not supported here — ILQL's reshaped
-sampling keeps the plain sampler.
+Transition logit masks (the trainer's ``logit_mask``, e.g. randomwalks'
+allowed-moves table) compose natively: the mask is applied to the draft AND
+the target distributions, so constrained sampling stays lossless. The
+full ``adjust_logits`` hook (ILQL's Q-value reshaping needs per-position
+head outputs) is not supported — ILQL keeps the plain sampler.
 """
 
 from typing import Any, Callable, Optional
@@ -39,7 +42,12 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from trlx_tpu.ops.sampling import GenerationConfig, GenerationOutput, process_logits
+from trlx_tpu.ops.sampling import (
+    GenerationConfig,
+    GenerationOutput,
+    apply_transition_mask,
+    process_logits,
+)
 
 
 def _filtered_probs(logits: jax.Array, config: GenerationConfig) -> jax.Array:
@@ -64,6 +72,9 @@ def generate_speculative(
     config: GenerationConfig,
     gamma: int = 4,
     return_stats: bool = False,
+    transition_mask: Optional[jax.Array] = None,  # [Vm, Vm'] bool: the
+    # trainer's prev→next logit mask; applied identically to draft AND
+    # target so constrained sampling (e.g. randomwalks) stays lossless
 ):
     """Sample ``config.max_new_tokens`` continuations via draft-and-verify.
 
@@ -130,11 +141,14 @@ def generate_speculative(
         # when p ≈ q, precisely the good-draft case)
         q_probs = None
         for j in range(G):
+            prev = tok_r  # the token being fed — q_{j+1} conditions on it
             out_j = draft_apply(
                 draft_params, tok_r[:, None], attention_mask=mask_round,
                 positions=None, cache=d_cache_r, cache_index=c - 1 + j,
             )
             logits_j = out_j["logits"][:, -1, :].astype(jnp.float32)
+            if transition_mask is not None:
+                logits_j = apply_transition_mask(transition_mask, prev, logits_j)
             probs_j = _filtered_probs(logits_j, config)
             rng, rj = jax.random.split(rng)
             if config.do_sample:
@@ -169,6 +183,11 @@ def generate_speculative(
         )
         t_cache_new = t_out["cache"]
         t_logits = t_out["logits"].astype(jnp.float32)  # [B, G+1, V]
+        if transition_mask is not None:
+            # p_j conditions on verify position j's input token — identical
+            # masking to the plain sampler's logit-mask hook, so behavior
+            # logprobs below come from the same (masked) distribution
+            t_logits = apply_transition_mask(transition_mask, verify_in, t_logits)
         p_probs = _filtered_probs(t_logits, config)  # p_0 .. p_G
         t_logprobs_all = jax.nn.log_softmax(t_logits, axis=-1)
         t_values = t_out.get("value")

@@ -456,22 +456,14 @@ class TPUBaseTrainer(BaseRLTrainer):
         unrelated row's constraints."""
         if self.logit_mask is None:
             return adjust
+        from trlx_tpu.ops.sampling import apply_transition_mask
+
         mask = jnp.asarray(np.asarray(self.logit_mask), bool)
 
         def fn(step_out: Dict[str, Any], logits: jax.Array) -> jax.Array:
             if adjust is not None:
                 logits = adjust(step_out, logits)
-            last_tokens = step_out["last_tokens"]
-            last = jnp.clip(last_tokens, 0, mask.shape[0] - 1)
-            sel = mask[last]  # [B, mask_vocab]
-            V = logits.shape[-1]
-            if mask.shape[1] >= V:  # mask over a padded/larger vocab: truncate
-                allowed = sel[:, :V]
-            else:  # mask narrower than vocab: out-of-range tokens disallowed
-                allowed = jnp.zeros(logits.shape, bool).at[:, : mask.shape[1]].set(sel)
-            row_known = (last_tokens >= 0) & (last_tokens < mask.shape[0])
-            allowed = allowed | ~row_known[:, None]
-            return jnp.where(allowed, logits, -1e10)
+            return apply_transition_mask(mask, step_out["last_tokens"], logits)
 
         return fn
 
@@ -480,7 +472,8 @@ class TPUBaseTrainer(BaseRLTrainer):
     ) -> Callable:
         key = (gen_config, extra_kwargs)
         if key not in self._generate_fns:
-            adjust = self._compose_logit_mask(self.adjust_logits_fn(dict(extra_kwargs)))
+            algo_adjust = self.adjust_logits_fn(dict(extra_kwargs))
+            adjust = self._compose_logit_mask(algo_adjust)
             if self.is_seq2seq:
                 module = self.module
                 start_id = self.tcfg.decoder_start_token_id
@@ -515,7 +508,9 @@ class TPUBaseTrainer(BaseRLTrainer):
 
             elif (
                 self.draft_module is not None
-                and adjust is None
+                and algo_adjust is None  # transition logit_mask composes
+                # natively (applied to draft AND target); ILQL reshaping
+                # does not
                 and gen_config.min_new_tokens == 0
             ):
                 # speculative decoding: draft proposes, the policy verifies
@@ -528,6 +523,11 @@ class TPUBaseTrainer(BaseRLTrainer):
                 draft_params = self.draft_params
                 tcfg, dcfg = self.tcfg, self.draft_tcfg
                 gamma = self.config.model.draft_gamma
+                trans_mask = (
+                    jnp.asarray(np.asarray(self.logit_mask), bool)
+                    if self.logit_mask is not None
+                    else None
+                )
 
                 def draft_apply(p, ids, **kw):
                     return draft_module.apply({"params": p}, ids, **kw)
@@ -546,15 +546,16 @@ class TPUBaseTrainer(BaseRLTrainer):
                         gen_config,
                         gamma=gamma,
                         return_stats=True,
+                        transition_mask=trans_mask,
                     )
 
             else:
-                if self.draft_module is not None and adjust is not None:
+                if self.draft_module is not None and algo_adjust is not None:
                     logger.warning(
-                        "draft_model_path set but this sampler has an "
-                        "adjust-logits hook (ILQL advantage reshaping or a "
-                        "logit mask): speculative decoding disabled for this "
-                        "generate path — rollouts use the plain sampler"
+                        "draft_model_path set but this sampler reshapes "
+                        "logits (ILQL advantage reshaping): speculative "
+                        "decoding disabled for this generate path — rollouts "
+                        "use the plain sampler"
                     )
                 elif self.draft_module is not None and gen_config.min_new_tokens > 0:
                     logger.warning(
