@@ -96,7 +96,7 @@ TASKS: Dict[str, Tuple[str, Dict[str, Any]]] = {
         os.path.join(_EXAMPLES, "dpo_sentiments.py"),
         {
             "train.total_steps": 2, "train.batch_size": 4, "train.eval_interval": 2,
-            "train.seq_length": 48,
+            "train.seq_length": 48, "method.gen_kwargs.max_new_tokens": 8,
             "model.model_path": "builtin:gpt2-test", "tokenizer.tokenizer_path": "builtin:bytes",
         },
     ),

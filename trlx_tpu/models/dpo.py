@@ -37,6 +37,10 @@ class DPOConfig(MethodConfig):
     label_smoothing: float = 0.0
     reference_free: bool = False
     gen_kwargs: Dict[str, Any] = field(default_factory=dict)
+    # stream the vocab projection for completion logprobs in T-chunks of
+    # this size instead of materializing [B, T, V] logits (0 = off); same
+    # mechanism as SFTConfig.logit_chunk
+    logit_chunk: int = 0
 
     def loss(
         self,

@@ -154,7 +154,8 @@ class CausalLMWithILQLHeads(nn.Module):
         return self.backbone.init_cache(batch_size, max_length, dtype)
 
     def backbone_forward(
-        self, input_ids, attention_mask=None, positions=None, cache=None, cache_index=None
+        self, input_ids, attention_mask=None, positions=None, cache=None,
+        cache_index=None, logits_span=None,
     ):
         """Backbone-only forward (no heads) — the training loss gathers
         hidden states at action/state indices first and applies heads to the
@@ -166,7 +167,14 @@ class CausalLMWithILQLHeads(nn.Module):
             positions=positions,
             cache=cache,
             cache_index=cache_index,
+            logits_span=logits_span,
         )
+
+    def project_logits(self, hidden):
+        """Vocab projection of gathered hidden states — the loss projects
+        only the action positions instead of the full sequence, so the
+        ``[B, T, V]`` logits tensor is never materialized."""
+        return self.backbone.project_logits(hidden)
 
     def heads_on(self, hs_actions, hs_states):
         """Apply Q/target-Q heads at action positions, V head at states."""

@@ -62,37 +62,21 @@ class SFTConfig(MethodConfig):
         logits are never materialized: hidden chunks stream through the
         model's ``project_logits`` under ``jax.checkpoint`` (forward AND
         backward peak at ``[B, chunk, V]``)."""
-        shift_hidden = hidden[:, :-1]
-        shift_labels = labels[:, 1:]
-        B, T, E = shift_hidden.shape
-        # pad up to a chunk multiple (IGNORE_INDEX labels contribute
-        # nothing) so the chunk size is honored for ANY T — the shifted
-        # length T = seq_length - 1 is frequently odd/prime, and a
-        # divisor-only fallback would quietly degrade to token-at-a-time
-        C = min(chunk, T)
-        pad = (-T) % C
-        if pad:
-            shift_hidden = jnp.pad(shift_hidden, ((0, 0), (0, pad), (0, 0)))
-            shift_labels = jnp.pad(
-                shift_labels, ((0, 0), (0, pad)), constant_values=IGNORE_INDEX
-            )
-        n_chunks = (T + pad) // C
-        hc = shift_hidden.reshape(B, n_chunks, C, E).transpose(1, 0, 2, 3)
-        lc = shift_labels.reshape(B, n_chunks, C).transpose(1, 0, 2)
+        from trlx_tpu.ops.chunked import stream_projected_reduce
 
-        def body(carry, xs):
-            h, l = xs
-            logits = module.apply(
-                {"params": params}, h, method=type(module).project_logits
-            )
+        def body(carry, logits, l):
             nll, m = _token_nll(logits, l)
             s, n = carry
-            return (s + jnp.sum(nll * m), n + jnp.sum(m)), None
+            return s + jnp.sum(nll * m), n + jnp.sum(m)
 
-        (s, n), _ = jax.lax.scan(
-            jax.checkpoint(body),
+        s, n = stream_projected_reduce(
+            module,
+            params,
+            hidden[:, :-1],
+            [(labels[:, 1:], IGNORE_INDEX)],
+            chunk,
             (jnp.asarray(0.0, jnp.float32), jnp.asarray(0.0, jnp.float32)),
-            (hc, lc),
+            body,
         )
         loss = s / jnp.maximum(n, 1.0)
         return loss, {"losses/loss": loss, "losses/ppl": jnp.exp(loss)}

@@ -1,0 +1,59 @@
+"""Stream hidden states through the vocab projection in T-chunks.
+
+The ``[B, T, V]`` logits tensor is the peak-memory item of large-vocab
+training forwards (BLOOM's V = 250k). Losses that reduce over tokens
+(SFT cross-entropy, DPO completion logprobs) never need the whole tensor at
+once: this helper reshapes ``[B, T, ...]`` rows into chunks, projects each
+chunk via the model's ``project_logits``, and folds a caller-supplied
+reduction under ``jax.checkpoint`` — forward AND backward peak at
+``[B, chunk, V]``. One definition of the pad/reshape/scan machinery so the
+call sites (``models/sft.py::SFTConfig.chunked_loss``,
+``trainer/dpo.py::_completion_logps``) cannot drift apart.
+"""
+
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def stream_projected_reduce(
+    module,
+    params,
+    hidden: jax.Array,  # [B, T, E]
+    arrays: Sequence[Tuple[jax.Array, Any]],  # ([B, T] array, pad_value) ...
+    chunk: int,
+    init: Any,  # reduction carry init
+    body_fn: Callable[..., Any],  # (carry, logits, *chunk_arrays) -> carry
+) -> Any:
+    """Fold ``body_fn`` over T-chunks of projected logits.
+
+    ``arrays`` ride along chunk-aligned (padded with their declared pad
+    value, e.g. ``IGNORE_INDEX`` labels or a zero mask, so padding
+    contributes nothing to a well-formed reduction). The chunk size is
+    honored for ANY T via padding — T is frequently odd/prime after the
+    causal shift, and a divisor fallback would quietly degrade to
+    token-at-a-time.
+    """
+    B, T, E = hidden.shape
+    C = min(chunk, T)
+    pad = (-T) % C
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        arrays = [
+            (jnp.pad(a, ((0, 0), (0, pad)), constant_values=v), v)
+            for a, v in arrays
+        ]
+    n_chunks = (T + pad) // C
+    hc = hidden.reshape(B, n_chunks, C, E).transpose(1, 0, 2, 3)
+    acs = [a.reshape(B, n_chunks, C).transpose(1, 0, 2) for a, _ in arrays]
+
+    def body(carry, xs):
+        h, *rest = xs
+        logits = module.apply(
+            {"params": params}, h, method=type(module).project_logits
+        )
+        return body_fn(carry, logits, *rest), None
+
+    carry, _ = jax.lax.scan(jax.checkpoint(body), init, (hc, *acs))
+    return carry

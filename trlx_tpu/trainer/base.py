@@ -454,11 +454,10 @@ class TPUBaseTrainer(BaseRLTrainer):
         out-of-range *next* tokens; out-of-range *last* tokens (no transition
         row exists for them) sample unconstrained rather than borrowing an
         unrelated row's constraints."""
-        if self.logit_mask is None:
+        mask = self._logit_mask_array()
+        if mask is None:
             return adjust
         from trlx_tpu.ops.sampling import apply_transition_mask
-
-        mask = jnp.asarray(np.asarray(self.logit_mask), bool)
 
         def fn(step_out: Dict[str, Any], logits: jax.Array) -> jax.Array:
             if adjust is not None:
@@ -467,14 +466,21 @@ class TPUBaseTrainer(BaseRLTrainer):
 
         return fn
 
+    def _logit_mask_array(self) -> Optional[jax.Array]:
+        """The trainer's transition logit mask as a bool device array (one
+        conversion for the step-sampler hook and the speculative path)."""
+        if self.logit_mask is None:
+            return None
+        return jnp.asarray(np.asarray(self.logit_mask), bool)
+
     def _get_generate_fn(
         self, gen_config: GenerationConfig, extra_kwargs: Tuple[Tuple[str, Any], ...] = ()
     ) -> Callable:
         key = (gen_config, extra_kwargs)
         if key not in self._generate_fns:
             algo_adjust = self.adjust_logits_fn(dict(extra_kwargs))
-            adjust = self._compose_logit_mask(algo_adjust)
             if self.is_seq2seq:
+                adjust = self._compose_logit_mask(algo_adjust)
                 module = self.module
                 start_id = self.tcfg.decoder_start_token_id
 
@@ -513,6 +519,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                 # does not
                 and gen_config.min_new_tokens == 0
             ):
+                # no adjust hook here: the mask rides transition_mask below
                 # speculative decoding: draft proposes, the policy verifies
                 # γ tokens per forward — lossless, so the rollout semantics
                 # (tokens/logprobs/values under the policy) are unchanged
@@ -523,11 +530,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                 draft_params = self.draft_params
                 tcfg, dcfg = self.tcfg, self.draft_tcfg
                 gamma = self.config.model.draft_gamma
-                trans_mask = (
-                    jnp.asarray(np.asarray(self.logit_mask), bool)
-                    if self.logit_mask is not None
-                    else None
-                )
+                trans_mask = self._logit_mask_array()
 
                 def draft_apply(p, ids, **kw):
                     return draft_module.apply({"params": p}, ids, **kw)
@@ -565,6 +568,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                     )
                 apply_fn = self._apply_fn()
                 tcfg = self.tcfg
+                adjust = self._compose_logit_mask(algo_adjust)
 
                 def fn(params, input_ids, attention_mask, rng):
                     return generate(

@@ -30,15 +30,43 @@ from trlx_tpu.utils.stats import logprobs_of_labels
 logger = logging.get_logger(__name__)
 
 
-def _completion_logps(module, params, input_ids, attention_mask, out_mask):
+def _completion_logps(module, params, input_ids, attention_mask, out_mask, chunk=0):
     """Summed logprob of completion tokens per row: token t is predicted at
     position t-1; only positions with ``out_mask`` contribute. Also returns
-    the raw forward outputs (router aux losses for MoE policies)."""
+    the raw forward outputs (router aux losses for MoE policies).
+
+    With ``chunk`` > 0 the vocab projection streams in T-chunks through the
+    model's ``project_logits`` under ``jax.checkpoint`` — the ``[B, T, V]``
+    logits never materialize (DPO holds chosen AND rejected rows per pair,
+    doubling the logits footprint relative to SFT at the same batch)."""
+    sel = (out_mask[:, 1:] * attention_mask[:, 1:]).astype(jnp.float32)
+    labels = input_ids[:, 1:]
+    if chunk and hasattr(type(module), "project_logits"):
+        from trlx_tpu.ops.chunked import stream_projected_reduce
+
+        out = module.apply(
+            {"params": params}, input_ids, attention_mask=attention_mask,
+            logits_span=(0, 0),
+        )
+
+        def body(carry, logits, l, s):
+            lp = logprobs_of_labels(logits.astype(jnp.float32), l)
+            return carry + jnp.sum(lp * s, axis=1)
+
+        sums = stream_projected_reduce(
+            module,
+            params,
+            out["hidden_states"][:, :-1],
+            [(labels, 0), (sel, 0.0)],
+            chunk,
+            jnp.zeros((input_ids.shape[0],), jnp.float32),
+            body,
+        )
+        return sums, out
     out = module.apply({"params": params}, input_ids, attention_mask=attention_mask)
-    lp = logprobs_of_labels(out["logits"][:, :-1], input_ids[:, 1:])
+    lp = logprobs_of_labels(out["logits"][:, :-1], labels)
     # accumulate in fp32: a bf16 sum of hundreds of logprobs has an ulp of
     # O(1) nats — the same order as real DPO margins
-    sel = (out_mask[:, 1:] * attention_mask[:, 1:]).astype(jnp.float32)
     return jnp.sum(lp.astype(jnp.float32) * sel, axis=1), out
 
 
@@ -72,8 +100,11 @@ class DPOTrainer(TPUBaseTrainer):
         logger.info("Precomputing frozen-reference logprobs for %d pairs", len(self.store))
         from trlx_tpu.parallel import shard_batch
 
+        chunk = getattr(self.config.method, "logit_chunk", 0)
         ref_fn = jax.jit(
-            lambda p, ids, attn, out: _completion_logps(self.module, p, ids, attn, out)[0]
+            lambda p, ids, attn, out: _completion_logps(
+                self.module, p, ids, attn, out, chunk
+            )[0]
         )
         bs = min(self.config.train.batch_size, len(self.store))
         loader = self.store.create_loader(bs, shuffle=False, drop_last=False)
@@ -111,7 +142,7 @@ class DPOTrainer(TPUBaseTrainer):
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
         logps, out = _completion_logps(
             self.module, params, batch["input_ids"], batch["attention_mask"],
-            batch["out_mask"],
+            batch["out_mask"], getattr(self.config.method, "logit_chunk", 0),
         )
         refs = batch["ref_logps"]
         # interleaved pair layout: chosen at even rows, rejected at odd
@@ -126,6 +157,14 @@ class DPOTrainer(TPUBaseTrainer):
         )
 
     def prepare_learning(self) -> None:
+        chunk = getattr(self.config.method, "logit_chunk", 0)
+        if chunk and not hasattr(type(self.module), "project_logits"):
+            logger.warning(
+                "method.logit_chunk=%d is IGNORED: %s has no project_logits — "
+                "the full [B, T, V] logits will be materialized",
+                chunk,
+                type(self.module).__name__,
+            )
         if len(self.store) < self.config.train.batch_size:
             raise ValueError(
                 f"preference dataset has {len(self.store)} pairs but "

@@ -219,15 +219,19 @@ class ILQLTrainer(TPUBaseTrainer):
             )
             action_source = batch["decoder_input_ids"]
         else:
+            # logits_span=(0,0): only hidden states come back — the CE term
+            # needs logits at ACTION positions only, so the vocab projection
+            # runs on the gathered [B, A, E] hidden below instead of the
+            # full [B, T, V] tensor (the peak-memory item at large vocab)
             backbone_out = module.apply(
                 {"params": params},
                 batch["input_ids"],
                 attention_mask=batch["attention_mask"],
+                logits_span=(0, 0),
                 method=type(module).backbone_forward,
             )
             action_source = batch["input_ids"]
         hidden = backbone_out["hidden_states"]
-        logits_all = backbone_out["logits"]
 
         hs_actions = batched_index_select(hidden, batch["actions_ixs"])
         hs_states = batched_index_select(hidden, batch["states_ixs"])
@@ -237,7 +241,12 @@ class ILQLTrainer(TPUBaseTrainer):
             hs_states,
             method=type(module).heads_on,
         )
-        logits = batched_index_select(logits_all, batch["actions_ixs"])
+        if self.is_seq2seq:
+            logits = batched_index_select(backbone_out["logits"], batch["actions_ixs"])
+        else:
+            logits = module.apply(
+                {"params": params}, hs_actions, method=type(module).project_logits
+            )
         # the action token itself = the next token after the action index
         actions = jnp.take_along_axis(
             action_source[:, 1:], batch["actions_ixs"], axis=1
