@@ -293,13 +293,20 @@ class Seq2SeqLMWithILQLHeads(nn.Module):
         attention_mask=None,
         decoder_input_ids=None,
         decoder_attention_mask=None,
+        logits_span=None,
     ):
         return self.backbone(
             input_ids,
             attention_mask=attention_mask,
             decoder_input_ids=decoder_input_ids,
             decoder_attention_mask=decoder_attention_mask,
+            logits_span=logits_span,
         )
+
+    def project_logits(self, hidden):
+        """Vocab projection of gathered decoder hidden states (the ILQL loss
+        projects action positions only — see the causal twin)."""
+        return self.backbone.project_logits(hidden)
 
     def heads_on(self, hs_actions, hs_states):
         return self.ilql_heads.heads_on(hs_actions, hs_states)

@@ -400,6 +400,8 @@ class T5Transformer(nn.Module):
         cache: Optional[List[Dict[str, Any]]] = None,
         cache_index: Optional[jax.Array] = None,
         branch_layer: Optional[int] = None,
+        logits_span: Optional[Tuple[int, int]] = None,  # static [a, b) span of
+        # decoder positions to project ((0, 0) = hidden states only)
     ) -> Dict[str, Any]:
         cfg = self.config
         B, T = decoder_input_ids.shape
@@ -443,12 +445,20 @@ class T5Transformer(nn.Module):
 
         h = self.dec_ln_f(x)
         return {
-            "logits": self._logits(h),
+            "logits": self._logits(
+                h if logits_span is None else h[:, logits_span[0] : logits_span[1]]
+            ),
             "hidden_states": h,
             "pre_norm_hidden": x,
             "branch_input": branch_input,
             "cache": new_cache,
         }
+
+    def project_logits(self, hidden: jax.Array) -> jax.Array:
+        """Vocab projection of (already final-normed) decoder hidden states —
+        lets losses project gathered/chunked positions instead of the full
+        ``[B, T, V]`` tensor (mirrors ``CausalTransformer.project_logits``)."""
+        return self._logits(hidden)
 
     def __call__(
         self,
@@ -457,6 +467,7 @@ class T5Transformer(nn.Module):
         decoder_input_ids: Optional[jax.Array] = None,  # [B, T]
         decoder_attention_mask: Optional[jax.Array] = None,
         branch_layer: Optional[int] = None,
+        logits_span: Optional[Tuple[int, int]] = None,
     ) -> Dict[str, Any]:
         cfg = self.config
         B = input_ids.shape[0]
@@ -468,6 +479,7 @@ class T5Transformer(nn.Module):
         out = self.decode(
             decoder_input_ids, enc, attention_mask,
             decoder_mask=decoder_attention_mask, branch_layer=branch_layer,
+            logits_span=logits_span,
         )
         out["encoder_hidden"] = enc
         return out

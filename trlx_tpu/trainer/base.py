@@ -269,6 +269,25 @@ class TPUBaseTrainer(BaseRLTrainer):
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
         ...
 
+    def _resolved_logit_chunk(self) -> int:
+        """``method.logit_chunk`` when the module can stream the vocab
+        projection, else 0 — warning ONCE (and before any forward runs, so
+        DPO's whole-dataset reference precompute isn't silently full-size)."""
+        chunk = getattr(self.config.method, "logit_chunk", 0)
+        if not chunk:
+            return 0
+        if hasattr(type(self.module), "project_logits"):
+            return chunk
+        if not getattr(self, "_warned_logit_chunk", False):
+            self._warned_logit_chunk = True
+            logger.warning(
+                "method.logit_chunk=%d is IGNORED: %s has no project_logits — "
+                "the full [B, T, V] logits will be materialized",
+                chunk,
+                type(self.module).__name__,
+            )
+        return 0
+
     def with_router_aux(
         self,
         loss_stats: Tuple[jax.Array, Dict[str, Any]],

@@ -100,7 +100,7 @@ class DPOTrainer(TPUBaseTrainer):
         logger.info("Precomputing frozen-reference logprobs for %d pairs", len(self.store))
         from trlx_tpu.parallel import shard_batch
 
-        chunk = getattr(self.config.method, "logit_chunk", 0)
+        chunk = self._resolved_logit_chunk()
         ref_fn = jax.jit(
             lambda p, ids, attn, out: _completion_logps(
                 self.module, p, ids, attn, out, chunk
@@ -142,7 +142,7 @@ class DPOTrainer(TPUBaseTrainer):
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
         logps, out = _completion_logps(
             self.module, params, batch["input_ids"], batch["attention_mask"],
-            batch["out_mask"], getattr(self.config.method, "logit_chunk", 0),
+            batch["out_mask"], self._resolved_logit_chunk(),
         )
         refs = batch["ref_logps"]
         # interleaved pair layout: chosen at even rows, rejected at odd
@@ -157,14 +157,6 @@ class DPOTrainer(TPUBaseTrainer):
         )
 
     def prepare_learning(self) -> None:
-        chunk = getattr(self.config.method, "logit_chunk", 0)
-        if chunk and not hasattr(type(self.module), "project_logits"):
-            logger.warning(
-                "method.logit_chunk=%d is IGNORED: %s has no project_logits — "
-                "the full [B, T, V] logits will be materialized",
-                chunk,
-                type(self.module).__name__,
-            )
         if len(self.store) < self.config.train.batch_size:
             raise ValueError(
                 f"preference dataset has {len(self.store)} pairs but "

@@ -209,12 +209,14 @@ class ILQLTrainer(TPUBaseTrainer):
 
         if self.is_seq2seq:
             # decoder positions carry actions/states (reference seq2seq heads
-            # forward, ``modeling_ilql.py:396-427``)
+            # forward, ``modeling_ilql.py:396-427``); logits project at the
+            # gathered action positions only, like the causal path below
             backbone_out = module.apply(
                 {"params": params},
                 batch["input_ids"],
                 attention_mask=batch["attention_mask"],
                 decoder_input_ids=batch["decoder_input_ids"],
+                logits_span=(0, 0),
                 method=type(module).backbone_forward,
             )
             action_source = batch["decoder_input_ids"]
@@ -241,12 +243,9 @@ class ILQLTrainer(TPUBaseTrainer):
             hs_states,
             method=type(module).heads_on,
         )
-        if self.is_seq2seq:
-            logits = batched_index_select(backbone_out["logits"], batch["actions_ixs"])
-        else:
-            logits = module.apply(
-                {"params": params}, hs_actions, method=type(module).project_logits
-            )
+        logits = module.apply(
+            {"params": params}, hs_actions, method=type(module).project_logits
+        )
         # the action token itself = the next token after the action index
         actions = jnp.take_along_axis(
             action_source[:, 1:], batch["actions_ixs"], axis=1

@@ -42,8 +42,8 @@ class SFTTrainer(TPUBaseTrainer):
     def loss_fn(
         self, params: Any, batch: Dict[str, jax.Array], rng: jax.Array
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-        chunk = getattr(self.config.method, "logit_chunk", 0)
-        if chunk and hasattr(type(self.module), "project_logits"):
+        chunk = self._resolved_logit_chunk()
+        if chunk:
             # stream the vocab projection: logits_span=(0,0) returns hidden
             # states with an empty logits tensor, chunked_loss does the rest
             out = self.module.apply(
@@ -68,14 +68,7 @@ class SFTTrainer(TPUBaseTrainer):
         )
 
     def prepare_learning(self) -> None:
-        chunk = getattr(self.config.method, "logit_chunk", 0)
-        if chunk and not hasattr(type(self.module), "project_logits"):
-            logger.warning(
-                "method.logit_chunk=%d is IGNORED: %s has no project_logits — "
-                "the full [B, T, V] logits will be materialized",
-                chunk,
-                type(self.module).__name__,
-            )
+        self._resolved_logit_chunk()  # surface the ignored-knob warning early
         self.train_dataloader = self.store.create_loader(
             self.config.train.batch_size, shuffle=True, seed=self.config.train.seed
         )
