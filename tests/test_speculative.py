@@ -178,6 +178,41 @@ def test_sampling_first_token_distribution_matches_target():
     assert tv < 0.15, tv  # top_k=4, n=512 → noise floor ≈ 0.06
 
 
+def test_acceptance_rule_is_distribution_exact():
+    """The committed-token marginal of the rejection-sampling rule IS the
+    target distribution — checked against arbitrary enumerated p/q over a
+    tiny vocab, no models involved.
+
+    For gamma=1 the first committed token x_0 = d_1 if accepted else the
+    residual resample; the scheme guarantees P(x_0 = t) = p_0(t) exactly.
+    Monte-Carlo over the pure rule with d_1 ~ q_1 must match p_0 within
+    binomial noise."""
+    from trlx_tpu.ops.speculative import accept_and_extra
+
+    V, N = 5, 40_000
+    rs = np.random.RandomState(0)
+    # arbitrary, deliberately mismatched distributions (incl. a zero in p)
+    p0 = np.asarray([0.5, 0.0, 0.2, 0.25, 0.05])
+    p1 = np.ones(V) / V  # bonus dist (irrelevant to x_0's marginal)
+    q1 = np.asarray([0.1, 0.4, 0.1, 0.15, 0.25])
+
+    p_probs = jnp.broadcast_to(jnp.asarray(np.stack([p0, p1]), jnp.float32), (N, 2, V))
+    q_probs = jnp.broadcast_to(jnp.asarray(q1[None], jnp.float32), (N, 1, V))
+    d_toks = jnp.asarray(rs.choice(V, size=(N, 1), p=q1), jnp.int32)
+
+    k, extra, _ = jax.jit(accept_and_extra, static_argnums=(4,))(
+        p_probs, q_probs, d_toks, jax.random.PRNGKey(1), True
+    )
+    k, extra, d = np.asarray(k), np.asarray(extra), np.asarray(d_toks)[:, 0]
+    x0 = np.where(k >= 1, d, extra)
+    freq = np.bincount(x0, minlength=V) / N
+    # 4-sigma binomial bound per bucket
+    bound = 4 * np.sqrt(np.maximum(p0 * (1 - p0), 1e-4) / N)
+    assert np.all(np.abs(freq - p0) <= bound), (freq, p0, bound)
+    # the zero-probability target token must NEVER be committed as x_0
+    assert freq[1] == 0.0, freq
+
+
 def test_transition_mask_composes_losslessly():
     """A prev→next transition mask (the trainer logit_mask, e.g.
     randomwalks) applies to draft AND target: greedy masked speculative

@@ -59,6 +59,66 @@ def _filtered_probs(logits: jax.Array, config: GenerationConfig) -> jax.Array:
     )
 
 
+def accept_and_extra(
+    p_probs: jax.Array,  # [B, G+1, V] target dists p_0..p_G
+    q_probs: jax.Array,  # [B, G, V] draft dists q_1..q_G
+    d_toks: jax.Array,  # [B, G] draft proposals (d_i ~ q_i)
+    rng: jax.Array,
+    do_sample: bool,
+):
+    """The speculative acceptance rule as a pure function of distributions.
+
+    Returns ``(k, extra_tok, rng)``: ``k`` accepted draft tokens (the
+    committed block is ``d_1..d_k, extra``), the residual/bonus ``extra``
+    token, and the advanced rng (callers must thread it — reusing the input
+    rng would correlate later draws with the acceptance draws).
+    Sampling: accept ``d_i`` iff ``u·q_i(d_i) < p_{i-1}(d_i)``; on the first
+    rejection resample from ``norm(max(p−q, 0))``; after a full accept,
+    sample the bonus from ``p_G``. This is the Leviathan/Chen rejection
+    scheme — the marginal of every committed token is EXACTLY the target's
+    (machine-checked against enumerated distributions in
+    ``tests/test_speculative.py::test_acceptance_rule_is_distribution_exact``).
+    Greedy: accept iff ``d_i == argmax p_{i-1}``; extra = ``argmax p_k``.
+    """
+    B, G = d_toks.shape
+    q_sel = jnp.take_along_axis(q_probs, d_toks[..., None], axis=-1)[..., 0]
+    p_sel = jnp.take_along_axis(
+        p_probs[:, :G, :], d_toks[..., None], axis=-1
+    )[..., 0]  # p_{i-1}(d_i)
+    if do_sample:
+        rng, ru = jax.random.split(rng)
+        u = jax.random.uniform(ru, (B, G))
+        # strict <: u ∈ [0,1) can be exactly 0, and `0·q <= 0` would accept
+        # a token with ZERO target probability. Accept iff u < p/q.
+        accept = u * q_sel < p_sel
+    else:
+        accept = d_toks == jnp.argmax(p_probs[:, :G, :], axis=-1)
+    k = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+
+    p_row_at_k = jnp.take_along_axis(p_probs, k[:, None, None], axis=1)[:, 0, :]
+    if do_sample:
+        res_probs = jnp.maximum(p_probs[:, :G, :] - q_probs, 0.0)  # [B, G, V]
+        res_at_k = jnp.take_along_axis(
+            res_probs, jnp.minimum(k, G - 1)[:, None, None], axis=1
+        )[:, 0, :]
+        res_sum = jnp.sum(res_at_k, axis=-1, keepdims=True)
+        # bonus (k == G) samples p_G; degenerate residual (p == q exactly)
+        # also falls back to p — both are distribution-exact
+        extra_dist = jnp.where(
+            (k[:, None] < G) & (res_sum > 1e-20),
+            res_at_k / jnp.maximum(res_sum, 1e-20),
+            p_row_at_k,
+        )
+        rng, re = jax.random.split(rng)
+        extra_tok = jax.random.categorical(
+            re, jnp.log(jnp.maximum(extra_dist, 1e-30)), axis=-1
+        ).astype(jnp.int32)
+    else:
+        # greedy: the target would deterministically pick argmax p_k
+        extra_tok = jnp.argmax(p_row_at_k, axis=-1).astype(jnp.int32)
+    return k, extra_tok, rng
+
+
 def generate_speculative(
     target_apply: Callable[..., Any],
     target_params: Any,
@@ -134,7 +194,6 @@ def generate_speculative(
         # G is small and static) ----
         d_cache_r, tok_r = carry["d_cache"], t_last
         d_toks = jnp.zeros((B, G), jnp.int32)
-        q_sel = jnp.zeros((B, G), jnp.float32)
         # [B, G, V] full draft dists for the residual resample — f32: the
         # rejection-sampling identity needs the SAME q as the accept test
         # (a rounded copy would sample the extra token from rounding noise
@@ -160,9 +219,6 @@ def generate_speculative(
             if q_probs is None:
                 q_probs = jnp.zeros((B, G) + probs_j.shape[-1:], jnp.float32)
             d_toks = d_toks.at[:, j].set(tok_r)
-            q_sel = q_sel.at[:, j].set(
-                jnp.take_along_axis(probs_j, tok_r[:, None], axis=-1)[:, 0]
-            )
             q_probs = q_probs.at[:, j].set(probs_j)
             d_cache_r = out_j["cache"]
         # one more draft forward to write d_G's K/V (logits discarded):
@@ -195,45 +251,10 @@ def generate_speculative(
             t_values = jnp.zeros(verify_in.shape, jnp.float32)
         t_values = t_values.astype(jnp.float32)  # [B, G+1]
 
-        # ---- acceptance ----
-        p_sel = jnp.take_along_axis(
-            p_probs[:, :G, :], d_toks[..., None], axis=-1
-        )[..., 0]  # p_{i-1}(d_i), [B, G]
-        if config.do_sample:
-            rng, ru = jax.random.split(rng)
-            u = jax.random.uniform(ru, (B, G))
-            # strict <: u ∈ [0,1) can be exactly 0, and `0·q <= 0` would
-            # accept a token with ZERO target probability (outside the
-            # target's top-k/top-p support). Accept iff u < p/q.
-            accept = u * q_sel < p_sel
-        else:
-            accept = d_toks == jnp.argmax(p_probs[:, :G, :], axis=-1)
-        acc_prefix = jnp.cumprod(accept.astype(jnp.int32), axis=1)  # [B, G]
-        k = jnp.sum(acc_prefix, axis=1)  # accepted draft tokens per row
-
-        # extra token: residual resample at the rejection position, or a
-        # bonus sample from p_G when everything was accepted
-        p_row_at_k = jnp.take_along_axis(p_probs, k[:, None, None], axis=1)[:, 0, :]
-        if config.do_sample:
-            res_probs = jnp.maximum(p_probs[:, :G, :] - q_probs, 0.0)  # [B, G, V]
-            res_at_k = jnp.take_along_axis(
-                res_probs, jnp.minimum(k, G - 1)[:, None, None], axis=1
-            )[:, 0, :]
-            res_sum = jnp.sum(res_at_k, axis=-1, keepdims=True)
-            # bonus (k == G) samples p_G; degenerate residual (p == q
-            # exactly) also falls back to p — both are distribution-exact
-            extra_dist = jnp.where(
-                (k[:, None] < G) & (res_sum > 1e-20),
-                res_at_k / jnp.maximum(res_sum, 1e-20),
-                p_row_at_k,
-            )
-            rng, re = jax.random.split(rng)
-            extra_tok = jax.random.categorical(
-                re, jnp.log(jnp.maximum(extra_dist, 1e-30)), axis=-1
-            ).astype(jnp.int32)
-        else:
-            # greedy: the target would deterministically pick argmax p_k
-            extra_tok = jnp.argmax(p_row_at_k, axis=-1).astype(jnp.int32)
+        # ---- acceptance (the pure rejection-sampling rule) ----
+        k, extra_tok, rng = accept_and_extra(
+            p_probs, q_probs, d_toks, rng, config.do_sample
+        )
 
         # ---- tentative committed block: d_1..d_k, extra ----
         j_iota = jnp.arange(G + 1)[None, :]
