@@ -30,8 +30,12 @@ WORKER = textwrap.dedent(
 )
 
 
-@pytest.mark.slow
-def test_two_process_cpu_cluster(tmp_path):
+
+
+def _run_two_process(worker_src: str, extra_env=None, timeout=300, marker="OK"):
+    """Launch two coordinated worker processes and assert both print
+    ``marker <pid>``. One harness for every multihost test (port pick, env
+    plumbing, returncode/marker checks)."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -40,25 +44,97 @@ def test_two_process_cpu_cluster(tmp_path):
     procs = []
     for pid in range(2):
         env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
         env.update(
             TRLX_TPU_PLATFORM="cpu",
             TRLX_TPU_COORDINATOR=f"localhost:{port}",
             TRLX_TPU_NUM_PROCESSES="2",
             TRLX_TPU_PROCESS_ID=str(pid),
         )
-        # each process must see exactly its own CPU devices
-        env.pop("XLA_FLAGS", None)
+        env.update(extra_env or {})
         procs.append(
             subprocess.Popen(
-                [sys.executable, "-c", WORKER.format(repo=repo)],
+                [sys.executable, "-c", worker_src.format(repo=repo)],
                 env=env,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
                 text=True,
             )
         )
-    outs = [p.communicate(timeout=180)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:  # a hung worker must not orphan its peer
+            if p.poll() is None:
+                p.terminate()
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid}:\n{out[-2000:]}"
+        assert f"{marker} {pid}" in out, out[-2000:]
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_cpu_cluster(tmp_path):
+    outs = _run_two_process(WORKER, timeout=180, marker="PROC_OK")
+    for pid, out in enumerate(outs):
         # allgather over both processes: 1 + 2 = 3
         assert f"PROC_OK {pid} 3" in out, out[-2000:]
+
+
+MOE_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import trlx_tpu.trlx as trlx
+    trlx.initialize_runtime()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    assert jax.process_count() == 2 and jax.device_count() == 4
+    from trlx_tpu.data.configs import ParallelConfig
+    from trlx_tpu.parallel import make_mesh, set_global_mesh
+    from trlx_tpu.models.transformer import CausalTransformer, TransformerConfig
+
+    # data axis spans the two processes x expert axis the two local devices
+    mesh = make_mesh(ParallelConfig(data=2, expert=2))
+    set_global_mesh(mesh)
+    cfg = TransformerConfig.mixtral(
+        "test", dtype=jnp.float32, param_dtype=jnp.float32, num_experts=2
+    )
+    m = CausalTransformer(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 259, (4, 16)), jnp.int32)
+
+    def run():
+        params = m.init(jax.random.PRNGKey(0), ids[:1])["params"]
+        out = m.apply({{"params": params}}, ids)
+        return jnp.sum(out["logits"].astype(jnp.float32)), out["router_aux_loss"]
+
+    with mesh:
+        total, aux = jax.jit(run)()
+    # the summed scalar is replicated: readable on every process; allgather
+    # the HOST value to assert both processes ran the same global program
+    local = np.float32(jax.device_get(total))
+    from jax.experimental import multihost_utils
+    gathered = np.asarray(multihost_utils.process_allgather(local))
+    assert np.isfinite(gathered).all()
+    np.testing.assert_allclose(gathered[0], gathered[1], rtol=1e-6)
+    print("MOE_OK", jax.process_index(), float(local), flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_expert_parallel_forward(tmp_path):
+    """Expert parallelism ACROSS process boundaries: a 2-process CPU cluster
+    (2 local devices each) runs an MoE forward over a data(2-proc) ×
+    expert(2) mesh — the dispatch/combine collectives cross the process
+    fabric, the distributed analogue of a multi-host TPU pod's EP."""
+    _run_two_process(
+        MOE_WORKER,
+        extra_env={
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "JAX_COMPILATION_CACHE_DIR": "",  # per-process compiles, no races
+        },
+        timeout=300,
+        marker="MOE_OK",
+    )

@@ -178,6 +178,72 @@ def test_sampling_first_token_distribution_matches_target():
     assert tv < 0.15, tv  # top_k=4, n=512 → noise floor ≈ 0.06
 
 
+def test_cross_family_draft_greedy_exact():
+    """The draft can be a DIFFERENT architecture family (the practical case:
+    a small distilled draft) — only the vocab must match. Greedy parity must
+    still be bit-exact."""
+    kw = dict(model_extra_kwargs=dict(dtype=jnp.float32, param_dtype=jnp.float32))
+    t_mod, t_params, t_cfg = build_causal_lm(
+        ModelConfig("builtin:gpt2-test", **kw), head="value"
+    )
+    # llama-test: rotary + RMSNorm + GQA — nothing like gpt2, same 259 vocab
+    d_mod, d_params, d_cfg = build_causal_lm(
+        ModelConfig("builtin:llama-test", **kw), head=None, seed=5
+    )
+    assert d_cfg.vocab_size == t_cfg.vocab_size
+    t = (lambda p, i, **k: t_mod.apply({"params": p}, i, **k), t_params, t_cfg)
+    d = (lambda p, i, **k: d_mod.apply({"params": p}, i, **k), d_params, d_cfg)
+    ids, mask = _prompts()
+    cfg = GenerationConfig(
+        max_new_tokens=8, do_sample=False, eos_token_id=None, pad_token_id=258
+    )
+    t_apply, t_params, t_cfg = t
+    ref = generate(
+        t_apply, t_params, lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+        ids, mask, jax.random.PRNGKey(0), cfg,
+    )
+    out = _spec(t, d, ids, mask, cfg, gamma=3)
+    assert (np.asarray(out.response_tokens) == np.asarray(ref.response_tokens)).all()
+
+
+def test_grpo_rollouts_ride_speculative_sampler(tmp_path):
+    """GRPO inherits the speculative sampler through the shared generate
+    path: acceptance stats land in its make_experience stats."""
+    import trlx_tpu.trainer.grpo  # noqa: F401
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401
+    from trlx_tpu.data.default_configs import default_grpo_config
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+
+    config = default_grpo_config().evolve(
+        train=dict(
+            seq_length=24, batch_size=8, total_steps=2, eval_interval=10**6,
+            checkpoint_interval=10**6, save_best=False, tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+        ),
+        model=dict(
+            model_path="builtin:gpt2-test",
+            draft_model_path="builtin:gpt2-test",
+            draft_gamma=2,
+        ),
+        method=dict(
+            num_rollouts=8, chunk_size=8, group_size=4, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    trainer = get_trainer(config.train.trainer)(
+        config=config,
+        reward_fn=lambda samples, prompts, outputs, **kw: [float(len(o)) for o in outputs],
+        metric_fn=None, stop_sequences=[],
+    )
+    pipeline = get_pipeline(config.train.pipeline)(
+        ["hello", "world"] * 2, 12, trainer.tokenizer
+    )
+    trainer.add_prompt_pipeline(pipeline)
+    trainer.make_experience(8)
+    assert "rollout/spec_acceptance_rate" in trainer.make_experience_stats
+
+
 def test_acceptance_rule_is_distribution_exact():
     """The committed-token marginal of the rejection-sampling rule IS the
     target distribution — checked against arbitrary enumerated p/q over a
