@@ -123,3 +123,58 @@ def test_grpo_e2e(tmp_path):
     assert losses and all(np.isfinite(l) for l in losses)
     # grouped rollouts: store elements carry per-sequence advantages
     assert all(hasattr(e, "advantage") for e in trainer.store.history)
+
+
+def test_rloo_baseline_properties():
+    """RLOO: each advantage is the reward minus the leave-one-out mean of
+    the OTHER group members — algebraically (r_i - group_mean) * n/(n-1),
+    so per-group sums are identically zero."""
+    from trlx_tpu.models.grpo import group_advantages_np
+
+    rs = np.random.RandomState(0)
+    n, groups = 4, 3
+    scores = rs.randn(groups * n).astype(np.float32)
+    adv = group_advantages_np(scores, n, baseline="rloo")
+    g = scores.reshape(groups, n)
+    # algebraic identity: r_i - loo_mean_i == (r_i - group_mean) * n/(n-1)
+    expected = (g - g.mean(axis=1, keepdims=True)) * (n / (n - 1))
+    np.testing.assert_allclose(adv.reshape(groups, n), expected, rtol=1e-6)
+
+    with pytest.raises(ValueError):
+        group_advantages_np(scores, 1, baseline="rloo")
+    with pytest.raises(ValueError):
+        group_advantages_np(scores, n, baseline="nope")
+
+
+def test_rloo_e2e_smoke(tmp_path):
+    """GRPO trainer with baseline=rloo trains end to end."""
+    import trlx_tpu.trainer.grpo  # noqa: F401
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401
+    from trlx_tpu.data.default_configs import default_grpo_config
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+
+    config = default_grpo_config().evolve(
+        train=dict(
+            seq_length=24, batch_size=8, total_steps=2, eval_interval=10**6,
+            checkpoint_interval=10**6, save_best=False, tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+        ),
+        model=dict(model_path="builtin:gpt2-test"),
+        method=dict(
+            num_rollouts=8, chunk_size=8, group_size=4, ppo_epochs=1,
+            baseline="rloo",
+            gen_kwargs=dict(max_new_tokens=6, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    trainer = get_trainer(config.train.trainer)(
+        config=config,
+        reward_fn=lambda samples, prompts, outputs, **kw: [float(len(o)) for o in outputs],
+        metric_fn=None, stop_sequences=[],
+    )
+    pipeline = get_pipeline(config.train.pipeline)(["hi", "yo"] * 2, 8, trainer.tokenizer)
+    trainer.add_prompt_pipeline(pipeline)
+    trainer.make_experience(8)
+    trainer.prepare_learning()
+    stats = trainer.train_step(next(iter(trainer.store.create_loader(8, shuffle=True))))
+    assert np.isfinite(float(np.asarray(stats["losses/total_loss"])))

@@ -28,7 +28,11 @@ from trlx_tpu.utils.stats import get_tensor_stats
 
 
 def group_advantages_np(
-    scores: np.ndarray, group_size: int, scale: bool = True, eps: float = 1e-6
+    scores: np.ndarray,
+    group_size: int,
+    scale: bool = True,
+    eps: float = 1e-6,
+    baseline: str = "group",
 ) -> np.ndarray:
     """Per-sequence advantages from grouped rewards (host side, numpy).
 
@@ -36,12 +40,25 @@ def group_advantages_np(
     repeats each prompt ``group_size`` times in a row). ``scale=False``
     skips the per-group std division (the "Dr. GRPO" variant, which removes
     the difficulty bias of std normalization).
+
+    ``baseline="rloo"`` uses the leave-one-out mean of the OTHER group
+    members as each sequence's baseline (REINFORCE-Leave-One-Out, Kool et
+    al. 2019; Ahmadian et al. 2024) — an unbiased baseline, since a
+    sequence's own reward never appears in it. Requires ``group_size >= 2``
+    and ignores ``scale`` (RLOO is unscaled by definition).
     """
     if scores.shape[0] % group_size:
         raise ValueError(
             f"batch {scores.shape[0]} not divisible by group_size {group_size}"
         )
     g = scores.reshape(-1, group_size)
+    if baseline == "rloo":
+        if group_size < 2:
+            raise ValueError("rloo baseline needs group_size >= 2")
+        loo_mean = (g.sum(axis=1, keepdims=True) - g) / (group_size - 1)
+        return (g - loo_mean).reshape(-1).astype(np.float32)
+    if baseline != "group":
+        raise ValueError(f"unknown baseline '{baseline}' (group | rloo)")
     adv = g - g.mean(axis=1, keepdims=True)
     if scale:
         adv = adv / (g.std(axis=1, keepdims=True) + eps)
@@ -62,12 +79,16 @@ class GRPOConfig(PPOConfig):
         reference (k3 estimator) — replaces PPO's KL-shaped rewards.
     :param scale_advantage: divide group-centered rewards by the group std
         (True = original GRPO; False = Dr. GRPO).
+    :param baseline: ``"group"`` (group-mean baseline, GRPO) or ``"rloo"``
+        (leave-one-out mean — REINFORCE-Leave-One-Out; unbiased baseline,
+        no std scaling).
     """
 
     name: str = "GRPOConfig"
     group_size: int = 8
     beta: float = 0.04
     scale_advantage: bool = True
+    baseline: str = "group"
 
     def loss(
         self,

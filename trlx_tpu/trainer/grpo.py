@@ -49,6 +49,12 @@ class GRPOTrainer(PPOTrainer):
                 f"chunk_size {method.chunk_size} must be a multiple of "
                 f"group_size {method.group_size}"
             )
+        if method.baseline not in ("group", "rloo"):
+            raise ValueError(
+                f"unknown method.baseline '{method.baseline}' (group | rloo)"
+            )
+        if method.baseline == "rloo" and method.group_size < 2:
+            raise ValueError("baseline=rloo needs group_size >= 2")
         super().__init__(config, **kwargs)
         self.store = GRPORolloutStorage(self.tokenizer.pad_token_id)
 
@@ -141,7 +147,9 @@ class GRPOTrainer(PPOTrainer):
             self.running_moments.update(scores)  # logging only: the group
             # normalization below IS the reward scaling in GRPO
             all_scores.append(scores)
-            advantages = group_advantages_np(scores, G, method.scale_advantage)
+            advantages = group_advantages_np(
+                scores, G, method.scale_advantage, baseline=method.baseline
+            )
 
             # reference KL for logging (the loss recomputes it on device)
             lp, rlp = np.asarray(host["logprobs"]), np.asarray(host["ref_logprobs"])
