@@ -27,6 +27,9 @@ from trlx_tpu.utils import flatten_dict
 from trlx_tpu.utils.stats import get_tensor_stats
 
 
+BASELINES = ("group", "rloo")  # the one whitelist (trainer validation imports it)
+
+
 def group_advantages_np(
     scores: np.ndarray,
     group_size: int,
@@ -58,7 +61,7 @@ def group_advantages_np(
         loo_mean = (g.sum(axis=1, keepdims=True) - g) / (group_size - 1)
         return (g - loo_mean).reshape(-1).astype(np.float32)
     if baseline != "group":
-        raise ValueError(f"unknown baseline '{baseline}' (group | rloo)")
+        raise ValueError(f"unknown baseline '{baseline}'; known: {BASELINES}")
     adv = g - g.mean(axis=1, keepdims=True)
     if scale:
         adv = adv / (g.std(axis=1, keepdims=True) + eps)

@@ -49,12 +49,21 @@ class GRPOTrainer(PPOTrainer):
                 f"chunk_size {method.chunk_size} must be a multiple of "
                 f"group_size {method.group_size}"
             )
-        if method.baseline not in ("group", "rloo"):
+        from trlx_tpu.models.grpo import BASELINES
+
+        if method.baseline not in BASELINES:
             raise ValueError(
-                f"unknown method.baseline '{method.baseline}' (group | rloo)"
+                f"unknown method.baseline '{method.baseline}'; known: {BASELINES}"
             )
-        if method.baseline == "rloo" and method.group_size < 2:
-            raise ValueError("baseline=rloo needs group_size >= 2")
+        if method.baseline == "rloo":
+            if method.group_size < 2:
+                raise ValueError("baseline=rloo needs group_size >= 2")
+            if method.scale_advantage:
+                logger.warning(
+                    "baseline=rloo ignores scale_advantage (RLOO is unscaled "
+                    "by definition) — set method.scale_advantage: false to "
+                    "silence this"
+                )
         super().__init__(config, **kwargs)
         self.store = GRPORolloutStorage(self.tokenizer.pad_token_id)
 
