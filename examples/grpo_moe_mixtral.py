@@ -12,6 +12,14 @@ Defaults to the tiny ``builtin:mixtral-test`` preset so the script runs
 anywhere (CPU mesh included); point ``MODEL_PATH`` at a local Mixtral
 checkpoint directory to RLHF the real 8x7B (import is exact —
 ``tests/test_hf_export.py::test_roundtrip_exact_logits[mixtral]``).
+
+Capacity note: HF import pins ``moe_capacity_factor = num_experts`` so
+imported checkpoints reproduce HF logits exactly (drop-free routing), but
+that makes the dispatch/combine slot tensors multi-GB per layer at 8x7B
+scale. For *training* this script overrides it to ``MOE_CAPACITY``
+(default 2.0): overflow tokens are dropped — standard MoE training
+behavior; the Switch load-balance loss keeps drops rare. Set
+``MOE_CAPACITY=8`` to recover the drop-free parity setting.
 """
 
 import os
@@ -33,6 +41,13 @@ def main(hparams=None):
     model_path, tokenizer_path = resolve_model()
     sentiment = get_positive_sentiment_fn()
 
+    extra = dict(router_aux_coef=0.01, router_z_coef=0.001)
+    if os.environ.get("MODEL_PATH"):
+        # Override the drop-free import default (capacity = num_experts,
+        # needed only for exact-logit parity) with a training-throughput
+        # capacity; see the module docstring for the trade-off.
+        extra["moe_capacity_factor"] = float(os.environ.get("MOE_CAPACITY", 2.0))
+
     config = default_grpo_config().evolve(
         train=dict(
             seq_length=128,
@@ -46,7 +61,7 @@ def main(hparams=None):
             model_path=model_path,
             # router-loss weights are model knobs (TransformerConfig);
             # raise router_aux_coef if expert load collapses during RL
-            model_extra_kwargs=dict(router_aux_coef=0.01, router_z_coef=0.001),
+            model_extra_kwargs=extra,
         ),
         tokenizer=dict(tokenizer_path=tokenizer_path),
         # expert=2 partitions the experts; scale with the pod (e.g. a v4-32

@@ -10,10 +10,13 @@ only wall-clock per collected sample drops (toward the draft's cost times
 ``generate`` (SURVEY.md §3.2).
 
 Model resolution mirrors ``ppo_sentiments.py``; the draft defaults to
-``distilgpt2`` (same GPT-2 tokenizer) with an offline fallback of a random
-tiny GPT-2 — useful for wiring checks, though a random draft's acceptance
-rate makes speculation pointless for actual speed (set ``DRAFT_PATH`` to a
-real distilled/small checkpoint of the policy's family).
+``distilgpt2`` (same GPT-2 tokenizer) when the hub is reachable. Offline,
+policy and draft both fall back to the same random tiny GPT-2 so the
+draft-and-verify path runs as a wiring check (no speedup — set
+``DRAFT_PATH`` to a real distilled/small checkpoint of the policy's family
+for that). With ``MODEL_PATH`` set and no ``DRAFT_PATH``, rollouts use
+plain sampling: there is no builtin draft that shares a real checkpoint's
+vocab.
 """
 
 import os
@@ -28,7 +31,16 @@ def resolve_models():
     path = os.environ.get("MODEL_PATH")
     draft = os.environ.get("DRAFT_PATH")
     if path:
-        return path, path, draft or "builtin:gpt2-test"
+        # A draft must share the policy's tokenizer/vocab. The tiny byte-vocab
+        # builtin draft only matches a builtin test policy; for any other
+        # checkpoint, no DRAFT_PATH means plain sampling rather than a
+        # guaranteed vocab-mismatch error at trainer construction.
+        if not draft:
+            # every builtin *-test preset shares the 259-entry byte vocab, so
+            # the tiny builtin draft pairs with any of them
+            is_builtin_test = path.startswith("builtin:") and path.endswith("-test")
+            draft = "builtin:gpt2-test" if is_builtin_test else None
+        return path, path, draft
     try:
         from transformers import AutoConfig
 
@@ -36,7 +48,10 @@ def resolve_models():
         AutoConfig.from_pretrained("distilgpt2")
         return "lvwerra/gpt2-imdb", "lvwerra/gpt2-imdb", draft or "distilgpt2"
     except Exception:
-        return "builtin:gpt2-small", "builtin:bytes", draft or "builtin:gpt2-test"
+        # Offline wiring check: policy and draft are the same tiny builtin so
+        # vocabs match and the full draft-and-verify path executes (acceptance
+        # is near-1.0 with draft == policy, so no speedup — wiring only).
+        return "builtin:gpt2-test", "builtin:bytes", draft or "builtin:gpt2-test"
 
 
 def main(hparams=None):

@@ -199,3 +199,80 @@ def test_t5_lora_merged_on_export():
     merged = np.asarray(sd["decoder.block.0.layer.1.EncDecAttention.q.weight"]).T
     np.testing.assert_allclose(merged, expected, atol=1e-6)
     assert not any("lora" in k for k in sd)
+
+
+def test_push_to_hub_payload(tmp_path):
+    """``push_to_hub`` stages a complete ``save_pretrained`` export and hands
+    the staged directory to the upload step in one call (reference
+    capability: ``modeling_base.py:30`` inherits ``PushToHubMixin``).
+    Offline-safe: with ``uploader=`` injected, no network is touched."""
+    import json
+    import os
+
+    from trlx_tpu.utils.checkpoint import push_to_hub
+
+    _, params, cfg = build_causal_lm(
+        ModelConfig(model_path="builtin:gpt2-test"), head="value"
+    )
+    seen = {}
+
+    def uploader(repo_id, staged):
+        seen["repo_id"] = repo_id
+        seen["files"] = sorted(os.listdir(staged))
+        with open(os.path.join(staged, "trlx_tpu_config.json")) as f:
+            seen["config"] = json.load(f)
+        return f"local://{repo_id}"
+
+    url = push_to_hub(
+        "org/tiny-gpt2-rlhf",
+        params,
+        cfg,
+        tokenizer_path="builtin:bytes",
+        uploader=uploader,
+    )
+    assert url == "local://org/tiny-gpt2-rlhf"
+    assert seen["repo_id"] == "org/tiny-gpt2-rlhf"
+    # native export + HF torch export both present, so the published repo is
+    # loadable by plain transformers (value head under the v_head. prefix)
+    for name in ("flax_model.msgpack", "trlx_tpu_config.json", "pytorch_model.bin", "config.json"):
+        assert name in seen["files"], seen["files"]
+    assert seen["config"]["tokenizer_path"] == "builtin:bytes"
+
+
+def test_push_to_hub_staging_dir_persists(tmp_path):
+    """An explicit staging_dir keeps the export on disk after upload — the
+    manual-recovery path the error message points at."""
+    from trlx_tpu.utils.checkpoint import push_to_hub
+
+    _, params, cfg = build_causal_lm(ModelConfig(model_path="builtin:gpt2-test"))
+    staged_dir = str(tmp_path / "staged")
+    push_to_hub(
+        "org/x", params, cfg, staging_dir=staged_dir, uploader=lambda r, d: r
+    )
+    assert (tmp_path / "staged" / "flax_model.msgpack").exists()
+
+
+def test_push_to_hub_failure_keeps_staged_export(tmp_path):
+    """If the upload step fails after staging, the export survives for
+    manual recovery (the error log points at it) instead of vanishing with
+    the temp dir."""
+    import glob
+
+    from trlx_tpu.utils.checkpoint import push_to_hub
+
+    _, params, cfg = build_causal_lm(ModelConfig(model_path="builtin:gpt2-test"))
+
+    def boom(repo_id, staged):
+        raise ConnectionError("hub unreachable")
+
+    before = set(glob.glob("/tmp/trlx_tpu_hub_*"))
+    with pytest.raises(ConnectionError):
+        push_to_hub("org/x", params, cfg, uploader=boom)
+    kept = set(glob.glob("/tmp/trlx_tpu_hub_*")) - before
+    assert len(kept) == 1
+    import os
+    import shutil
+
+    staged = kept.pop()
+    assert os.path.exists(os.path.join(staged, "flax_model.msgpack"))
+    shutil.rmtree(staged)

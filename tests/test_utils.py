@@ -156,3 +156,18 @@ def test_log_rank_prefix_never_initializes_backend(monkeypatch):
     # no env: falls through to jax.distributed global state WITHOUT backend
     # init — uninitialized single-process state reads as rank 0
     assert tlog._process_index() == 0
+
+
+def test_version_consistent():
+    """pyproject.toml and the package __version__ must agree (round-3 verdict
+    flagged a 0.3.0 / 0.1.0 skew)."""
+    import os
+    import re
+
+    import trlx_tpu
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "pyproject.toml")) as f:
+        m = re.search(r'^version = "([^"]+)"', f.read(), re.M)
+    assert m, "pyproject.toml has no version field"
+    assert trlx_tpu.__version__ == m.group(1)

@@ -8,7 +8,7 @@ generation with on-device KL-to-reference, and fused pure-function losses
 inside a pjit'd train step.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 from trlx_tpu.trlx import train  # noqa: F401
 

@@ -649,6 +649,10 @@ class TPUBaseTrainer(BaseRLTrainer):
             {"input_ids": input_ids, "attention_mask": np.asarray(attention_mask, np.int32)},
             self.mesh,
         )
+        # cleared up front so stats only ever reflect the *current* rollout
+        # path — a later plain-sampler generate (ILQL adjust hook,
+        # min_new_tokens > 0) must not keep reporting a stale acceptance rate
+        self.last_spec_stats = {}
         out = fn(self.state.params, batch["input_ids"], batch["attention_mask"], rng)
         if type(out) is tuple:  # speculative sampler: (output, stats) —
             # GenerationOutput itself is a NamedTuple, hence the exact check
@@ -984,3 +988,14 @@ class TPUBaseTrainer(BaseRLTrainer):
             self.tcfg,
             tokenizer_path=self.config.tokenizer.tokenizer_path,
         )
+
+    def push_to_hub(self, repo_id: str, **kwargs) -> str:
+        """Publish the current policy weights to the HF Hub (reference:
+        ``modeling_base.py:30`` via ``PushToHubMixin``). Stages a full
+        ``save_pretrained`` export locally, then uploads it in one call;
+        see ``utils/checkpoint.py::push_to_hub`` for the offline/test
+        ``uploader=`` seam."""
+        from trlx_tpu.utils.checkpoint import push_to_hub
+
+        kwargs.setdefault("tokenizer_path", self.config.tokenizer.tokenizer_path)
+        return push_to_hub(repo_id, self.state.params, self.tcfg, **kwargs)

@@ -160,6 +160,75 @@ def save_pretrained(
             )
 
 
+def push_to_hub(
+    repo_id: str,
+    params: Any,
+    transformer_config,
+    tokenizer_path: Optional[str] = None,
+    private: bool = True,
+    commit_message: str = "Upload trlx_tpu model",
+    token: Optional[str] = None,
+    staging_dir: Optional[str] = None,
+    uploader=None,
+) -> str:
+    """Publish a ``save_pretrained`` export to the Hugging Face Hub
+    (reference capability: ``modeling_base.py:30`` inherits
+    ``transformers.utils.PushToHubMixin`` so wrapped models can
+    ``push_to_hub``).
+
+    Offline-safe by construction: the payload is always staged locally via
+    :func:`save_pretrained` first (``staging_dir``, or a temp dir), then
+    uploaded in one ``upload_folder`` call. ``uploader`` — a callable
+    ``(repo_id, staged_dir) -> url`` — replaces the network step for tests
+    or custom transports; without it ``huggingface_hub`` is required and a
+    missing install/token raises with a clear message instead of a partial
+    upload.
+
+    Returns the commit/repo URL reported by the upload step.
+    """
+    import shutil
+    import tempfile
+
+    api = None
+    if uploader is None:
+        # fail before the (potentially multi-GB, minutes-long) staging work,
+        # not after it
+        try:
+            from huggingface_hub import HfApi
+        except ImportError as e:
+            raise RuntimeError(
+                "push_to_hub needs the huggingface_hub package for the "
+                f"upload step ({e}); install it, or pass uploader= to "
+                "supply your own transport"
+            ) from e
+        api = HfApi(token=token)
+
+    staged = staging_dir or tempfile.mkdtemp(prefix="trlx_tpu_hub_")
+    cleanup = staging_dir is None
+    try:
+        save_pretrained(staged, params, transformer_config, tokenizer_path)
+        if uploader is not None:
+            return str(uploader(repo_id, staged))
+        api.create_repo(repo_id, private=private, exist_ok=True)
+        info = api.upload_folder(
+            repo_id=repo_id, folder_path=staged, commit_message=commit_message
+        )
+        return str(getattr(info, "commit_url", info))
+    except Exception:
+        # keep the staged export for manual recovery instead of deleting the
+        # very files the user would upload by hand
+        cleanup = False
+        from trlx_tpu.utils import logging
+
+        logging.get_logger(__name__).error(
+            f"push_to_hub failed after staging; export kept at {staged}"
+        )
+        raise
+    finally:
+        if cleanup:
+            shutil.rmtree(staged, ignore_errors=True)
+
+
 def load_pretrained_params(directory: str, template: Any) -> Any:
     """Load ``flax_model.msgpack`` into the structure of ``template``."""
     from flax import serialization
