@@ -1,0 +1,66 @@
+"""Regenerate ``benchmarks/perf_budgets.json`` from the current tree.
+
+Run after an *intentional* change to the hot programs (or to ratchet budgets
+down after an optimization):
+
+    python scripts/update_perf_budgets.py            # all configs
+    python scripts/update_perf_budgets.py gpt2_test  # just one
+
+Budgets are CPU-backend numbers (deterministic for a fixed jax/XLA install);
+``tests/test_perf_budget.py`` recomputes them on the same backend and fails
+on growth beyond tolerance. See ``trlx_tpu/perf.py``.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("TRLX_TPU_NO_TQDM", "1")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from trlx_tpu.perf import budget_configs, hot_program_costs  # noqa: E402
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks",
+    "perf_budgets.json",
+)
+
+
+def main() -> None:
+    only = set(sys.argv[1:])
+    existing = {}
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            existing = json.load(f)
+    budgets = existing.get("budgets", {})
+    for name, (config, shape) in budget_configs().items():
+        if only and name not in only:
+            continue
+        print(f"[{name}] compiling hot programs ...", flush=True)
+        costs = hot_program_costs(config, **shape)
+        budgets[name] = {"shape": shape, **costs}
+        for prog, c in costs.items():
+            print(
+                f"  {prog}: flops={c['flops']:.3e} bytes={c['bytes_accessed']:.3e} "
+                f"temp={c.get('temp_bytes', -1):.3e}"
+            )
+    payload = {
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "note": "XLA compiled-program budgets; regenerate with scripts/update_perf_budgets.py",
+        "budgets": budgets,
+    }
+    with open(OUT, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

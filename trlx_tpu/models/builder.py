@@ -158,9 +158,15 @@ def build_causal_lm(
     head: Optional[str] = None,  # None | "value" | "ilql"
     two_qs: bool = True,
     seed: int = 0,
+    abstract: bool = False,
 ) -> Tuple[Any, Dict[str, Any], TransformerConfig]:
     """Build module + params. Pretrained weights (HF torch) replace the
-    backbone subtree; heads stay freshly initialized."""
+    backbone subtree; heads stay freshly initialized.
+
+    ``abstract=True`` returns a ``ShapeDtypeStruct`` pytree instead of real
+    arrays (and skips any pretrained-weight load): enough to lower/compile
+    the training programs for cost/memory analysis without materializing a
+    multi-GB model (``trlx_tpu/perf.py``)."""
     tcfg, hf_path = resolve_transformer_config(model_config, parallel)
 
     if head == "value":
@@ -172,15 +178,22 @@ def build_causal_lm(
 
     rng = jax.random.PRNGKey(seed)
     dummy = jnp.zeros((1, 8), jnp.int32)
-    params = module.init(rng, dummy)["params"]
 
-    if head == "ilql":
-        # target-Q heads start as exact copies of the Q heads (reference
-        # deepcopies them at init, modeling_ilql.py:154) — training toward
-        # fresh random targets would be noise until many Polyak syncs.
-        from trlx_tpu.models.heads import sync_target_q_params
+    def make_params():
+        p = module.init(rng, dummy)["params"]
+        if head == "ilql":
+            # target-Q heads start as exact copies of the Q heads (reference
+            # deepcopies them at init, modeling_ilql.py:154) — training toward
+            # fresh random targets would be noise until many Polyak syncs.
+            from trlx_tpu.models.heads import sync_target_q_params
 
-        params = sync_target_q_params(params, alpha=1.0)
+            p = sync_target_q_params(p, alpha=1.0)
+        return p
+
+    if abstract:
+        return module, jax.eval_shape(make_params), tcfg
+
+    params = make_params()
 
     if hf_path is not None:
         from trlx_tpu.models.hf_interop import load_pretrained

@@ -1,0 +1,205 @@
+"""Hardware-free performance accounting for the hot programs.
+
+The reference validates performance empirically on live GPUs
+(``/root/reference/scripts/benchmark.sh:40-62``); on TPU, chip windows are
+scarce, so regressions need a net that runs anywhere. This module builds a
+trainer with **abstract weights** (``abstract_init=True`` — ShapeDtypeStruct
+pytrees, nothing materialized, so even multi-B-param configs cost ~no memory),
+lowers and compiles the three hot programs from SURVEY.md §3 —
+
+1. ``generate``  — the jitted rollout decode loop (dominant cost in PPO),
+2. ``score``     — the policy+frozen-reference scoring forward,
+3. ``train_step``— the full donated/grad-accum optimization step,
+
+— and reads XLA's compiled cost model (``cost_analysis()`` /
+``memory_analysis()``). The numbers are backend-specific (budgets here are
+CPU-backend numbers), but the *program* is the same one the trainer runs, so
+program-level regressions — an extra forward sneaking in, a lost logits-span
+restriction, a broken fusion, remat gone missing — show up as flop/byte
+jumps regardless of backend. ``tests/test_perf_budget.py`` asserts these
+against committed budgets (``benchmarks/perf_budgets.json``, regenerated via
+``scripts/update_perf_budgets.py``).
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from trlx_tpu.data.configs import TRLConfig
+
+# Program shapes: small enough to compile fast on one CPU core, large enough
+# that the per-token/per-layer structure (and its regressions) dominates.
+DEFAULT_SHAPE = dict(batch_size=8, prompt_len=32, gen_len=16)
+
+
+def _costs_of(lowered) -> Dict[str, float]:
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+        ca = ca[0]
+    out = {
+        "flops": float(ca.get("flops", -1.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", -1.0)),
+    }
+    try:
+        mem = compiled.memory_analysis()
+        out["temp_bytes"] = float(mem.temp_size_in_bytes)
+        out["argument_bytes"] = float(mem.argument_size_in_bytes)
+        out["output_bytes"] = float(mem.output_size_in_bytes)
+    except Exception:  # memory_analysis is optional on some backends
+        pass
+    return out
+
+
+def hot_program_costs(
+    config: TRLConfig,
+    batch_size: int = DEFAULT_SHAPE["batch_size"],
+    prompt_len: int = DEFAULT_SHAPE["prompt_len"],
+    gen_len: int = DEFAULT_SHAPE["gen_len"],
+    programs: Tuple[str, ...] = ("generate", "score", "train_step"),
+) -> Dict[str, Dict[str, float]]:
+    """Compile the hot programs of a PPO trainer for ``config`` with abstract
+    weights and return their XLA cost/memory analysis, keyed by program.
+
+    Works for any causal-LM config the trainer accepts — including configs
+    far too large to materialize on the analysis host (6B+ with
+    ``scan_layers``): only shapes flow through tracing and compilation.
+    """
+    from trlx_tpu.ops.sampling import GenerationConfig
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.ppo  # noqa: F401  (registers PPOTrainer)
+
+    cls = get_trainer(config.train.trainer)
+    trainer = cls(config, reward_fn=lambda **kw: [0.0], abstract_init=True)
+
+    B, P, N = batch_size, prompt_len, gen_len
+    SDS = jax.ShapeDtypeStruct
+    params = trainer.state.params
+    results: Dict[str, Dict[str, float]] = {}
+
+    if "generate" in programs:
+        gen_kwargs = dict(trainer.generate_kwargs)
+        gen_kwargs["max_new_tokens"] = N
+        gen_config = GenerationConfig.from_gen_kwargs(
+            gen_kwargs,
+            eos_token_id=trainer.tokenizer.eos_token_id,
+            pad_token_id=trainer.tokenizer.pad_token_id,
+        )
+        fn = trainer._get_generate_fn(gen_config, ())
+        results["generate"] = _costs_of(
+            fn.lower(
+                params,
+                SDS((B, P), np.int32),
+                SDS((B, P), np.int32),
+                jax.random.PRNGKey(0),
+            )
+        )
+
+    if "score" in programs:
+        fn = trainer._get_score_fn((B, P, N))
+        results["score"] = _costs_of(
+            fn.lower(
+                params,
+                trainer.ref_params,
+                SDS((B, P + N), np.int32),
+                SDS((B, P), np.int32),
+                SDS((B, N), np.int32),
+                SDS((B, N), np.int32),
+            )
+        )
+
+    if "train_step" in programs:
+        batch = {
+            "query_tensors": SDS((B, P), np.int32),
+            "query_mask": SDS((B, P), np.int32),
+            "response_tensors": SDS((B, N), np.int32),
+            "response_mask": SDS((B, N), np.int32),
+            "logprobs": SDS((B, N), np.float32),
+            "values": SDS((B, N), np.float32),
+            "rewards": SDS((B, N), np.float32),
+        }
+        fn = trainer._build_train_step()
+        results["train_step"] = _costs_of(fn.lower(trainer.state, batch))
+
+    return results
+
+
+def check_budget(
+    costs: Dict[str, Dict[str, float]],
+    budgets: Dict[str, Dict[str, float]],
+    flop_tol: float = 0.05,
+    byte_tol: float = 0.15,
+    stale_frac: float = 0.5,
+) -> Tuple[list, list]:
+    """Compare measured program costs against committed budgets.
+
+    Returns ``(violations, stale)``. A *violation* is a program whose flops
+    exceed budget by > ``flop_tol`` (flops are deterministic — any growth is
+    a program change) or whose bytes/temp memory exceed by > ``byte_tol``
+    (byte accounting wobbles more across XLA minor versions). *Stale* flags
+    programs now far **below** budget (> ``stale_frac`` improvement): not a
+    failure of the code, but the budget no longer guards anything — rerun
+    ``scripts/update_perf_budgets.py`` to ratchet it down.
+    """
+    tol = {"flops": flop_tol, "bytes_accessed": byte_tol, "temp_bytes": byte_tol}
+    violations, stale = [], []
+    for prog, budget in budgets.items():
+        if prog not in costs:
+            violations.append(f"{prog}: program missing from measurement")
+            continue
+        for metric, limit in budget.items():
+            if metric not in tol or limit <= 0:
+                continue
+            got = costs[prog].get(metric)
+            if got is None:
+                continue
+            if got > limit * (1.0 + tol[metric]):
+                violations.append(
+                    f"{prog}.{metric}: {got:.3e} exceeds budget {limit:.3e} "
+                    f"(+{100 * (got / limit - 1):.1f}%, tol {100 * tol[metric]:.0f}%)"
+                )
+            elif got < limit * stale_frac:
+                stale.append(
+                    f"{prog}.{metric}: {got:.3e} is {100 * (1 - got / limit):.1f}% "
+                    f"below budget {limit:.3e} — regenerate budgets to lock in the win"
+                )
+    return violations, stale
+
+
+def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
+    """The config matrix the perf net guards, name → (config, shape kwargs).
+
+    - ``gpt2_test``: tiny — exercised in the fast test tier so the net runs
+      in the <5-min loop;
+    - ``gpt2_small``: the flagship bench model (BASELINE.md);
+    - ``gptj_6b_scan``: the large-model path — scan_layers + full remat, the
+      program shape that runs on pods. Abstract weights: never materialized.
+    """
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    base = default_ppo_config()
+    return {
+        "gpt2_test": (
+            base.evolve(
+                model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "gpt2_small": (
+            base.evolve(
+                model=dict(model_path="builtin:gpt2-small", num_layers_unfrozen=2),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "gptj_6b_scan": (
+            base.evolve(
+                model=dict(model_path="builtin:gptj-6b", num_layers_unfrozen=2),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+                parallel=dict(scan_layers=True, remat="full"),
+            ),
+            dict(batch_size=2, prompt_len=32, gen_len=8),
+        ),
+    }

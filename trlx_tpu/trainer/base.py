@@ -132,8 +132,15 @@ class TPUBaseTrainer(BaseRLTrainer):
         reward_fn: Optional[Callable] = None,
         metric_fn: Optional[Callable] = None,
         stop_sequences: Optional[List[str]] = None,
+        abstract_init: bool = False,
         **kwargs,
     ):
+        # abstract_init: build the trainer with ShapeDtypeStruct weights —
+        # no parameter/optimizer arrays are ever materialized, but every
+        # jitted program (train step, generate, score) can still be lowered
+        # and compiled for cost/memory analysis (trlx_tpu/perf.py). Such a
+        # trainer can trace but never execute.
+        self.abstract_init = abstract_init
         super().__init__(config, reward_fn, metric_fn, stop_sequences, **kwargs)
         if config.train.batch_size % max(1, config.train.grad_accum) != 0:
             raise ValueError(
@@ -151,6 +158,10 @@ class TPUBaseTrainer(BaseRLTrainer):
         # ``accelerate_ppo_trainer.py:120-134``)
         self.is_seq2seq = config.model.model_arch_type == "seq2seq"
         if self.is_seq2seq:
+            if abstract_init:
+                raise NotImplementedError(
+                    "abstract_init is implemented for causal LMs only"
+                )
             from trlx_tpu.models.builder import build_seq2seq_lm, seq2seq_trainable_mask
 
             self.module, params, self.tcfg = build_seq2seq_lm(
@@ -171,8 +182,10 @@ class TPUBaseTrainer(BaseRLTrainer):
                 head=self.model_head,
                 two_qs=two_qs,
                 seed=config.train.seed,
+                abstract=abstract_init,
             )
-            params = shard_params(params, self.mesh)
+            if not abstract_init:
+                params = shard_params(params, self.mesh)
             self.param_mask = trainable_mask(
                 params, self.tcfg, config.model.num_layers_unfrozen
             )
@@ -200,6 +213,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                 config.parallel,
                 head=None,
                 seed=config.train.seed + 1,
+                abstract=abstract_init,
             )
             if self.draft_tcfg.vocab_size != self.tcfg.vocab_size:
                 raise ValueError(
@@ -207,7 +221,9 @@ class TPUBaseTrainer(BaseRLTrainer):
                     f"{self.tcfg.vocab_size}: speculative decoding needs a "
                     "same-tokenizer draft"
                 )
-            self.draft_params = shard_params(draft_params, self.mesh)
+            self.draft_params = (
+                draft_params if abstract_init else shard_params(draft_params, self.mesh)
+            )
 
         default_lr = config.optimizer.kwargs.get("lr")
         self.schedule = get_scheduler(
@@ -225,10 +241,13 @@ class TPUBaseTrainer(BaseRLTrainer):
         # replicate. Without out_shardings the compiler may leave the whole
         # state on one device — and checkpoint restore then commits that
         # placement, breaking later steps.
-        opt_shardings = _optimizer_state_shardings(
-            self.mesh, params, jax.eval_shape(self.optimizer.init, params)
-        )
-        opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
+        if abstract_init:
+            opt_state = jax.eval_shape(self.optimizer.init, params)
+        else:
+            opt_shardings = _optimizer_state_shardings(
+                self.mesh, params, jax.eval_shape(self.optimizer.init, params)
+            )
+            opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
         from jax.sharding import NamedSharding, PartitionSpec
 
         replicated = NamedSharding(self.mesh, PartitionSpec())

@@ -80,8 +80,17 @@ class PPOTrainer(TPUBaseTrainer):
             self._ref_module = T5Transformer(self.tcfg)
         else:
             if nlu > 0:
-                branch = hydra_ref_params(self.state.params, self.tcfg, nlu)
-                self.ref_params = jax.tree_util.tree_map(jnp.copy, branch)
+                if self.abstract_init:
+                    # shapes only — the branch slice traces fine under
+                    # eval_shape, and an abstract trainer never executes,
+                    # so no buffer-owning copy is needed
+                    self.ref_params = jax.eval_shape(
+                        lambda p: hydra_ref_params(p, self.tcfg, nlu),
+                        self.state.params,
+                    )
+                else:
+                    branch = hydra_ref_params(self.state.params, self.tcfg, nlu)
+                    self.ref_params = jax.tree_util.tree_map(jnp.copy, branch)
             else:
                 # head wrappers scope the transformer under "backbone";
                 # head-less policies (GRPO) are the bare transformer tree
@@ -90,7 +99,8 @@ class PPOTrainer(TPUBaseTrainer):
                     if "backbone" in self.state.params
                     else self.state.params
                 )
-                self.ref_params = jax.tree_util.tree_map(jnp.copy, backbone)
+                copy = (lambda x: x) if self.abstract_init else jnp.copy
+                self.ref_params = jax.tree_util.tree_map(copy, backbone)
             self._ref_module = CausalTransformer(self.tcfg)
 
         self.running_moments = RunningMoments()
