@@ -1,0 +1,92 @@
+"""6B-shaped composed-runtime e2e (round-3 verdict next#6).
+
+``eval_shape`` partition tests (``tests/test_scan.py``) prove the sharding
+*math* for real 6B/20B configs; this proves the composed *runtime* path: a
+48-layer tiny-hidden policy — the reference's large-model layer count lives
+in ``configs/nemo_configs/megatron_20b.yaml:53-54`` (pp=4, tp=4 over many
+layers) — trained for several real steps through scan_layers + pipe + fsdp
++ tp on the 8-device CPU mesh, with decreasing loss and a checkpoint
+round-trip through the same composed mesh.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_sft_config
+
+
+def _composed_config(tmp_path, total_steps):
+    return default_sft_config().evolve(
+        train=dict(
+            seq_length=32,
+            batch_size=8,
+            total_steps=total_steps,
+            epochs=100,
+            eval_interval=10000,
+            checkpoint_interval=10000,
+            checkpoint_dir=str(tmp_path / "ck"),
+            logging_dir=str(tmp_path / "logs"),
+            tracker="jsonl",
+        ),
+        model=dict(
+            model_path="builtin:gpt2-test",
+            num_layers_unfrozen=-1,
+            # 48 layers at tiny hidden: megatron_20b.yaml-shaped depth, CPU cost
+            model_extra_kwargs=dict(num_layers=48),
+        ),
+        tokenizer=dict(tokenizer_path="builtin:bytes"),
+        optimizer=dict(name="adamw", kwargs=dict(lr=3.0e-3)),
+        parallel=dict(pipe=2, fsdp=2, model=2, scan_layers=True, remat="minimal"),
+    )
+
+
+SAMPLES = [
+    "the movie was great and the acting was great",
+    "the film was terrible and the plot was terrible",
+    "a wonderful story with a wonderful cast",
+    "an awful script with an awful ending",
+] * 4
+
+
+@pytest.mark.slow
+def test_48layer_scan_pipe_fsdp_tp_e2e(tmp_path):
+    trainer = trlx.train(samples=SAMPLES, config=_composed_config(tmp_path, 6))
+    assert dict(trainer.mesh.shape)["pipe"] == 2
+    assert dict(trainer.mesh.shape)["fsdp"] == 2
+    assert dict(trainer.mesh.shape)["model"] == 2
+    assert trainer.tcfg.num_layers == 48 and trainer.tcfg.scan_layers
+
+    # decreasing loss over the run, from the tracker's JSONL stream
+    with open(os.path.join(str(tmp_path / "logs"), "stats.jsonl")) as f:
+        rows = [json.loads(line) for line in f]
+    losses = [r["losses/loss"] for r in rows if "losses/loss" in r]
+    assert len(losses) == 6
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-2:]) < np.mean(losses[:2]), losses
+
+    # checkpoint round-trip through the same composed mesh: a fresh trainer
+    # (constructed directly — no training step) restores params + step
+    trainer.save(str(tmp_path / "ck_final"))
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.sft  # noqa: F401  (registration)
+
+    cfg2 = _composed_config(tmp_path, 0)
+    trainer2 = get_trainer(cfg2.train.trainer)(config=cfg2)
+    trainer2.load(str(tmp_path / "ck_final"))
+    assert int(trainer2.iter_count) == 6
+
+    a = jax_leaves_checksum(trainer.state.params)
+    b = jax_leaves_checksum(trainer2.state.params)
+    np.testing.assert_allclose(a, b, rtol=0, atol=0)
+
+
+def jax_leaves_checksum(tree):
+    import jax
+
+    return np.array(
+        [float(np.asarray(jax.device_get(x)).astype(np.float64).sum()) for x in jax.tree_util.tree_leaves(tree)]
+    )

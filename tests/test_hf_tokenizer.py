@@ -28,6 +28,10 @@ TEXT = st.text(
 )
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)  # hypothesis re-enters per example; one disk load each config
 def _tok(padding_side="left", truncation_side="right") -> HFTokenizer:
     tok = from_config(TokenizerConfig(FIXTURE, padding_side, truncation_side))
     assert isinstance(tok, HFTokenizer)
