@@ -369,3 +369,85 @@ def test_asha_lone_survivor_runs_at_max_t(tmp_path):
     final = [r for r in records if r["rung"] == 1]
     assert len(final) == 1
     assert final[0]["hparams"]["train.total_steps"] == 18
+
+
+@pytest.mark.slow
+def test_two_process_trials_dispatch(tmp_path):
+    """Cluster-dispatch leg (round-3 verdict next#7, reference
+    ``trlx/sweep.py:267-348`` Ray placement): each trial runs as its OWN
+    2-process ``jax.distributed`` cluster over the
+    TRLX_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID contract, placed through a
+    command-template launcher (env(1) carries the per-process contract the
+    way a remote shell would), rank 0 the only result writer."""
+    import textwrap
+
+    script = tmp_path / "trial_script.py"
+    script.write_text(
+        textwrap.dedent(
+            """
+            import json, os, sys
+
+            def main(hparams):
+                import trlx_tpu.trlx as trlx
+                trlx.initialize_runtime()
+                import jax
+                import jax.numpy as jnp
+                from jax.experimental import multihost_utils
+
+                assert jax.process_count() == 2, jax.process_count()
+                total = multihost_utils.process_allgather(
+                    jnp.asarray(1.0 + jax.process_index())
+                )
+                # metric depends on the swept hparam AND the collective
+                metric = float(total.sum()) * float(hparams["optimizer.kwargs.lr"])
+                if jax.process_index() == 0:
+                    with open(os.environ["TRLX_TPU_SWEEP_RESULT"], "w") as f:
+                        json.dump(
+                            {"stats": {"reward/mean": metric}, "iter_count": 1}, f
+                        )
+
+            if __name__ == "__main__":
+                main(json.loads(sys.argv[1]))
+            """
+        )
+    )
+    config = {
+        "tune_config": {
+            "mode": "max",
+            "metric": "reward/mean",
+            "search_alg": "quasirandom",
+            "num_samples": 2,
+            "procs_per_trial": 2,
+            "launcher": "env {env} {python} {script} {hparams}",
+        },
+        "optimizer.kwargs.lr": {"strategy": "loguniform", "values": [1e-4, 1e-3]},
+    }
+    records = run_sweep(
+        str(script),
+        config,
+        str(tmp_path / "out"),
+        trial_timeout=600,
+        extra_env={"TRLX_TPU_PLATFORM": "cpu", "TRLX_TPU_NO_TQDM": "1"},
+    )
+    assert len(records) == 2
+    for r in records:
+        log = open(str(tmp_path / "out" / f"trial_{r['trial']:03d}.log")).read()
+        assert r["rc"] == 0, log[-2000:]
+        # allgather total = 1 + 2 = 3; metric = 3 * lr from the result file
+        lr = r["hparams"]["optimizer.kwargs.lr"]
+        assert abs(r["metric"] - 3.0 * lr) < 1e-9, (r["metric"], lr)
+    assert [r["metric"] for r in records] == sorted(
+        (r["metric"] for r in records), reverse=True
+    )
+
+
+def test_hosts_require_launcher(tmp_path):
+    with pytest.raises(ValueError, match="launcher"):
+        run_sweep(
+            __file__,
+            {
+                "tune_config": {"hosts": ["a", "b"]},
+                "x": {"strategy": "uniform", "values": [0.0, 1.0]},
+            },
+            str(tmp_path / "out2"),
+        )

@@ -33,6 +33,29 @@ density ratio. Schedulers: ``fifo`` (every trial runs its full budget) or
 ``asha``/``hyperband`` — successive halving over a budget dot-path (the
 reference's Ray HyperBandScheduler capability, adapted to sequential
 subprocess trials: promotions rerun at the larger budget).
+
+Cluster dispatch (the reference's Ray trial placement,
+``trlx/sweep.py:267-348``), all via ``tune_config``:
+
+- ``launcher``: shell-line template used to start each trial process,
+  e.g. ``"ssh -tt {host} env {env_remote} {python} {script}
+  {hparams_remote}"`` — ``{env}``/``{env_remote}`` expand to the trial's
+  ``TRLX_TPU_*`` contract (+ ``PYTHONPATH``) as ``k=v`` assignments (remote
+  shells don't inherit the sweep's environment); the ``_remote`` variants
+  carry an extra quoting layer that survives the remote shell's re-split,
+  and ``-tt`` makes a terminated ssh client hang up the remote trial;
+- ``hosts``: list cycled over trials, each entry a host or a
+  comma-separated group (one process per pod host, coordinator on the
+  first). Accelerator trials parallelize across hosts up to one in-flight
+  trial per host (clamped);
+- ``procs_per_trial``: spawn N coordinated processes per trial over the
+  ``TRLX_TPU_COORDINATOR``/``NUM_PROCESSES``/``PROCESS_ID`` multi-host
+  contract (one trial = one jax.distributed cluster; rank 0 writes the
+  result file).
+
+Results flow through ``TRLX_TPU_SWEEP_RESULT`` paths under the sweep's
+output dir, so remote hosts must share that filesystem (NFS/GCS-fuse — the
+standard pod setup; Ray ships results through its object store instead).
 """
 
 import argparse
@@ -278,6 +301,121 @@ class Searcher:
         return np.log(dens + 1e-12)
 
 
+_PORT_LOCK = threading.Lock()
+_PORT_COUNTER = itertools.count(29500 + (os.getpid() % 997))
+
+
+def _next_coordinator_port() -> int:
+    """Sweep-unique coordinator port. A bind-then-release probe would race
+    under concurrent trials (two trials drawing the same ephemeral port and
+    cross-joining into one jax.distributed cluster) and proves nothing for a
+    remote host anyway; a monotonic counter from a pid-offset base keeps
+    every trial in this sweep on its own port. Collisions with unrelated
+    services surface as an init failure of that one trial."""
+    with _PORT_LOCK:
+        return next(_PORT_COUNTER)
+
+
+def _trial_command(
+    launcher: Optional[str],
+    script: str,
+    hparams: Dict[str, Any],
+    host: Optional[str],
+    env: Dict[str, str],
+):
+    """Build one trial process's command: an argv list (no launcher) or a
+    shell line (launcher template — run with ``shell=True`` so it behaves
+    like the line the user wrote).
+
+    Template placeholders: ``{python}``, ``{script}``, ``{host}``,
+    ``{hparams}`` / ``{env}`` (shell-quoted once — for commands executed
+    locally), and ``{hparams_remote}`` / ``{env_remote}`` (quoted twice —
+    one layer is consumed by the local shell, the surviving layer protects
+    the value when a remote shell re-splits the line, as ssh does). ``{env}``
+    carries the trial's ``TRLX_TPU_*`` contract plus ``PYTHONPATH`` and
+    ``JAX_PLATFORMS`` as ``k=v`` assignments: remote shells don't inherit
+    the sweep's environment. Example::
+
+        launcher: "ssh -tt {host} env {env_remote} {python} {script} {hparams_remote}"
+
+    (``-tt`` so terminating the local ssh client also hangs up the remote
+    trial — plain ssh would leave it running, holding the host's chip.)
+    """
+    if launcher is None:
+        return [sys.executable, os.path.abspath(script), json.dumps(hparams)]
+    import shlex
+
+    def env_pairs(quote):
+        return " ".join(
+            f"{k}={quote(v)}"
+            for k, v in sorted(env.items())
+            if k.startswith("TRLX_TPU_") or k in ("JAX_PLATFORMS", "PYTHONPATH")
+        )
+
+    payload = json.dumps(hparams)
+    return launcher.format(
+        python=sys.executable,
+        script=os.path.abspath(script),
+        hparams=shlex.quote(payload),
+        hparams_remote=shlex.quote(shlex.quote(payload)),
+        host=host or "localhost",
+        env=env_pairs(shlex.quote),
+        env_remote=env_pairs(lambda v: shlex.quote(shlex.quote(v))),
+    )
+
+
+def _wait_sigterm_only(procs: List[subprocess.Popen], timeout: Optional[float], log) -> int:
+    """Wait on every trial process; on timeout SIGTERM (twice) then ORPHAN —
+    never SIGKILL: a process hung on the accelerator claim that is SIGKILLed
+    wedges the chip for every subsequent trial. Returns max rc (-1 on
+    timeout/orphan)."""
+    deadline = None if timeout is None else time.time() + timeout
+    rc = 0
+    timed_out = False
+    for proc in procs:
+        left = None if deadline is None else max(0.1, deadline - time.time())
+        try:
+            rc = max(rc, abs(proc.wait(timeout=left)))
+            continue
+        except subprocess.TimeoutExpired:
+            pass
+        timed_out = True
+        terminated = False
+
+        def _sigterm(p=proc):
+            # shell-launched trials run in their own session: signal that
+            # whole group so the SIGTERM reaches the trial, not just /bin/sh.
+            # ONLY when the child leads its own group — killpg on a child in
+            # the sweep's group would SIGTERM the sweep itself.
+            import signal
+
+            try:
+                pgid = os.getpgid(p.pid)
+                if pgid == p.pid:
+                    os.killpg(pgid, signal.SIGTERM)
+                else:
+                    p.terminate()
+            except (ProcessLookupError, PermissionError, OSError):
+                p.terminate()
+
+        for _ in range(2):
+            _sigterm()
+            try:
+                proc.wait(timeout=30)
+                log.write(f"\nsweep: trial terminated after {timeout}s timeout\n")
+                terminated = True
+                break
+            except subprocess.TimeoutExpired:
+                continue
+        if not terminated:
+            log.write(
+                f"\nsweep: trial pid {proc.pid} ignored SIGTERM after "
+                f"{timeout}s timeout; orphaned (never SIGKILL — chip wedge)\n"
+            )
+    # a real failure code from any process outranks the generic timeout mark
+    return rc if rc > 0 else (-1 if timed_out else rc)
+
+
 def run_trial(
     script: str,
     hparams: Dict[str, Any],
@@ -285,9 +423,23 @@ def run_trial(
     log_path: str,
     timeout: Optional[float] = None,
     extra_env: Optional[Dict[str, str]] = None,
+    launcher: Optional[str] = None,
+    host: Optional[str] = None,
+    procs_per_trial: int = 1,
 ) -> int:
-    """One subprocess trial: ``python script.py '<json>'`` with the result
-    file advertised via ``TRLX_TPU_SWEEP_RESULT``."""
+    """One trial: ``python script.py '<json>'`` with the result file
+    advertised via ``TRLX_TPU_SWEEP_RESULT``.
+
+    Multi-host dispatch (the reference's Ray-cluster trial placement,
+    ``trlx/sweep.py:267-348``): ``launcher`` is a command template (see
+    :func:`_trial_command`) used to place the processes — e.g. over ssh —
+    and ``procs_per_trial > 1`` spawns that many coordinated processes per
+    trial over the ``TRLX_TPU_COORDINATOR``/``NUM_PROCESSES``/``PROCESS_ID``
+    contract (``trlx_tpu.trlx.initialize_runtime``). ``host`` may be a
+    comma-separated group (``"hostA,hostB"``): process ``i`` lands on
+    ``group[i % len(group)]`` — one process per pod host — and the
+    coordinator is process 0's host. The trainer reports sweep results from
+    rank 0 only, so the one ``result_path`` stays single-writer."""
     env = dict(os.environ)
     env["TRLX_TPU_SWEEP_RESULT"] = result_path
     # trials run with cwd at the script (for its local imports); make this
@@ -298,35 +450,37 @@ def run_trial(
     )
     if extra_env:
         env.update(extra_env)
+    group = (host or "localhost").split(",")
+    coordinator = None
+    if procs_per_trial > 1:
+        coordinator = f"{group[0]}:{_next_coordinator_port()}"
     with open(log_path, "a") as log:
-        proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(script), json.dumps(hparams)],
-            cwd=os.path.dirname(os.path.abspath(script)) or None,
-            env=env,
-            stdout=log,
-            stderr=subprocess.STDOUT,
-        )
-        try:
-            return proc.wait(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            # a hung trial must not abort the sweep; its last _report_sweep
-            # write (if any) still counts. SIGTERM only — a trial hung on
-            # the accelerator claim must NEVER be SIGKILLed (a kill
-            # mid-claim wedges the chip for every subsequent trial); if it
-            # ignores SIGTERM, orphan it and move on.
-            for _ in range(2):
-                proc.terminate()
-                try:
-                    proc.wait(timeout=30)
-                    log.write(f"\nsweep: trial terminated after {timeout}s timeout\n")
-                    return -1
-                except subprocess.TimeoutExpired:
-                    continue
-            log.write(
-                f"\nsweep: trial pid {proc.pid} ignored SIGTERM after "
-                f"{timeout}s timeout; orphaned (never SIGKILL — chip wedge)\n"
+        procs = []
+        for pid_i in range(max(1, procs_per_trial)):
+            penv = dict(env)
+            if coordinator is not None:
+                penv.update(
+                    TRLX_TPU_COORDINATOR=coordinator,
+                    TRLX_TPU_NUM_PROCESSES=str(procs_per_trial),
+                    TRLX_TPU_PROCESS_ID=str(pid_i),
+                )
+            cmd = _trial_command(
+                launcher, script, hparams, group[pid_i % len(group)], penv
             )
-            return -1
+            procs.append(
+                subprocess.Popen(
+                    cmd,
+                    shell=isinstance(cmd, str),
+                    # own session, so timeout SIGTERMs reach the whole
+                    # launcher process group (shell + ssh client)
+                    start_new_session=isinstance(cmd, str),
+                    cwd=os.path.dirname(os.path.abspath(script)) or None,
+                    env=penv,
+                    stdout=log,
+                    stderr=subprocess.STDOUT,
+                )
+            )
+        return _wait_sigterm_only(procs, timeout, log)
 
 
 def run_sweep(
@@ -379,10 +533,28 @@ def run_sweep(
             f"scheduler '{scheduler}' not supported (fifo, asha/hyperband)"
         )
     max_concurrent = max(1, int(tune.get("max_concurrent", max_concurrent)))
+    # cluster dispatch (reference: Ray trial placement, trlx/sweep.py:267-348)
+    launcher = tune.get("launcher")
+    hosts: List[str] = list(tune.get("hosts") or [])
+    procs_per_trial = max(1, int(tune.get("procs_per_trial", 1)))
+    if hosts and launcher is None:
+        raise ValueError(
+            "tune_config.hosts needs tune_config.launcher (a command template "
+            "like \"ssh -tt {host} env {env_remote} {python} {script} "
+            "{hparams_remote}\") to place trials on those hosts"
+        )
     trial_platform = (extra_env or {}).get(
         "JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "")
     )
-    if max_concurrent > 1 and trial_platform.lower() != "cpu":
+    if hosts and max_concurrent > len(hosts) and trial_platform.lower() != "cpu":
+        # trials cycle hosts i % len(hosts): more in flight than hosts means
+        # two accelerator trials claiming the same chip — the wedge scenario
+        logger.warning(
+            f"max_concurrent={max_concurrent} > {len(hosts)} hosts with "
+            "accelerator trials; clamping to one in-flight trial per host"
+        )
+        max_concurrent = len(hosts)
+    if max_concurrent > 1 and trial_platform.lower() != "cpu" and not hosts:
         logger.warning(
             f"max_concurrent={max_concurrent} but trials target the "
             "accelerator (JAX_PLATFORMS is not 'cpu'); a single chip cannot "
@@ -416,7 +588,17 @@ def run_sweep(
             t0 = time.time()
             result_path = os.path.join(output_dir, f"trial_{i:03d}.json")
             log_path = os.path.join(output_dir, f"trial_{i:03d}.log")
-            rc = run_trial(script, hparams, result_path, log_path, trial_timeout, extra_env)
+            rc = run_trial(
+                script,
+                hparams,
+                result_path,
+                log_path,
+                trial_timeout,
+                extra_env,
+                launcher=launcher,
+                host=hosts[i % len(hosts)] if hosts else None,
+                procs_per_trial=procs_per_trial,
+            )
             stats: Dict[str, Any] = {}
             if os.path.exists(result_path):
                 with open(result_path) as f:
