@@ -44,10 +44,11 @@ Cluster dispatch (the reference's Ray trial placement,
   shells don't inherit the sweep's environment); the ``_remote`` variants
   carry an extra quoting layer that survives the remote shell's re-split,
   and ``-tt`` makes a terminated ssh client hang up the remote trial;
-- ``hosts``: list cycled over trials, each entry a host or a
-  comma-separated group (one process per pod host, coordinator on the
-  first). Accelerator trials parallelize across hosts up to one in-flight
-  trial per host (clamped);
+- ``hosts``: a free-slot pool — each trial borrows an entry for its
+  whole run, so two in-flight trials never share one. Entries are a host
+  or a comma-separated group (one process per pod host, coordinator on
+  the first). Accelerator trials parallelize across hosts up to one
+  in-flight trial per host (clamped);
 - ``procs_per_trial``: spawn N coordinated processes per trial over the
   ``TRLX_TPU_COORDINATOR``/``NUM_PROCESSES``/``PROCESS_ID`` multi-host
   contract (one trial = one jax.distributed cluster; rank 0 writes the
@@ -354,8 +355,8 @@ def _trial_command(
 
     payload = json.dumps(hparams)
     return launcher.format(
-        python=sys.executable,
-        script=os.path.abspath(script),
+        python=shlex.quote(sys.executable),
+        script=shlex.quote(os.path.abspath(script)),
         hparams=shlex.quote(payload),
         hparams_remote=shlex.quote(shlex.quote(payload)),
         host=host or "localhost",
@@ -543,8 +544,13 @@ def run_sweep(
             "like \"ssh -tt {host} env {env_remote} {python} {script} "
             "{hparams_remote}\") to place trials on those hosts"
         )
-    trial_platform = (extra_env or {}).get(
-        "JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "")
+    # TRLX_TPU_PLATFORM is the authoritative CPU-forcing contract
+    # (initialize_runtime overrides boot shims that ignore JAX_PLATFORMS);
+    # fall back to JAX_PLATFORMS for scripts that don't call it
+    merged_env = dict(os.environ)
+    merged_env.update(extra_env or {})
+    trial_platform = merged_env.get(
+        "TRLX_TPU_PLATFORM", merged_env.get("JAX_PLATFORMS", "")
     )
     if hosts and max_concurrent > len(hosts) and trial_platform.lower() != "cpu":
         # trials cycle hosts i % len(hosts): more in flight than hosts means
@@ -566,6 +572,16 @@ def run_sweep(
     os.makedirs(output_dir, exist_ok=True)
     results_path = os.path.join(output_dir, "results.jsonl")
     records: List[Dict[str, Any]] = []
+    # free-slot host pool: a trial borrows a host for its whole run, so two
+    # in-flight trials can never share one — index-based cycling breaks the
+    # moment pool workers finish out of order (e.g. big ASHA batches)
+    host_pool: Optional[Any] = None
+    if hosts:
+        import queue
+
+        host_pool = queue.Queue()
+        for h in hosts:
+            host_pool.put(h)
     searcher = Searcher(len(space.sampled), search_alg, seed=seed)
     grid_points = space.grid_points()
     draws = max(1, n)
@@ -588,17 +604,22 @@ def run_sweep(
             t0 = time.time()
             result_path = os.path.join(output_dir, f"trial_{i:03d}.json")
             log_path = os.path.join(output_dir, f"trial_{i:03d}.log")
-            rc = run_trial(
-                script,
-                hparams,
-                result_path,
-                log_path,
-                trial_timeout,
-                extra_env,
-                launcher=launcher,
-                host=hosts[i % len(hosts)] if hosts else None,
-                procs_per_trial=procs_per_trial,
-            )
+            trial_host = host_pool.get() if host_pool is not None else None
+            try:
+                rc = run_trial(
+                    script,
+                    hparams,
+                    result_path,
+                    log_path,
+                    trial_timeout,
+                    extra_env,
+                    launcher=launcher,
+                    host=trial_host,
+                    procs_per_trial=procs_per_trial,
+                )
+            finally:
+                if host_pool is not None:
+                    host_pool.put(trial_host)
             stats: Dict[str, Any] = {}
             if os.path.exists(result_path):
                 with open(result_path) as f:
