@@ -29,11 +29,12 @@ Robustness: the TPU backend can be transiently unavailable (single-tenant
 chip wedged by a stale session from a killed process — this killed the r1
 AND r2 bench windows).  Init is probed in throwaway subprocesses (SIGTERM
 only, never SIGKILL — killing a mid-claim process is what causes the wedge)
-and retried with backoff for ``BENCH_ACCEL_WAIT`` seconds (default 40 min —
-a wedge typically clears server-side within the hour); if the accelerator
-never comes up, the bench falls back to forced-CPU with a reduced work size
-so it still emits a parsable JSON line (tagged ``[cpu-fallback]`` in the
-metric name).
+and retried with backoff for ``BENCH_ACCEL_WAIT`` seconds (default 15 min —
+short enough that the CPU fallback still finishes inside the driver's bench
+window; r3's 40-min default overran it, rc=124 with no artifact); if the
+accelerator never comes up, the bench falls back to forced-CPU with a
+reduced work size so it still emits a parsable JSON line (tagged
+``[cpu-fallback]``, with the wedge status stamped into the ``note``).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -100,17 +101,21 @@ def _init_devices():
     """``jax.devices()`` with a long accelerator-wait horizon, then
     forced-CPU fallback.
 
-    The driver's bench window is multi-hour; a wedged chip claim typically
-    clears in 30-60 min when the server reaps the stale session. So keep
-    re-probing with backoff for ``BENCH_ACCEL_WAIT`` seconds (default 40
-    min) before giving up, logging every attempt's outcome to stderr.
+    Keep re-probing with backoff for ``BENCH_ACCEL_WAIT`` seconds (default
+    900 — the budget must leave the CPU-fallback bench room to finish
+    inside the driver's window) before giving up, logging every attempt's
+    outcome to stderr.
 
-    Returns ``(devices, fallback_exc)`` — ``fallback_exc`` is None unless we
-    gave up on the accelerator and dropped to CPU.
+    Returns ``(devices, fallback_exc, attempts)`` — ``fallback_exc`` is None
+    unless we gave up on the accelerator and dropped to CPU.
     """
     import jax
 
-    wait_budget = float(os.environ.get("BENCH_ACCEL_WAIT", 2400.0))
+    # r3 post-mortem: a 2400s probe budget exceeded the driver's own bench
+    # timeout (BENCH_r03.json rc=124 with no JSON line at all). The probe
+    # horizon must leave room for the CPU-fallback bench to complete inside
+    # the driver window, so a wedged round still produces an artifact.
+    wait_budget = float(os.environ.get("BENCH_ACCEL_WAIT", 900.0))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120.0))
     deadline = time.time() + wait_budget
     last_err = None
@@ -128,7 +133,7 @@ def _init_devices():
                 f"(waited {time.time() + wait_budget - deadline:.0f}s total)",
                 file=sys.stderr,
             )
-            return jax.devices(), None
+            return jax.devices(), None, attempt
         except Exception as e:  # backend init failure (e.g. contended chip)
             last_err = e
             remaining = deadline - time.time()
@@ -166,13 +171,13 @@ def _init_devices():
         jax.extend.backend.clear_backends()
     except Exception:
         pass
-    return jax.devices(), last_err
+    return jax.devices(), last_err, attempt
 
 
 def main():
     import jax
 
-    devices, fallback_err = _init_devices()
+    devices, fallback_err, probe_attempts = _init_devices()
     on_cpu = devices[0].platform == "cpu"
     if fallback_err is not None:
         print(f"bench: accelerator unavailable, CPU fallback: {fallback_err}", file=sys.stderr)
@@ -266,6 +271,50 @@ def main():
     samples_per_sec = n_cycles * chunk / dt
     per_chip = samples_per_sec / max(n_dev, 1)
     tag = " [cpu-fallback]" if on_cpu else ""
+    # self-explanatory wedge context (round-3 verdict next#1): when the
+    # single-tenant chip claim is wedged, the artifact itself must say why
+    # there is no on-chip number and where the evidence trail lives
+    note = None
+    if on_cpu and fallback_err is not None:
+        note = (
+            f"CPU fallback, value NOT comparable to baseline: accelerator "
+            f"init failed after {probe_attempts} SIGTERM-only probe attempts "
+            f"({fallback_err}); acquisition trail in "
+            f"benchmarks/tpu/ACQUISITION_LOG.md"
+        )
+        # incident context comes from the session that knows it — either
+        # BENCH_WEDGE_SINCE in the env, or the maintained status file
+        # benchmarks/tpu/WEDGE_STATUS.json (updated/cleared by the builder)
+        # — never a source-code default that would mislabel future fallbacks
+        wedge_since = os.environ.get("BENCH_WEDGE_SINCE")
+        if not wedge_since:
+            try:
+                status_path = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "benchmarks", "tpu", "WEDGE_STATUS.json",
+                )
+                with open(status_path) as f:
+                    status = json.load(f)
+                if not status.get("cleared"):
+                    wedge_since = status.get("since")
+            except Exception:
+                pass
+        if wedge_since:
+            try:
+                import calendar
+
+                age_h = (
+                    time.time()
+                    - calendar.timegm(time.strptime(wedge_since, "%Y-%m-%dT%H:%MZ"))
+                ) / 3600.0
+                age = f", ~{age_h:.0f}h old at bench time"
+            except Exception:
+                age = ""
+            note += (
+                f"; known chip-claim wedge since {wedge_since}{age} "
+                f"(stale server-side session; recovery chain armed: "
+                f"scripts/probe_tpu_loop.sh && scripts/tpu_evidence.py)"
+            )
 
     # Analytic MFU estimate (stderr; stdout stays the one-line contract).
     # Scaling-book accounting: forward ≈ 2·N FLOPs/token, backward ≈ 4·N
@@ -309,16 +358,15 @@ def main():
         ),
         file=sys.stderr,
     )
-    print(
-        json.dumps(
-            {
-                "metric": "ppo_sentiments-shaped e2e throughput (gpt2-small, 64+40 tok)" + tag,
-                "value": round(samples_per_sec, 3),
-                "unit": "samples/sec",
-                "vs_baseline": round(samples_per_sec / A100_BASELINE_SAMPLES_PER_SEC, 3),
-            }
-        )
-    )
+    line = {
+        "metric": "ppo_sentiments-shaped e2e throughput (gpt2-small, 64+40 tok)" + tag,
+        "value": round(samples_per_sec, 3),
+        "unit": "samples/sec",
+        "vs_baseline": round(samples_per_sec / A100_BASELINE_SAMPLES_PER_SEC, 3),
+    }
+    if note:
+        line["note"] = note
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -29,7 +29,7 @@ def test_init_devices_succeeds_after_transient_failures(bench, monkeypatch):
     monkeypatch.setattr(bench, "_probe_accelerator", fake_probe)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     monkeypatch.setenv("BENCH_ACCEL_WAIT", "3600")
-    devices, err = bench._init_devices()
+    devices, err, _attempts = bench._init_devices()
     assert err is None, "must not fall back once the probe succeeds"
     assert len(calls) == 3
 
@@ -40,7 +40,7 @@ def test_init_devices_falls_back_after_wait_budget(bench, monkeypatch):
     slept = []
     monkeypatch.setattr(bench.time, "sleep", lambda s: slept.append(s))
     monkeypatch.setenv("BENCH_ACCEL_WAIT", "0")  # budget exhausted immediately
-    devices, err = bench._init_devices()
+    devices, err, _attempts = bench._init_devices()
     assert err is not None, "exhausted budget must report the failure"
     assert len(calls) == 1  # no pointless re-probe past the deadline
     assert devices[0].platform == "cpu"
@@ -54,7 +54,7 @@ def test_init_devices_stops_probing_on_orphan_pileup(bench, monkeypatch):
     monkeypatch.setattr(bench, "_probe_accelerator", fake_probe)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     monkeypatch.setenv("BENCH_ACCEL_WAIT", "999999")
-    devices, err = bench._init_devices()
+    devices, err, _attempts = bench._init_devices()
     assert err is not None
     # capped: stops probing soon after the orphan limit, not at the deadline
     assert bench._ORPHANED_PROBES <= 4

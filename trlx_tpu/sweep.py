@@ -553,8 +553,10 @@ def run_sweep(
         "TRLX_TPU_PLATFORM", merged_env.get("JAX_PLATFORMS", "")
     )
     if hosts and max_concurrent > len(hosts) and trial_platform.lower() != "cpu":
-        # trials cycle hosts i % len(hosts): more in flight than hosts means
-        # two accelerator trials claiming the same chip — the wedge scenario
+        # accelerator trials take a host-pool slot for their whole run, so
+        # excess in-flight trials would just block on the pool; clamp loudly
+        # instead of silently queueing (CPU trials are exempt below: host
+        # sharing is safe there, so they skip the pool entirely)
         logger.warning(
             f"max_concurrent={max_concurrent} > {len(hosts)} hosts with "
             "accelerator trials; clamping to one in-flight trial per host"
@@ -572,16 +574,23 @@ def run_sweep(
     os.makedirs(output_dir, exist_ok=True)
     results_path = os.path.join(output_dir, "results.jsonl")
     records: List[Dict[str, Any]] = []
-    # free-slot host pool: a trial borrows a host for its whole run, so two
-    # in-flight trials can never share one — index-based cycling breaks the
-    # moment pool workers finish out of order (e.g. big ASHA batches)
+    # Host assignment. Accelerator trials: a free-slot pool — a trial
+    # borrows a host for its whole run, so two in-flight trials can never
+    # share one chip (index-based cycling breaks the moment pool workers
+    # finish out of order, e.g. big ASHA batches). CPU trials: host sharing
+    # is safe, so skip the pool — a blocking pool would silently serialize
+    # the supported oversubscribed-CPU sweep — and cycle hosts non-blocking.
     host_pool: Optional[Any] = None
+    host_cycle: Optional[Any] = None
     if hosts:
-        import queue
+        if trial_platform.lower() != "cpu":
+            import queue
 
-        host_pool = queue.Queue()
-        for h in hosts:
-            host_pool.put(h)
+            host_pool = queue.Queue()
+            for h in hosts:
+                host_pool.put(h)
+        else:
+            host_cycle = iter(itertools.cycle(hosts))
     searcher = Searcher(len(space.sampled), search_alg, seed=seed)
     grid_points = space.grid_points()
     draws = max(1, n)
@@ -604,7 +613,13 @@ def run_sweep(
             t0 = time.time()
             result_path = os.path.join(output_dir, f"trial_{i:03d}.json")
             log_path = os.path.join(output_dir, f"trial_{i:03d}.log")
-            trial_host = host_pool.get() if host_pool is not None else None
+            if host_pool is not None:
+                trial_host = host_pool.get()
+            elif host_cycle is not None:
+                with lock:
+                    trial_host = next(host_cycle)
+            else:
+                trial_host = None
             try:
                 rc = run_trial(
                     script,
