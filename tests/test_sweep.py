@@ -98,6 +98,12 @@ def test_sweep_randomwalks_ppo(tmp_path):
     # ranked best-first
     metrics = [r["metric"] for r in records]
     assert metrics == sorted(metrics, reverse=True)
+    # per-trial metric curves (reference W&B-report capability): every trial
+    # streamed its JSONL tracker and the report renders the series
+    assert "metrics/optimality over evaluations" in report
+    curves = json.load(open(tmp_path / "sweep_out" / "curves.json"))
+    assert set(curves) == {"0", "1"}
+    assert all(len(v) >= 1 for v in curves.values())
 
 
 def test_choice_is_u_driven():
@@ -451,3 +457,15 @@ def test_hosts_require_launcher(tmp_path):
             },
             str(tmp_path / "out2"),
         )
+
+
+def test_sparkline_and_wandb_fallback(tmp_path, monkeypatch):
+    from trlx_tpu.sweep import _sparkline, publish_wandb_report
+
+    assert _sparkline([0.0, 0.5, 1.0]) == "▁▄█"
+    assert _sparkline([]) == ""
+    assert _sparkline([2.0, 2.0]) == "▁▁"
+    assert " " in _sparkline([0.0, float("nan"), 1.0])
+    # wandb absent or disabled -> clean no-op, never an exception
+    monkeypatch.setenv("WANDB_MODE", "disabled")
+    assert publish_wandb_report([], {}, "m", str(tmp_path)) is False

@@ -54,6 +54,14 @@ Cluster dispatch (the reference's Ray trial placement,
   contract (one trial = one jax.distributed cluster; rank 0 writes the
   result file).
 
+Reporting: trials stream a per-trial JSONL tracker under the sweep dir
+(``tune_config.trial_curves: false`` keeps the script's own tracker), and
+``report.md`` renders the ranked table plus each trial's metric curve
+(sparklines; raw series in ``curves.json``) — the reference's W&B-report
+capability offline. ``tune_config.wandb_report: true`` additionally
+publishes the curves to a W&B run (opt-in: an unauthenticated wandb.init
+blocks on a login prompt).
+
 Results flow through ``TRLX_TPU_SWEEP_RESULT`` paths under the sweep's
 output dir, so remote hosts must share that filesystem (NFS/GCS-fuse — the
 standard pod setup; Ray ships results through its object store instead).
@@ -442,7 +450,9 @@ def run_trial(
     coordinator is process 0's host. The trainer reports sweep results from
     rank 0 only, so the one ``result_path`` stays single-writer."""
     env = dict(os.environ)
-    env["TRLX_TPU_SWEEP_RESULT"] = result_path
+    # trials run with cwd at the script; any relative path we hand them
+    # would resolve against that cwd, not the sweep's
+    env["TRLX_TPU_SWEEP_RESULT"] = os.path.abspath(result_path)
     # trials run with cwd at the script (for its local imports); make this
     # trlx_tpu installation importable there too
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -538,6 +548,8 @@ def run_sweep(
     launcher = tune.get("launcher")
     hosts: List[str] = list(tune.get("hosts") or [])
     procs_per_trial = max(1, int(tune.get("procs_per_trial", 1)))
+    trial_curves = bool(tune.get("trial_curves", True))
+    wandb_report = bool(tune.get("wandb_report", False))
     if hosts and launcher is None:
         raise ValueError(
             "tune_config.hosts needs tune_config.launcher (a command template "
@@ -571,6 +583,10 @@ def run_sweep(
         )
         max_concurrent = 1
 
+    # trials run with their cwd at the user script — every path that crosses
+    # the subprocess boundary (result files, per-trial logging dirs) must be
+    # absolute or it lands next to the script instead of the sweep output
+    output_dir = os.path.abspath(output_dir)
     os.makedirs(output_dir, exist_ok=True)
     results_path = os.path.join(output_dir, "results.jsonl")
     records: List[Dict[str, Any]] = []
@@ -613,6 +629,26 @@ def run_sweep(
             t0 = time.time()
             result_path = os.path.join(output_dir, f"trial_{i:03d}.json")
             log_path = os.path.join(output_dir, f"trial_{i:03d}.log")
+            # per-trial metric curves (the reference streams every trial to
+            # W&B and renders a report of the curves, trlx/sweep.py:177-264;
+            # here each trial gets a JSONL tracker under the sweep dir and
+            # report() renders the curves). This overrides the script's own
+            # tracker for the trial — the reference's Ray sweep routes trial
+            # logging the same way; set tune_config.trial_curves: false to
+            # keep the script's tracker instead. The injected plumbing keys
+            # stay OUT of the recorded hparams (the record must reproduce
+            # the winning config, not this sweep's local paths).
+            user_hparams = hparams
+            if trial_curves and "train.tracker" not in hparams:
+                trial_dir = os.path.join(output_dir, f"trial_{i:03d}")
+                stats_file = os.path.join(trial_dir, "stats.jsonl")
+                if os.path.exists(stats_file):
+                    os.remove(stats_file)  # JSONL tracker appends: a rerun
+                    # into the same output_dir must not fuse old curves
+                hparams = dict(
+                    hparams,
+                    **{"train.logging_dir": trial_dir, "train.tracker": "jsonl"},
+                )
             if host_pool is not None:
                 trial_host = host_pool.get()
             elif host_cycle is not None:
@@ -640,7 +676,7 @@ def run_sweep(
                 with open(result_path) as f:
                     stats = json.load(f)
             record.update(
-                hparams=hparams,
+                hparams=user_hparams,
                 u=[float(x) for x in us],
                 rc=rc,
                 runtime_s=round(time.time() - t0, 1),
@@ -722,7 +758,7 @@ def run_sweep(
         return -m if mode == "max" else m
 
     records.sort(key=rank_key)
-    report(records, metric, mode, output_dir)
+    report(records, metric, mode, output_dir, wandb_report=wandb_report)
     return records
 
 
@@ -820,9 +856,53 @@ def _run_asha(
         ]
 
 
-def report(records: List[Dict[str, Any]], metric: str, mode: str, output_dir: str) -> None:
-    """Ranked text report (the reference renders a W&B report,
-    ``trlx/sweep.py:177-264``; offline JSONL + markdown table here)."""
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(series: List[float]) -> str:
+    finite = [v for v in series if np.isfinite(v)]
+    if not finite:
+        return ""
+    lo, hi = min(finite), max(finite)
+    span = (hi - lo) or 1.0
+    return "".join(
+        _SPARK[int((v - lo) / span * (len(_SPARK) - 1))] if np.isfinite(v) else " "
+        for v in series
+    )
+
+
+def _trial_curve(output_dir: str, trial: int, metric: str) -> List[float]:
+    """The trial's metric series from its JSONL tracker stream."""
+    path = os.path.join(output_dir, f"trial_{trial:03d}", "stats.jsonl")
+    if not os.path.exists(path):
+        return []
+    series = []
+    with open(path) as f:
+        for line in f:
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if metric in row:
+                series.append(float(row[metric]))
+    return series
+
+
+def report(
+    records: List[Dict[str, Any]],
+    metric: str,
+    mode: str,
+    output_dir: str,
+    wandb_report: bool = False,
+) -> None:
+    """Sweep report: ranked table + per-trial metric curves — the capability
+    of the reference's W&B report (``trlx/sweep.py:177-264``, line plots of
+    every trial's metric over steps), rendered offline as sparkline rows in
+    ``report.md`` with the raw series in ``curves.json``. With
+    ``wandb_report=True`` (``tune_config.wandb_report`` — opt-in: an
+    unauthenticated ``wandb.init`` blocks on a login prompt, so it must
+    never run by surprise) the same curves also publish to a W&B run
+    (:func:`publish_wandb_report`)."""
     lines = [f"# Sweep report — {metric} ({mode})", ""]
     lines.append("| rank | trial | " + metric + " | rc | hparams |")
     lines.append("|---|---|---|---|---|")
@@ -833,11 +913,71 @@ def report(records: List[Dict[str, Any]], metric: str, mode: str, output_dir: st
     best = records[0] if records else None
     if best is not None and best["metric"] is not None:
         lines += ["", f"Best: trial {best['trial']} → {metric}={best['metric']}", f"```json\n{json.dumps(best['hparams'], indent=2)}\n```"]
+
+    curves = {r["trial"]: _trial_curve(output_dir, r["trial"], metric) for r in records}
+    if any(curves.values()):
+        lines += ["", f"## {metric} over evaluations", ""]
+        lines.append("| trial | curve | first | last | n |")
+        lines.append("|---|---|---|---|---|")
+        for r in records:
+            series = curves[r["trial"]]
+            if not series:
+                continue
+            lines.append(
+                f"| {r['trial']} | `{_sparkline(series)}` | {series[0]:.4g} "
+                f"| {series[-1]:.4g} | {len(series)} |"
+            )
+        with open(os.path.join(output_dir, "curves.json"), "w") as f:
+            json.dump({str(k): v for k, v in curves.items()}, f, indent=2)
+
     text = "\n".join(lines)
     with open(os.path.join(output_dir, "report.md"), "w") as f:
         f.write(text + "\n")
     if logging.get_verbosity() <= logging.INFO:
         print(text)
+    if wandb_report:
+        publish_wandb_report(records, curves, metric, output_dir)
+
+
+def publish_wandb_report(
+    records: List[Dict[str, Any]],
+    curves: Dict[int, List[float]],
+    metric: str,
+    output_dir: str,
+) -> bool:
+    """Publish the sweep summary + trial curves as a W&B run (reference
+    capability: ``trlx/sweep.py:177-264`` builds a wandb Report of all trial
+    charts). Graceful no-op (returns False) when wandb is missing, disabled,
+    or offline — the markdown/JSON artifacts above are the offline record."""
+    if os.environ.get("WANDB_MODE", "").lower() in ("disabled", "dryrun"):
+        return False
+    try:
+        import wandb
+    except ImportError:
+        return False
+    try:
+        run = wandb.init(
+            project=os.environ.get("WANDB_PROJECT", "trlx_tpu-sweeps"),
+            name=os.path.basename(os.path.abspath(output_dir)),
+            job_type="sweep-report",
+        )
+        table = wandb.Table(columns=["rank", "trial", metric, "hparams"])
+        for rank, r in enumerate(records):
+            table.add_data(rank, r["trial"], r["metric"], json.dumps(r["hparams"]))
+        payload: Dict[str, Any] = {"ranking": table}
+        series = [curves[r["trial"]] for r in records if curves.get(r["trial"])]
+        if series:
+            keys = [f"trial {r['trial']}" for r in records if curves.get(r["trial"])]
+            xs = list(range(max(len(s) for s in series)))
+            payload["curves"] = wandb.plot.line_series(
+                xs=xs, ys=series, keys=keys, title=metric, xname="evaluation"
+            )
+        run.log(payload)
+        run.finish()
+        return True
+    except Exception as e:  # network/auth problems must never fail the sweep
+        logger.warning(f"W&B sweep report skipped: {e}")
+        return False
 
 
 def main(argv: Optional[List[str]] = None) -> int:
