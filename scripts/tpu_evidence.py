@@ -380,6 +380,28 @@ def write_report(out_dir: str, allow_publish: bool = False) -> None:
                 f"- peak HBM {gib(perf.get('hbm_peak_bytes'))} of {gib(perf.get('hbm_limit_bytes'))}",
                 "",
             ]
+    spec_path = os.path.join(out_dir, "speculative.json")
+    if os.path.exists(spec_path):
+        try:
+            with open(spec_path) as f:
+                spec = json.load(f)
+            lines += [
+                "## Speculative decoding A/B (draft-and-verify vs plain sampler)",
+                "",
+                f"- plain: {spec['plain']['samples_per_s']} samples/s; "
+                f"speculative: {spec['speculative']['samples_per_s']} samples/s "
+                f"→ **{spec['speedup']}×**",
+            ]
+            acc = spec["speculative"].get("spec_acceptance_rate")
+            if acc is not None:
+                lines += [
+                    f"- acceptance rate {acc:.3f} (untrained-model floor), "
+                    f"{spec['speculative'].get('spec_rounds')} rounds for "
+                    f"{spec['config']['max_new_tokens']} tokens",
+                ]
+            lines += [""]
+        except Exception:
+            pass
     if walks:
         opts = [r["metrics/optimality"] for r in walks if "metrics/optimality" in r]
         if opts:
@@ -438,6 +460,7 @@ def main(argv=None):
         ),
         "gpt2_xl": (GPT2_XL_CODE, 3600),
         "profile": (PROFILE_CODE.format(out_dir=args.out), 3600),
+        "speculative": (None, 1800),  # A/B rollout throughput, chip-native
     }
     only = args.only.split(",") if args.only else list(stages)
     ok = {}
@@ -449,6 +472,17 @@ def main(argv=None):
             # the whole evidence window)
             ok[name] = run_stage(
                 name, [sys.executable, os.path.join(REPO, "bench.py")],
+                args.out, timeout_s,
+            )
+        elif name == "speculative":
+            # same entry as the committed CPU artifact
+            # (benchmarks/SPECULATIVE_cpu.json) — run on the chip it finds
+            ok[name] = run_stage(
+                name,
+                [
+                    sys.executable, "-m", "trlx_tpu.benchmark", "speculative",
+                    "--output", os.path.join(args.out, "speculative.json"),
+                ],
                 args.out, timeout_s,
             )
         else:

@@ -274,6 +274,10 @@ def measure_speculative(
     """
     import numpy as np
 
+    from trlx_tpu.trlx import initialize_runtime
+
+    initialize_runtime()  # honors TRLX_TPU_PLATFORM before any backend init
+
     import trlx_tpu.trainer.ppo  # noqa: F401  (registers PPOTrainer)
     from trlx_tpu.data.default_configs import default_ppo_config
     from trlx_tpu.trainer import get_trainer

@@ -639,12 +639,15 @@ def run_sweep(
             # stay OUT of the recorded hparams (the record must reproduce
             # the winning config, not this sweep's local paths).
             user_hparams = hparams
+            trial_dir = os.path.join(output_dir, f"trial_{i:03d}")
+            stats_file = os.path.join(trial_dir, "stats.jsonl")
+            if os.path.exists(stats_file):
+                # JSONL trackers append, and report() reads this path
+                # unconditionally: a rerun into the same output_dir must
+                # never fuse (or inherit) a previous run's curves — cleared
+                # even when this run injects no tracker
+                os.remove(stats_file)
             if trial_curves and "train.tracker" not in hparams:
-                trial_dir = os.path.join(output_dir, f"trial_{i:03d}")
-                stats_file = os.path.join(trial_dir, "stats.jsonl")
-                if os.path.exists(stats_file):
-                    os.remove(stats_file)  # JSONL tracker appends: a rerun
-                    # into the same output_dir must not fuse old curves
                 hparams = dict(
                     hparams,
                     **{"train.logging_dir": trial_dir, "train.tracker": "jsonl"},
@@ -881,10 +884,10 @@ def _trial_curve(output_dir: str, trial: int, metric: str) -> List[float]:
         for line in f:
             try:
                 row = json.loads(line)
-            except ValueError:
-                continue
-            if metric in row:
-                series.append(float(row[metric]))
+                if metric in row:
+                    series.append(float(row[metric]))
+            except (ValueError, TypeError):
+                continue  # a malformed line must not cost the whole report
     return series
 
 
@@ -929,6 +932,12 @@ def report(
             )
         with open(os.path.join(output_dir, "curves.json"), "w") as f:
             json.dump({str(k): v for k, v in curves.items()}, f, indent=2)
+    else:
+        # a curve-less run must not leave a previous run's curves.json
+        # sitting next to a fresh report.md
+        stale = os.path.join(output_dir, "curves.json")
+        if os.path.exists(stale):
+            os.remove(stale)
 
     text = "\n".join(lines)
     with open(os.path.join(output_dir, "report.md"), "w") as f:
