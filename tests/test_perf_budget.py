@@ -54,6 +54,18 @@ def test_budget_gpt2_test():
 
 
 @pytest.mark.slow
+def test_budget_ilql_gpt2_test():
+    """ILQL's programs: twin-Q/CQL train step + the advantage-reshaping
+    sampler (a different generate program than PPO's)."""
+    _assert_within_budget("ilql_gpt2_test")
+
+
+@pytest.mark.slow
+def test_budget_sft_gpt2_test():
+    _assert_within_budget("sft_gpt2_test")
+
+
+@pytest.mark.slow
 def test_budget_gpt2_small():
     """The flagship bench model (BASELINE.md): the exact programs whose
     samples/s the driver benchmark measures on chip."""
@@ -70,12 +82,16 @@ def test_budget_gptj_6b_scan():
 
 
 def test_budget_file_covers_matrix():
-    """Every config in the guarded matrix has a committed budget with all
-    three programs present."""
+    """Every config in the guarded matrix has a committed budget with its
+    trainer's full program set present — and no orphaned budgets survive a
+    config rename (the generator preserves existing entries)."""
+    from trlx_tpu.perf import budget_programs
+
     with open(BUDGET_PATH) as f:
         payload = json.load(f)
-    for name in budget_configs():
-        assert name in payload["budgets"], f"no budget for {name}"
-        for prog in ("generate", "score", "train_step"):
+    expected = budget_programs()
+    assert set(payload["budgets"]) == set(expected)
+    for name, progs in expected.items():
+        for prog in progs:
             entry = payload["budgets"][name][prog]
             assert entry["flops"] > 0 and entry["bytes_accessed"] > 0

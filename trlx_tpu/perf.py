@@ -32,6 +32,22 @@ from trlx_tpu.data.configs import TRLConfig
 # that the per-token/per-layer structure (and its regressions) dominates.
 DEFAULT_SHAPE = dict(batch_size=8, prompt_len=32, gen_len=16)
 
+# The hot-program set per trainer — single source of truth for
+# hot_program_costs' default, the budget generator, and the coverage test.
+TRAINER_PROGRAMS = {
+    "ppotrainer": ("generate", "score", "train_step"),
+    "ilqltrainer": ("generate", "train_step"),
+    "sfttrainer": ("train_step",),
+}
+
+
+def budget_programs() -> Dict[str, Tuple[str, ...]]:
+    """Config name → the program set its budget must contain."""
+    return {
+        name: TRAINER_PROGRAMS[config.train.trainer.lower()]
+        for name, (config, _) in budget_configs().items()
+    }
+
 
 def _costs_of(lowered) -> Dict[str, float]:
     compiled = lowered.compile()
@@ -52,26 +68,66 @@ def _costs_of(lowered) -> Dict[str, float]:
     return out
 
 
+def _train_batch_sds(trainer_name: str, B: int, P: int, N: int) -> Dict[str, Any]:
+    """Abstract train-step batch for each supported trainer's loss contract."""
+    SDS = jax.ShapeDtypeStruct
+    T = P + N
+    if trainer_name == "ppotrainer":
+        return {
+            "query_tensors": SDS((B, P), np.int32),
+            "query_mask": SDS((B, P), np.int32),
+            "response_tensors": SDS((B, N), np.int32),
+            "response_mask": SDS((B, N), np.int32),
+            "logprobs": SDS((B, N), np.float32),
+            "values": SDS((B, N), np.float32),
+            "rewards": SDS((B, N), np.float32),
+        }
+    if trainer_name == "sfttrainer":
+        return {
+            "input_ids": SDS((B, T), np.int32),
+            "attention_mask": SDS((B, T), np.int32),
+            "labels": SDS((B, T), np.int32),
+        }
+    if trainer_name == "ilqltrainer":
+        A = N  # one action (response token) per generated position
+        return {
+            "input_ids": SDS((B, T), np.int32),
+            "attention_mask": SDS((B, T), np.int32),
+            "rewards": SDS((B, A), np.float32),
+            "states_ixs": SDS((B, A + 1), np.int32),
+            "actions_ixs": SDS((B, A), np.int32),
+            "dones": SDS((B, A + 1), np.int32),
+        }
+    raise ValueError(f"no abstract batch builder for trainer '{trainer_name}'")
+
+
 def hot_program_costs(
     config: TRLConfig,
     batch_size: int = DEFAULT_SHAPE["batch_size"],
     prompt_len: int = DEFAULT_SHAPE["prompt_len"],
     gen_len: int = DEFAULT_SHAPE["gen_len"],
-    programs: Tuple[str, ...] = ("generate", "score", "train_step"),
+    programs: Optional[Tuple[str, ...]] = None,
 ) -> Dict[str, Dict[str, float]]:
-    """Compile the hot programs of a PPO trainer for ``config`` with abstract
+    """Compile the hot programs of a trainer for ``config`` with abstract
     weights and return their XLA cost/memory analysis, keyed by program.
 
+    Supports PPO (generate + score + train_step), ILQL (generate with the
+    advantage-reshaping sampler hook + train_step), and SFT (train_step).
     Works for any causal-LM config the trainer accepts — including configs
     far too large to materialize on the analysis host (6B+ with
     ``scan_layers``): only shapes flow through tracing and compilation.
     """
     from trlx_tpu.ops.sampling import GenerationConfig
     from trlx_tpu.trainer import get_trainer
-    import trlx_tpu.trainer.ppo  # noqa: F401  (registers PPOTrainer)
+    import trlx_tpu.trainer.ilql  # noqa: F401  (registration)
+    import trlx_tpu.trainer.ppo  # noqa: F401
+    import trlx_tpu.trainer.sft  # noqa: F401
 
     cls = get_trainer(config.train.trainer)
     trainer = cls(config, reward_fn=lambda **kw: [0.0], abstract_init=True)
+    trainer_name = type(trainer).__name__.lower()
+    if programs is None:
+        programs = TRAINER_PROGRAMS.get(trainer_name, ("train_step",))
 
     B, P, N = batch_size, prompt_len, gen_len
     SDS = jax.ShapeDtypeStruct
@@ -110,15 +166,7 @@ def hot_program_costs(
         )
 
     if "train_step" in programs:
-        batch = {
-            "query_tensors": SDS((B, P), np.int32),
-            "query_mask": SDS((B, P), np.int32),
-            "response_tensors": SDS((B, N), np.int32),
-            "response_mask": SDS((B, N), np.int32),
-            "logprobs": SDS((B, N), np.float32),
-            "values": SDS((B, N), np.float32),
-            "rewards": SDS((B, N), np.float32),
-        }
+        batch = _train_batch_sds(trainer_name, B, P, N)
         fn = trainer._build_train_step()
         results["train_step"] = _costs_of(fn.lower(trainer.state, batch))
 
@@ -170,19 +218,40 @@ def check_budget(
 def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
     """The config matrix the perf net guards, name → (config, shape kwargs).
 
-    - ``gpt2_test``: tiny — exercised in the fast test tier so the net runs
-      in the <5-min loop;
+    - ``gpt2_test``: tiny PPO — exercised in the fast test tier so the net
+      runs in the <5-min loop;
     - ``gpt2_small``: the flagship bench model (BASELINE.md);
     - ``gptj_6b_scan``: the large-model path — scan_layers + full remat, the
-      program shape that runs on pods. Abstract weights: never materialized.
+      program shape that runs on pods. Abstract weights: never materialized;
+    - ``ilql_gpt2_test`` / ``sft_gpt2_test``: the other two reference
+      algorithms' programs (ILQL: twin-Q/CQL train step + the
+      advantage-reshaping sampler; SFT: masked-CE step).
     """
-    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.data.default_configs import (
+        default_ilql_config,
+        default_ppo_config,
+        default_sft_config,
+    )
 
     base = default_ppo_config()
     return {
         "gpt2_test": (
             base.evolve(
                 model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "ilql_gpt2_test": (
+            default_ilql_config().evolve(
+                model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=-1),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "sft_gpt2_test": (
+            default_sft_config().evolve(
+                model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=-1),
                 tokenizer=dict(tokenizer_path="builtin:bytes"),
             ),
             dict(batch_size=8, prompt_len=32, gen_len=16),
