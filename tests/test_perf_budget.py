@@ -95,3 +95,16 @@ def test_budget_file_covers_matrix():
         for prog in progs:
             entry = payload["budgets"][name][prog]
             assert entry["flops"] > 0 and entry["bytes_accessed"] > 0
+
+
+@pytest.mark.slow
+def test_budget_grpo_gpt2_test():
+    """GRPO's programs: head-less policy generate, hydra-ref scoring, and
+    the group-relative-advantage train step."""
+    _assert_within_budget("grpo_gpt2_test")
+
+
+@pytest.mark.slow
+def test_budget_dpo_gpt2_test():
+    """DPO's paired-completion logp train step."""
+    _assert_within_budget("dpo_gpt2_test")

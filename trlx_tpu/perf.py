@@ -36,7 +36,9 @@ DEFAULT_SHAPE = dict(batch_size=8, prompt_len=32, gen_len=16)
 # hot_program_costs' default, the budget generator, and the coverage test.
 TRAINER_PROGRAMS = {
     "ppotrainer": ("generate", "score", "train_step"),
+    "grpotrainer": ("generate", "score", "train_step"),
     "ilqltrainer": ("generate", "train_step"),
+    "dpotrainer": ("train_step",),
     "sfttrainer": ("train_step",),
 }
 
@@ -88,6 +90,26 @@ def _train_batch_sds(trainer_name: str, B: int, P: int, N: int) -> Dict[str, Any
             "attention_mask": SDS((B, T), np.int32),
             "labels": SDS((B, T), np.int32),
         }
+    if trainer_name == "grpotrainer":
+        return {
+            "query_tensors": SDS((B, P), np.int32),
+            "query_mask": SDS((B, P), np.int32),
+            "response_tensors": SDS((B, N), np.int32),
+            "response_mask": SDS((B, N), np.int32),
+            "logprobs": SDS((B, N), np.float32),
+            "ref_logprobs": SDS((B, N), np.float32),
+            "advantages": SDS((B,), np.float32),
+        }
+    if trainer_name == "dpotrainer":
+        # interleaved (chosen, rejected) pair rows
+        if B % 2:
+            raise ValueError(f"DPO batches are (chosen, rejected) pairs: batch_size {B} must be even")
+        return {
+            "input_ids": SDS((B, T), np.int32),
+            "attention_mask": SDS((B, T), np.int32),
+            "out_mask": SDS((B, T), np.int32),
+            "ref_logps": SDS((B,), np.float32),
+        }
     if trainer_name == "ilqltrainer":
         A = N  # one action (response token) per generated position
         return {
@@ -111,15 +133,18 @@ def hot_program_costs(
     """Compile the hot programs of a trainer for ``config`` with abstract
     weights and return their XLA cost/memory analysis, keyed by program.
 
-    Supports PPO (generate + score + train_step), ILQL (generate with the
-    advantage-reshaping sampler hook + train_step), and SFT (train_step).
+    Supports PPO and GRPO (generate + score + train_step), ILQL (generate
+    with the advantage-reshaping sampler hook + train_step), and DPO/SFT
+    (train_step).
     Works for any causal-LM config the trainer accepts — including configs
     far too large to materialize on the analysis host (6B+ with
     ``scan_layers``): only shapes flow through tracing and compilation.
     """
     from trlx_tpu.ops.sampling import GenerationConfig
     from trlx_tpu.trainer import get_trainer
-    import trlx_tpu.trainer.ilql  # noqa: F401  (registration)
+    import trlx_tpu.trainer.dpo  # noqa: F401  (registration)
+    import trlx_tpu.trainer.grpo  # noqa: F401
+    import trlx_tpu.trainer.ilql  # noqa: F401
     import trlx_tpu.trainer.ppo  # noqa: F401
     import trlx_tpu.trainer.sft  # noqa: F401
 
@@ -225,9 +250,14 @@ def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
       program shape that runs on pods. Abstract weights: never materialized;
     - ``ilql_gpt2_test`` / ``sft_gpt2_test``: the other two reference
       algorithms' programs (ILQL: twin-Q/CQL train step + the
-      advantage-reshaping sampler; SFT: masked-CE step).
+      advantage-reshaping sampler; SFT: masked-CE step);
+    - ``grpo_gpt2_test`` / ``dpo_gpt2_test``: the beyond-reference
+      algorithms (GRPO: head-less policy + hydra-ref scoring; DPO:
+      paired-completion logp step).
     """
     from trlx_tpu.data.default_configs import (
+        default_dpo_config,
+        default_grpo_config,
         default_ilql_config,
         default_ppo_config,
         default_sft_config,
@@ -251,6 +281,20 @@ def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
         ),
         "sft_gpt2_test": (
             default_sft_config().evolve(
+                model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=-1),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "grpo_gpt2_test": (
+            default_grpo_config().evolve(
+                model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "dpo_gpt2_test": (
+            default_dpo_config().evolve(
                 model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=-1),
                 tokenizer=dict(tokenizer_path="builtin:bytes"),
             ),
