@@ -108,3 +108,11 @@ def test_budget_grpo_gpt2_test():
 def test_budget_dpo_gpt2_test():
     """DPO's paired-completion logp train step."""
     _assert_within_budget("dpo_gpt2_test")
+
+
+@pytest.mark.slow
+def test_budget_ppo_t5_test():
+    """The seq2seq leg: T5 encode/decode generate, teacher-forced scoring
+    with the decoder hydra branch, and the seq2seq PPO step — abstract
+    weights through build_seq2seq_lm."""
+    _assert_within_budget("ppo_t5_test")

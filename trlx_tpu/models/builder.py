@@ -381,8 +381,12 @@ def build_seq2seq_lm(
     head: Optional[str] = None,  # None | "value" | "ilql"
     two_qs: bool = True,
     seed: int = 0,
+    abstract: bool = False,
 ):
-    """Build seq2seq module + params (pretrained backbone import, fresh heads)."""
+    """Build seq2seq module + params (pretrained backbone import, fresh heads).
+
+    ``abstract=True`` mirrors :func:`build_causal_lm`: a ShapeDtypeStruct
+    pytree for lowering/compiling programs without materializing weights."""
     from trlx_tpu.models.heads import Seq2SeqLMWithILQLHeads, Seq2SeqLMWithValueHead
     from trlx_tpu.models.seq2seq import T5Transformer
 
@@ -398,12 +402,19 @@ def build_seq2seq_lm(
     rng = jax.random.PRNGKey(seed)
     enc = jnp.zeros((1, 8), jnp.int32)
     dec = jnp.zeros((1, 4), jnp.int32)
-    params = module.init(rng, enc, decoder_input_ids=dec)["params"]
 
-    if head == "ilql":
-        from trlx_tpu.models.heads import sync_target_q_params
+    def make_params():
+        p = module.init(rng, enc, decoder_input_ids=dec)["params"]
+        if head == "ilql":
+            from trlx_tpu.models.heads import sync_target_q_params
 
-        params = sync_target_q_params(params, alpha=1.0)
+            p = sync_target_q_params(p, alpha=1.0)
+        return p
+
+    if abstract:
+        return module, jax.eval_shape(make_params), scfg
+
+    params = make_params()
 
     if hf_path is not None:
         from trlx_tpu.models.hf_interop import load_pretrained_seq2seq

@@ -253,7 +253,9 @@ def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
       advantage-reshaping sampler; SFT: masked-CE step);
     - ``grpo_gpt2_test`` / ``dpo_gpt2_test``: the beyond-reference
       algorithms (GRPO: head-less policy + hydra-ref scoring; DPO:
-      paired-completion logp step).
+      paired-completion logp step);
+    - ``ppo_t5_test``: the seq2seq leg — T5 encode/decode generate,
+      teacher-forced scoring with the decoder hydra branch, seq2seq step.
     """
     from trlx_tpu.data.default_configs import (
         default_dpo_config,
@@ -282,6 +284,17 @@ def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
         "sft_gpt2_test": (
             default_sft_config().evolve(
                 model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=-1),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "ppo_t5_test": (
+            base.evolve(
+                model=dict(
+                    model_path="builtin:t5-test",
+                    model_arch_type="seq2seq",
+                    num_layers_unfrozen=1,
+                ),
                 tokenizer=dict(tokenizer_path="builtin:bytes"),
             ),
             dict(batch_size=8, prompt_len=32, gen_len=16),

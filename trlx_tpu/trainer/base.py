@@ -158,37 +158,22 @@ class TPUBaseTrainer(BaseRLTrainer):
         # ``accelerate_ppo_trainer.py:120-134``)
         self.is_seq2seq = config.model.model_arch_type == "seq2seq"
         if self.is_seq2seq:
-            if abstract_init:
-                raise NotImplementedError(
-                    "abstract_init is implemented for causal LMs only"
-                )
             from trlx_tpu.models.builder import build_seq2seq_lm, seq2seq_trainable_mask
 
-            self.module, params, self.tcfg = build_seq2seq_lm(
-                config.model,
-                config.parallel,
-                head=self.model_head,
-                two_qs=two_qs,
-                seed=config.train.seed,
-            )
-            params = shard_params(params, self.mesh)
-            self.param_mask = seq2seq_trainable_mask(
-                params, self.tcfg, config.model.num_layers_unfrozen
-            )
+            build, mask_fn = build_seq2seq_lm, seq2seq_trainable_mask
         else:
-            self.module, params, self.tcfg = build_causal_lm(
-                config.model,
-                config.parallel,
-                head=self.model_head,
-                two_qs=two_qs,
-                seed=config.train.seed,
-                abstract=abstract_init,
-            )
-            if not abstract_init:
-                params = shard_params(params, self.mesh)
-            self.param_mask = trainable_mask(
-                params, self.tcfg, config.model.num_layers_unfrozen
-            )
+            build, mask_fn = build_causal_lm, trainable_mask
+        self.module, params, self.tcfg = build(
+            config.model,
+            config.parallel,
+            head=self.model_head,
+            two_qs=two_qs,
+            seed=config.train.seed,
+            abstract=abstract_init,
+        )
+        if not abstract_init:
+            params = shard_params(params, self.mesh)
+        self.param_mask = mask_fn(params, self.tcfg, config.model.num_layers_unfrozen)
         self.draft_module = self.draft_params = self.draft_tcfg = None
         self.last_spec_stats: Dict[str, float] = {}
         if config.model.draft_model_path and self.is_seq2seq:

@@ -71,37 +71,19 @@ class PPOTrainer(TPUBaseTrainer):
             from trlx_tpu.models.seq2seq import T5Transformer
 
             if nlu > 0:
-                branch = seq2seq_hydra_ref_params(self.state.params, self.tcfg, nlu)
-                self.ref_params = jax.tree_util.tree_map(jnp.copy, branch)
+                extract = lambda p: seq2seq_hydra_ref_params(p, self.tcfg, nlu)  # noqa: E731
             else:
-                self.ref_params = jax.tree_util.tree_map(
-                    jnp.copy, self.state.params["backbone"]
-                )
+                extract = lambda p: p["backbone"]  # noqa: E731
             self._ref_module = T5Transformer(self.tcfg)
         else:
             if nlu > 0:
-                if self.abstract_init:
-                    # shapes only — the branch slice traces fine under
-                    # eval_shape, and an abstract trainer never executes,
-                    # so no buffer-owning copy is needed
-                    self.ref_params = jax.eval_shape(
-                        lambda p: hydra_ref_params(p, self.tcfg, nlu),
-                        self.state.params,
-                    )
-                else:
-                    branch = hydra_ref_params(self.state.params, self.tcfg, nlu)
-                    self.ref_params = jax.tree_util.tree_map(jnp.copy, branch)
+                extract = lambda p: hydra_ref_params(p, self.tcfg, nlu)  # noqa: E731
             else:
                 # head wrappers scope the transformer under "backbone";
                 # head-less policies (GRPO) are the bare transformer tree
-                backbone = (
-                    self.state.params["backbone"]
-                    if "backbone" in self.state.params
-                    else self.state.params
-                )
-                copy = (lambda x: x) if self.abstract_init else jnp.copy
-                self.ref_params = jax.tree_util.tree_map(copy, backbone)
+                extract = lambda p: p["backbone"] if "backbone" in p else p  # noqa: E731
             self._ref_module = CausalTransformer(self.tcfg)
+        self.ref_params = self._ref_snapshot(extract)
 
         self.running_moments = RunningMoments()
         self.ref_mean: Optional[float] = method.ref_mean
@@ -117,6 +99,18 @@ class PPOTrainer(TPUBaseTrainer):
             self.setup_rollout_logging(config)
         else:
             self.log_rollouts = False
+
+    def _ref_snapshot(self, extract):
+        """Frozen-reference snapshot of (a branch of) the current params.
+
+        Real runs take buffer-owning copies (the train step donates its
+        input state, so the snapshot must not alias it); under
+        ``abstract_init`` only shapes are produced — the branch extractor's
+        slicing traces fine under ``eval_shape`` and an abstract trainer
+        never executes."""
+        if self.abstract_init:
+            return jax.eval_shape(extract, self.state.params)
+        return jax.tree_util.tree_map(jnp.copy, extract(self.state.params))
 
     # ------------------------------------------------------------------
     # rollout collection
