@@ -16,6 +16,12 @@ import os
 import sys
 
 os.environ.setdefault("TRLX_TPU_NO_TQDM", "1")
+# sharded budget entries lower over an 8-device virtual mesh — same device
+# count the test suite's conftest forces, so budgets and checks agree
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
 
 import jax

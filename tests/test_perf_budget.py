@@ -116,3 +116,12 @@ def test_budget_ppo_t5_test():
     with the decoder hydra branch, and the seq2seq PPO step — abstract
     weights through build_seq2seq_lm."""
     _assert_within_budget("ppo_t5_test")
+
+
+@pytest.mark.slow
+def test_budget_gptj_6b_fsdp2_tp2_sp2():
+    """The true SPMD program: 6B sharded over an 8-device fsdp2*tp2*sp2
+    mesh with real param/optimizer/batch shardings attached — per-device
+    cost and memory incl. the GSPMD-inserted collectives. A silently lost
+    sharding shows up as a multi-x flop/temp jump."""
+    _assert_within_budget("gptj_6b_fsdp2_tp2_sp2")

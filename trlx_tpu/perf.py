@@ -139,8 +139,20 @@ def hot_program_costs(
     Works for any causal-LM config the trainer accepts — including configs
     far too large to materialize on the analysis host (6B+ with
     ``scan_layers``): only shapes flow through tracing and compilation.
+
+    When the config's mesh spans more than one device, the real GSPMD
+    shardings are attached to every abstract input (params, optimizer
+    moments, batch), so the compiled program is the true SPMD program —
+    collectives included — and its per-device cost/memory is what gets
+    budgeted. Requires the analysis host to expose that many (virtual)
+    devices.
     """
+    import contextlib
+    import dataclasses
+
     from trlx_tpu.ops.sampling import GenerationConfig
+    from trlx_tpu.parallel.mesh import set_global_mesh
+    from trlx_tpu.parallel.sharding import batch_spec, param_shardings
     from trlx_tpu.trainer import get_trainer
     import trlx_tpu.trainer.dpo  # noqa: F401  (registration)
     import trlx_tpu.trainer.grpo  # noqa: F401
@@ -156,44 +168,85 @@ def hot_program_costs(
 
     B, P, N = batch_size, prompt_len, gen_len
     SDS = jax.ShapeDtypeStruct
-    params = trainer.state.params
+    mesh = trainer.mesh
+    multi = int(np.prod(list(mesh.shape.values()))) > 1
+
+    def attach(tree, shardings):
+        return jax.tree_util.tree_map(
+            lambda s, sh: SDS(s.shape, s.dtype, sharding=sh), tree, shardings
+        )
+
+    def with_param_shardings(tree):
+        if not multi:
+            return tree
+        return attach(tree, param_shardings(tree, mesh))
+
+    def batch_sds(shape, dtype):
+        if not multi:
+            return SDS(shape, dtype)
+        from jax.sharding import NamedSharding
+
+        return SDS(
+            shape, dtype, sharding=NamedSharding(mesh, batch_spec(len(shape)))
+        )
+
+    params = with_param_shardings(trainer.state.params)
     results: Dict[str, Dict[str, float]] = {}
-
-    if "generate" in programs:
-        gen_kwargs = dict(trainer.generate_kwargs)
-        gen_kwargs["max_new_tokens"] = N
-        gen_config = GenerationConfig.from_gen_kwargs(
-            gen_kwargs,
-            eos_token_id=trainer.tokenizer.eos_token_id,
-            pad_token_id=trainer.tokenizer.pad_token_id,
-        )
-        fn = trainer._get_generate_fn(gen_config, ())
-        results["generate"] = _costs_of(
-            fn.lower(
-                params,
-                SDS((B, P), np.int32),
-                SDS((B, P), np.int32),
-                jax.random.PRNGKey(0),
+    # sequence-parallel ops read the global mesh during tracing
+    set_global_mesh(mesh)
+    ctx = mesh if multi else contextlib.nullcontext()
+    with ctx:
+        if "generate" in programs:
+            gen_kwargs = dict(trainer.generate_kwargs)
+            gen_kwargs["max_new_tokens"] = N
+            gen_config = GenerationConfig.from_gen_kwargs(
+                gen_kwargs,
+                eos_token_id=trainer.tokenizer.eos_token_id,
+                pad_token_id=trainer.tokenizer.pad_token_id,
             )
-        )
-
-    if "score" in programs:
-        fn = trainer._get_score_fn((B, P, N))
-        results["score"] = _costs_of(
-            fn.lower(
-                params,
-                trainer.ref_params,
-                SDS((B, P + N), np.int32),
-                SDS((B, P), np.int32),
-                SDS((B, N), np.int32),
-                SDS((B, N), np.int32),
+            fn = trainer._get_generate_fn(gen_config, ())
+            results["generate"] = _costs_of(
+                fn.lower(
+                    params,
+                    batch_sds((B, P), np.int32),
+                    batch_sds((B, P), np.int32),
+                    jax.random.PRNGKey(0),
+                )
             )
-        )
 
-    if "train_step" in programs:
-        batch = _train_batch_sds(trainer_name, B, P, N)
-        fn = trainer._build_train_step()
-        results["train_step"] = _costs_of(fn.lower(trainer.state, batch))
+        if "score" in programs:
+            fn = trainer._get_score_fn((B, P, N))
+            results["score"] = _costs_of(
+                fn.lower(
+                    params,
+                    with_param_shardings(trainer.ref_params),
+                    batch_sds((B, P + N), np.int32),
+                    batch_sds((B, P), np.int32),
+                    batch_sds((B, N), np.int32),
+                    batch_sds((B, N), np.int32),
+                )
+            )
+
+        if "train_step" in programs:
+            batch = _train_batch_sds(trainer_name, B, P, N)
+            if multi:
+                batch = {
+                    k: batch_sds(v.shape, v.dtype) for k, v in batch.items()
+                }
+            state = trainer.state
+            if multi:
+                from trlx_tpu.trainer.base import _optimizer_state_shardings
+
+                # derive moment shardings from the SHARDED params tree —
+                # the helper reads each param leaf's .sharding, and the
+                # abstract trainer's own params carry none
+                opt_sh = _optimizer_state_shardings(
+                    mesh, params, trainer.state.opt_state
+                )
+                opt = attach(trainer.state.opt_state, opt_sh)
+                state = dataclasses.replace(state, params=params, opt_state=opt)
+            fn = trainer._build_train_step()
+            results["train_step"] = _costs_of(fn.lower(state, batch))
 
     return results
 
@@ -242,6 +295,11 @@ def check_budget(
 
 def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
     """The config matrix the perf net guards, name → (config, shape kwargs).
+
+    Budgets are tied to an 8-virtual-device analysis host (the generator
+    and the test conftest both force ``xla_force_host_platform_device_count
+    =8``): configs with the default ``data=-1`` compile as dp8 SPMD
+    programs, and the explicit-mesh entries compose fsdp/tp/sp.
 
     - ``gpt2_test``: tiny PPO — exercised in the fast test tier so the net
       runs in the <5-min loop;
@@ -326,6 +384,20 @@ def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
                 tokenizer=dict(tokenizer_path="builtin:bytes"),
                 parallel=dict(scan_layers=True, remat="full"),
             ),
-            dict(batch_size=2, prompt_len=32, gen_len=8),
+            dict(batch_size=8, prompt_len=32, gen_len=8),
+        ),
+        "gptj_6b_fsdp2_tp2_sp2": (
+            # the true SPMD program over an 8-device mesh: per-device
+            # cost/memory incl. the collectives GSPMD inserts — guards the
+            # sharded hot paths (a lost sharding shows up as an 8x jump)
+            base.evolve(
+                model=dict(model_path="builtin:gptj-6b", num_layers_unfrozen=2),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+                parallel=dict(
+                    data=1, fsdp=2, model=2, sequence=2,
+                    scan_layers=True, remat="full",
+                ),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
         ),
     }
