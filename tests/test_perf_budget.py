@@ -125,3 +125,31 @@ def test_budget_gptj_6b_fsdp2_tp2_sp2():
     cost and memory incl. the GSPMD-inserted collectives. A silently lost
     sharding shows up as a multi-x flop/temp jump."""
     _assert_within_budget("gptj_6b_fsdp2_tp2_sp2")
+
+
+def test_capacity_plan_tiny():
+    """plan(): exact sharded weight/optimizer arithmetic + program costs,
+    no weights materialized."""
+    from trlx_tpu.perf import budget_configs, plan
+
+    config, shape = budget_configs()["gpt2_test"]
+    out = plan(config, **shape)
+    assert out["n_params"] > 0
+    # replicated over the dp-only mesh: per-device == full weight bytes
+    assert out["per_device"]["param_bytes"] > 0
+    assert out["per_device"]["optimizer_bytes"] > 0
+    assert "train_step" in out["programs"]
+
+
+@pytest.mark.slow
+def test_capacity_plan_sharded_weights_shrink():
+    """fsdp/tp sharding must reduce per-device weight bytes by the sharded
+    axes' product (up to non-divisible leaves)."""
+    from trlx_tpu.perf import budget_configs, plan
+
+    dense, shape = budget_configs()["gptj_6b_scan"]
+    sharded, shape_s = budget_configs()["gptj_6b_fsdp2_tp2_sp2"]
+    a = plan(dense, **shape)["per_device"]["param_bytes"]
+    b = plan(sharded, **shape_s)["per_device"]["param_bytes"]
+    # dense mesh is dp8 (replicated weights); sharded is fsdp2*tp2 -> ~4x less
+    assert b < a / 3, (a, b)

@@ -51,6 +51,20 @@ def budget_programs() -> Dict[str, Tuple[str, ...]]:
     }
 
 
+def _build_abstract_trainer(config: TRLConfig):
+    """Register all trainers and build the config's trainer on abstract
+    (ShapeDtypeStruct) weights — the shared entry for every analysis path."""
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.dpo  # noqa: F401  (registration)
+    import trlx_tpu.trainer.grpo  # noqa: F401
+    import trlx_tpu.trainer.ilql  # noqa: F401
+    import trlx_tpu.trainer.ppo  # noqa: F401
+    import trlx_tpu.trainer.sft  # noqa: F401
+
+    cls = get_trainer(config.train.trainer)
+    return cls(config, reward_fn=lambda **kw: [0.0], abstract_init=True)
+
+
 def _costs_of(lowered) -> Dict[str, float]:
     compiled = lowered.compile()
     ca = compiled.cost_analysis()
@@ -129,6 +143,7 @@ def hot_program_costs(
     prompt_len: int = DEFAULT_SHAPE["prompt_len"],
     gen_len: int = DEFAULT_SHAPE["gen_len"],
     programs: Optional[Tuple[str, ...]] = None,
+    trainer=None,
 ) -> Dict[str, Dict[str, float]]:
     """Compile the hot programs of a trainer for ``config`` with abstract
     weights and return their XLA cost/memory analysis, keyed by program.
@@ -153,15 +168,9 @@ def hot_program_costs(
     from trlx_tpu.ops.sampling import GenerationConfig
     from trlx_tpu.parallel.mesh import set_global_mesh
     from trlx_tpu.parallel.sharding import batch_spec, param_shardings
-    from trlx_tpu.trainer import get_trainer
-    import trlx_tpu.trainer.dpo  # noqa: F401  (registration)
-    import trlx_tpu.trainer.grpo  # noqa: F401
-    import trlx_tpu.trainer.ilql  # noqa: F401
-    import trlx_tpu.trainer.ppo  # noqa: F401
-    import trlx_tpu.trainer.sft  # noqa: F401
 
-    cls = get_trainer(config.train.trainer)
-    trainer = cls(config, reward_fn=lambda **kw: [0.0], abstract_init=True)
+    if trainer is None:
+        trainer = _build_abstract_trainer(config)
     trainer_name = type(trainer).__name__.lower()
     if programs is None:
         programs = TRAINER_PROGRAMS.get(trainer_name, ("train_step",))
@@ -401,3 +410,153 @@ def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
             dict(batch_size=8, prompt_len=32, gen_len=16),
         ),
     }
+
+
+def plan(
+    config: TRLConfig,
+    batch_size: int = DEFAULT_SHAPE["batch_size"],
+    prompt_len: int = DEFAULT_SHAPE["prompt_len"],
+    gen_len: int = DEFAULT_SHAPE["gen_len"],
+) -> Dict[str, Any]:
+    """Capacity plan for a config without touching an accelerator: param /
+    optimizer / gradient bytes per device (exact, from the abstract trees
+    and their shardings) plus each hot program's compiled cost and temp
+    memory. Answers "will this config fit?" before a pod is ever booked.
+
+    ``temp_bytes`` comes from the CPU backend's compiled buffer assignment —
+    indicative, not a TPU HBM guarantee; the weight/optimizer numbers are
+    exact arithmetic.
+    """
+    from trlx_tpu.parallel.sharding import param_shardings
+
+    trainer = _build_abstract_trainer(config)
+    mesh = trainer.mesh
+    n_dev = int(np.prod(list(mesh.shape.values())))
+
+    params = trainer.state.params
+    p_shard = param_shardings(params, mesh)
+
+    def shard_factor(leaf, sh):
+        # how many ways this leaf is actually split (replicated axes excluded)
+        try:
+            return int(np.prod(leaf.shape)) // int(
+                np.prod(sh.shard_shape(leaf.shape))
+            )
+        except Exception:
+            return 1
+
+    def sharded_bytes(tree, shardings):
+        return sum(
+            int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize // shard_factor(l, s)
+            for l, s in zip(
+                jax.tree_util.tree_leaves(tree),
+                jax.tree_util.tree_leaves(shardings),
+            )
+        )
+
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+    param_bytes_dev = sharded_bytes(params, p_shard)
+    from trlx_tpu.trainer.base import _optimizer_state_shardings
+
+    opt_sh = _optimizer_state_shardings(
+        mesh,
+        jax.tree_util.tree_map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            params,
+            p_shard,
+        ),
+        trainer.state.opt_state,
+    )
+    opt_bytes_dev = sharded_bytes(trainer.state.opt_state, opt_sh)
+
+    costs = hot_program_costs(
+        config,
+        batch_size=batch_size,
+        prompt_len=prompt_len,
+        gen_len=gen_len,
+        trainer=trainer,
+    )
+    return {
+        "mesh": {k: v for k, v in mesh.shape.items() if v > 1} or {"single_device": 1},
+        "n_devices": n_dev,
+        "n_params": n_params,
+        "per_device": {
+            "param_bytes": param_bytes_dev,
+            "optimizer_bytes": opt_bytes_dev,
+            "grad_bytes_upper_bound": param_bytes_dev,
+        },
+        "programs": costs,
+        "note": (
+            "weights/optimizer: exact arithmetic over the sharded abstract "
+            "trees; program temp_bytes: CPU-backend buffer assignment, "
+            "indicative only"
+        ),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json as _json
+
+    parser = argparse.ArgumentParser(
+        description="Capacity planner: compiled cost + memory plan for a "
+        "config, no accelerator or weights needed (abstract lowering)."
+    )
+    parser.add_argument("config", help="TRLConfig YAML path")
+    parser.add_argument("--batch-size", type=int, default=DEFAULT_SHAPE["batch_size"])
+    parser.add_argument("--prompt-len", type=int, default=DEFAULT_SHAPE["prompt_len"])
+    parser.add_argument("--gen-len", type=int, default=DEFAULT_SHAPE["gen_len"])
+    args = parser.parse_args(argv)
+
+    # size the virtual device pool to the config's explicit mesh axes
+    # BEFORE any jax backend initializes — a laptop has one device, and a
+    # sharded plan needs mesh-product many
+    import os
+
+    import yaml
+
+    with open(args.config) as f:
+        raw = yaml.safe_load(f) or {}
+    par = raw.get("parallel") or {}
+    needed = 1
+    for axis in ("data", "pipe", "fsdp", "model", "sequence", "expert"):
+        v = int(par.get(axis, 1))
+        if v > 1:
+            needed *= v
+    if needed > 1 and "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={needed}"
+        ).strip()
+
+    from trlx_tpu.trlx import initialize_runtime
+
+    initialize_runtime()
+    config = TRLConfig.load_yaml(args.config)
+    result = plan(
+        config,
+        batch_size=args.batch_size,
+        prompt_len=args.prompt_len,
+        gen_len=args.gen_len,
+    )
+    gib = 2**30
+    pd = result["per_device"]
+    print(_json.dumps(result, indent=2))
+    print(
+        f"\n# {result['n_params'] / 1e9:.2f}B params on {result['n_devices']} "
+        f"device(s) {result['mesh']}: "
+        f"{pd['param_bytes'] / gib:.2f} GiB weights + "
+        f"{pd['optimizer_bytes'] / gib:.2f} GiB optimizer + "
+        f"<= {pd['grad_bytes_upper_bound'] / gib:.2f} GiB grads per device "
+        f"(+ program temps, see programs.*.temp_bytes)",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
