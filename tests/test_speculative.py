@@ -427,3 +427,53 @@ def test_trainer_speculative_rollouts_e2e(tmp_path):
     trainer.prepare_learning()
     stats = trainer.train_step(next(iter(trainer.store.create_loader(8, shuffle=True))))
     assert np.isfinite(float(np.asarray(stats["losses/total_loss"])))
+
+
+@pytest.mark.parametrize("gamma", [1, 3])
+def test_greedy_min_new_tokens_matches_plain_sampler(gamma):
+    """min_new_tokens composes losslessly (round-4: previously an explicit
+    plain-sampler fallback): greedy speculative output with per-row eos
+    blocking is bit-identical to the plain sampler's, for any draft."""
+    t, d = _models(draft_seed=1)
+    ids, mask = _prompts()
+    t_apply, t_params, t_cfg = t
+    base = generate(
+        t_apply, t_params, lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+        ids, mask, jax.random.PRNGKey(0),
+        GenerationConfig(max_new_tokens=10, do_sample=False, eos_token_id=None, pad_token_id=258),
+    )
+    # an eos that greedy row 0 would emit early — min_new_tokens must defer it
+    eos = int(np.asarray(base.response_tokens)[0, 2])
+    cfg = GenerationConfig(
+        max_new_tokens=10, do_sample=False, eos_token_id=eos, pad_token_id=258,
+        min_new_tokens=6,
+    )
+    ref = generate(
+        t_apply, t_params, lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+        ids, mask, jax.random.PRNGKey(0), cfg,
+    )
+    out = _spec(t, d, ids, mask, cfg, gamma=gamma)
+    assert (np.asarray(out.response_tokens) == np.asarray(ref.response_tokens)).all()
+    assert (np.asarray(out.response_mask) == np.asarray(ref.response_mask)).all()
+    np.testing.assert_allclose(
+        np.asarray(out.response_logprobs), np.asarray(ref.response_logprobs), atol=1e-5
+    )
+
+
+def test_sampled_min_new_tokens_blocks_eos():
+    """Sampled path: no generated row may contain eos before min_new_tokens
+    (positions are per row — later rounds start mid-response)."""
+    t, d = _models(draft_seed=1)
+    ids, mask = _prompts()
+    cfg = GenerationConfig(
+        max_new_tokens=10, do_sample=True, eos_token_id=7, pad_token_id=258,
+        min_new_tokens=5, top_k=0, top_p=1.0,
+    )
+    for seed in range(4):
+        out = _spec(t, d, ids, mask, cfg, gamma=3, rng=seed)
+        toks = np.asarray(out.response_tokens)
+        m = np.asarray(out.response_mask)
+        gen_count = m.sum(axis=1)
+        for b in range(toks.shape[0]):
+            before_min = toks[b, : min(5, int(gen_count[b]))]
+            assert (before_min != 7).all(), (b, toks[b], m[b])

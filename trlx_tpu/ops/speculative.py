@@ -32,9 +32,13 @@ Exactness properties (tested in ``tests/test_speculative.py``):
 
 Transition logit masks (the trainer's ``logit_mask``, e.g. randomwalks'
 allowed-moves table) compose natively: the mask is applied to the draft AND
-the target distributions, so constrained sampling stays lossless. The
-full ``adjust_logits`` hook (ILQL's Q-value reshaping needs per-position
-head outputs) is not supported — ILQL keeps the plain sampler.
+the target distributions, so constrained sampling stays lossless. So does
+``min_new_tokens``: eos is blocked per ROW at response positions below the
+minimum — on the draft proposals and on the target's verify distributions
+alike, before both sampling and the behavior logprob — exactly the plain
+sampler's semantics. The full ``adjust_logits`` hook (ILQL's Q-value
+reshaping needs per-position head outputs) is not supported — ILQL keeps
+the plain sampler.
 """
 
 from typing import Any, Callable, Optional
@@ -144,10 +148,6 @@ def generate_speculative(
     head is attached), the draft's just ``logits``. Fully jittable with
     static ``config``/``gamma``.
     """
-    if config.min_new_tokens > 0:
-        raise NotImplementedError(
-            "min_new_tokens is unsupported in speculative decoding"
-        )
     B, P = input_ids.shape
     N = config.max_new_tokens
     G = gamma
@@ -208,6 +208,17 @@ def generate_speculative(
             logits_j = out_j["logits"][:, -1, :].astype(jnp.float32)
             if transition_mask is not None:
                 logits_j = apply_transition_mask(transition_mask, prev, logits_j)
+            if config.eos_token_id is not None and config.min_new_tokens > 0:
+                # proposal j lands at response position n_out + j: block eos
+                # there exactly like the plain sampler (q then matches the
+                # distribution the proposal is actually drawn from)
+                block_j = (n_out + j) < config.min_new_tokens  # [B]
+                logits_j = jnp.where(
+                    block_j[:, None]
+                    & (jnp.arange(logits_j.shape[-1])[None, :] == config.eos_token_id),
+                    -jnp.inf,
+                    logits_j,
+                )
             probs_j = _filtered_probs(logits_j, config)
             rng, rj = jax.random.split(rng)
             if config.do_sample:
@@ -244,6 +255,21 @@ def generate_speculative(
             # masking to the plain sampler's logit-mask hook, so behavior
             # logprobs below come from the same (masked) distribution
             t_logits = apply_transition_mask(transition_mask, verify_in, t_logits)
+        if config.eos_token_id is not None and config.min_new_tokens > 0:
+            # verify position j produces response position n_out + j; the
+            # plain sampler blocks eos there BEFORE both sampling and the
+            # behavior logprob, so the mask goes on t_logits (feeding
+            # p_probs and t_logprobs_all alike) for exactness
+            pos = n_out[:, None] + jnp.arange(G + 1)[None, :]  # [B, G+1]
+            t_logits = jnp.where(
+                (pos < config.min_new_tokens)[..., None]
+                & (
+                    jnp.arange(t_logits.shape[-1])[None, None, :]
+                    == config.eos_token_id
+                ),
+                -jnp.inf,
+                t_logits,
+            )
         p_probs = _filtered_probs(t_logits, config)  # p_0 .. p_G
         t_logprobs_all = jax.nn.log_softmax(t_logits, axis=-1)
         t_values = t_out.get("value")

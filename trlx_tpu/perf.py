@@ -417,6 +417,7 @@ def plan(
     batch_size: int = DEFAULT_SHAPE["batch_size"],
     prompt_len: int = DEFAULT_SHAPE["prompt_len"],
     gen_len: int = DEFAULT_SHAPE["gen_len"],
+    programs: Optional[Tuple[str, ...]] = None,
 ) -> Dict[str, Any]:
     """Capacity plan for a config without touching an accelerator: param /
     optimizer / gradient bytes per device (exact, from the abstract trees
@@ -469,11 +470,14 @@ def plan(
     )
     opt_bytes_dev = sharded_bytes(trainer.state.opt_state, opt_sh)
 
+    # programs=() skips compilation entirely — the weight/optimizer
+    # arithmetic alone is near-instant even at 20B+
     costs = hot_program_costs(
         config,
         batch_size=batch_size,
         prompt_len=prompt_len,
         gen_len=gen_len,
+        programs=programs,
         trainer=trainer,
     )
     return {

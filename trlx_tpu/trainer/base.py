@@ -539,8 +539,8 @@ class TPUBaseTrainer(BaseRLTrainer):
                 self.draft_module is not None
                 and algo_adjust is None  # transition logit_mask composes
                 # natively (applied to draft AND target); ILQL reshaping
-                # does not
-                and gen_config.min_new_tokens == 0
+                # does not. min_new_tokens also composes: per-row positional
+                # eos blocking on draft and target alike (lossless).
             ):
                 # no adjust hook here: the mask rides transition_mask below
                 # speculative decoding: draft proposes, the policy verifies
@@ -581,12 +581,6 @@ class TPUBaseTrainer(BaseRLTrainer):
                         "draft_model_path set but this sampler reshapes "
                         "logits (ILQL advantage reshaping): speculative "
                         "decoding disabled for this generate path — rollouts "
-                        "use the plain sampler"
-                    )
-                elif self.draft_module is not None and gen_config.min_new_tokens > 0:
-                    logger.warning(
-                        "draft_model_path set but min_new_tokens > 0 is "
-                        "unsupported by the speculative sampler — rollouts "
                         "use the plain sampler"
                     )
                 apply_fn = self._apply_fn()
@@ -654,8 +648,8 @@ class TPUBaseTrainer(BaseRLTrainer):
             self.mesh,
         )
         # cleared up front so stats only ever reflect the *current* rollout
-        # path — a later plain-sampler generate (ILQL adjust hook,
-        # min_new_tokens > 0) must not keep reporting a stale acceptance rate
+        # path — a later plain-sampler generate (ILQL adjust hook) must not
+        # keep reporting a stale acceptance rate
         self.last_spec_stats = {}
         out = fn(self.state.params, batch["input_ids"], batch["attention_mask"], rng)
         if type(out) is tuple:  # speculative sampler: (output, stats) —
