@@ -36,9 +36,10 @@ the target distributions, so constrained sampling stays lossless. So does
 ``min_new_tokens``: eos is blocked per ROW at response positions below the
 minimum — on the draft proposals and on the target's verify distributions
 alike, before both sampling and the behavior logprob — exactly the plain
-sampler's semantics. The full ``adjust_logits`` hook (ILQL's Q-value
-reshaping needs per-position head outputs) is not supported — ILQL keeps
-the plain sampler.
+sampler's semantics. And so does the full ``adjust_logits`` hook (ILQL's
+Q-value reshaping): it is applied to the target's per-position verify
+outputs — sampling is exact w.r.t. the ADJUSTED target distribution, and
+the (unadjusted) draft's mismatch only costs acceptance rate.
 """
 
 from typing import Any, Callable, Optional
@@ -47,6 +48,7 @@ import jax
 import jax.numpy as jnp
 
 from trlx_tpu.ops.sampling import (
+    _NON_CARRY_KEYS,
     GenerationConfig,
     GenerationOutput,
     apply_transition_mask,
@@ -139,6 +141,14 @@ def generate_speculative(
     transition_mask: Optional[jax.Array] = None,  # [Vm, Vm'] bool: the
     # trainer's prev→next logit mask; applied identically to draft AND
     # target so constrained sampling (e.g. randomwalks) stays lossless
+    adjust_logits: Optional[Callable[[Any, jax.Array], jax.Array]] = None,
+    # algorithm logit reshaping (ILQL: log π + β(minQ − V)) applied to the
+    # TARGET's verify distributions — step_out carries the target forward's
+    # per-position outputs ([B, G+1, ...] views), so the hook must be
+    # shape-polymorphic over leading dims (the trainer's hooks are). The
+    # draft proposes from its own unadjusted distribution; the acceptance
+    # rule corrects it, so sampling stays exact w.r.t. the ADJUSTED target
+    # — a mismatched draft just lowers the acceptance rate.
 ):
     """Sample ``config.max_new_tokens`` continuations via draft-and-verify.
 
@@ -250,6 +260,19 @@ def generate_speculative(
         )
         t_cache_new = t_out["cache"]
         t_logits = t_out["logits"].astype(jnp.float32)  # [B, G+1, V]
+        if adjust_logits is not None:
+            # same order as the plain sampler: algo reshaping first, then
+            # transition mask, then min_new_tokens eos blocking. step_info
+            # mirrors the plain sampler's step_out keys (incl. last_tokens),
+            # but fields keep the verify shape [B, G+1, ...] where plain
+            # passes last-position [B, ...] views — hence the hook contract:
+            # leading-dim polymorphic (see BaseRLTrainer.adjust_logits_fn)
+            step_info = {
+                k: v for k, v in t_out.items()
+                if k not in _NON_CARRY_KEYS and v is not None
+            }
+            step_info["last_tokens"] = verify_in  # token position j conditions on
+            t_logits = adjust_logits(step_info, t_logits)
         if transition_mask is not None:
             # p_j conditions on verify position j's input token — identical
             # masking to the plain sampler's logit-mask hook, so behavior

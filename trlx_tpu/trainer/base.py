@@ -341,6 +341,16 @@ class TPUBaseTrainer(BaseRLTrainer):
         ``extra_kwargs`` are the gen kwargs not consumed by
         :class:`GenerationConfig` (e.g. ILQL's ``beta``) — resolved per
         ``generate`` call, so kwarg overrides and eval sweeps reach the hook.
+
+        Contract: ``fn(step_out, logits) -> logits`` must be polymorphic
+        over leading dims. The plain sampler passes last-position views
+        (``[B, ...]`` fields, ``[B, V]`` logits); the speculative sampler
+        passes the verify block (``[B, G+1, ...]`` fields, ``[B, G+1, V]``
+        logits) with the same keys (model outputs + ``last_tokens``). Hooks
+        that broadcast per-position fields against the trailing vocab axis
+        — like ILQL's — satisfy this automatically; hooks that reshape
+        assuming a fixed rank do not and must not be paired with a draft
+        model.
         """
         return None
 
@@ -535,17 +545,15 @@ class TPUBaseTrainer(BaseRLTrainer):
                         adjust_logits=adjust,
                     )
 
-            elif (
-                self.draft_module is not None
-                and algo_adjust is None  # transition logit_mask composes
-                # natively (applied to draft AND target); ILQL reshaping
-                # does not. min_new_tokens also composes: per-row positional
-                # eos blocking on draft and target alike (lossless).
-            ):
-                # no adjust hook here: the mask rides transition_mask below
+            elif self.draft_module is not None:
                 # speculative decoding: draft proposes, the policy verifies
                 # γ tokens per forward — lossless, so the rollout semantics
-                # (tokens/logprobs/values under the policy) are unchanged
+                # (tokens/logprobs/values under the policy) are unchanged.
+                # Every sampler feature composes: the transition logit_mask
+                # (applied to draft AND target), min_new_tokens (per-row
+                # positional eos blocking), and the algo adjust hook (ILQL
+                # reshaping — applied to the target's verify distributions;
+                # a mismatched plain draft only costs acceptance rate).
                 from trlx_tpu.ops.speculative import generate_speculative
 
                 apply_fn = self._apply_fn()
@@ -573,16 +581,10 @@ class TPUBaseTrainer(BaseRLTrainer):
                         gamma=gamma,
                         return_stats=True,
                         transition_mask=trans_mask,
+                        adjust_logits=algo_adjust,
                     )
 
             else:
-                if self.draft_module is not None and algo_adjust is not None:
-                    logger.warning(
-                        "draft_model_path set but this sampler reshapes "
-                        "logits (ILQL advantage reshaping): speculative "
-                        "decoding disabled for this generate path — rollouts "
-                        "use the plain sampler"
-                    )
                 apply_fn = self._apply_fn()
                 tcfg = self.tcfg
                 adjust = self._compose_logit_mask(algo_adjust)
@@ -648,8 +650,8 @@ class TPUBaseTrainer(BaseRLTrainer):
             self.mesh,
         )
         # cleared up front so stats only ever reflect the *current* rollout
-        # path — a later plain-sampler generate (ILQL adjust hook) must not
-        # keep reporting a stale acceptance rate
+        # path — a draft-less or seq2seq generate must not keep reporting a
+        # stale acceptance rate from an earlier speculative call
         self.last_spec_stats = {}
         out = fn(self.state.params, batch["input_ids"], batch["attention_mask"], rng)
         if type(out) is tuple:  # speculative sampler: (output, stats) —
