@@ -178,3 +178,49 @@ def test_rloo_e2e_smoke(tmp_path):
     trainer.prepare_learning()
     stats = trainer.train_step(next(iter(trainer.store.create_loader(8, shuffle=True))))
     assert np.isfinite(float(np.asarray(stats["losses/total_loss"])))
+
+
+@pytest.mark.slow
+def test_grpo_speculative_rollouts_e2e(tmp_path):
+    """GRPO with a draft model: grouped rollouts ride the speculative
+    sampler (head-less policy — draft-and-verify composes with group
+    repetition), acceptance stats land in the training stats stream."""
+    config = default_grpo_config().evolve(
+        train=dict(
+            seq_length=32,
+            batch_size=8,
+            total_steps=2,
+            eval_interval=2,
+            checkpoint_interval=100000,
+            epochs=100,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+            logging_dir=str(tmp_path / "logs"),
+            tracker="jsonl",
+        ),
+        model=dict(
+            model_path="builtin:gpt2-test",
+            num_layers_unfrozen=1,
+            draft_model_path="builtin:gpt2-test",
+            draft_gamma=3,
+        ),
+        method=dict(
+            num_rollouts=8,
+            chunk_size=8,
+            group_size=4,
+            ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    trainer = trlx.train(
+        reward_fn=lambda samples, **kw: [float(len(s) % 5) for s in samples],
+        prompts=["hello world", "foo bar"] * 4,
+        eval_prompts=["hi"] * 8,
+        config=config,
+    )
+    assert trainer.iter_count == 2
+    rows = [
+        json.loads(line)
+        for line in open(os.path.join(str(tmp_path / "logs"), "stats.jsonl"))
+    ]
+    rates = [r["rollout/spec_acceptance_rate"] for r in rows if "rollout/spec_acceptance_rate" in r]
+    assert rates and all(0.0 <= x <= 1.0 for x in rates)

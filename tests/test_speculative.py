@@ -583,3 +583,70 @@ def test_sampled_adjust_distribution_matches_target():
     b = np.bincount(np.asarray(out.response_tokens)[:, 0], minlength=259) / B
     tv = 0.5 * np.abs(a - b).sum()
     assert tv < 0.15, tv  # top_k=4, n=512 -> noise floor ~= 0.06
+
+
+@pytest.mark.slow
+def test_all_sampler_features_compose_greedy_exact():
+    """The full composition — transition mask + min_new_tokens + algo
+    adjust hook + eos — in ONE speculative decode, bit-identical to the
+    plain sampler with the equivalent composed hook."""
+    from trlx_tpu.ops.sampling import apply_transition_mask
+
+    t, d = _ilql_models(draft_seed=3)
+    t_apply, t_params, t_cfg = t
+    ids, mask = _prompts()
+    V = 64
+    tmask = np.zeros((V, V), bool)
+    for v in range(V):
+        for step in (1, 2, 3):
+            tmask[v, (v + step) % V] = True
+    tmask_j = jnp.asarray(tmask)
+    ilql_adjust = _ilql_adjust(beta=2.0)
+
+    def composed(step_out, logits):
+        # plain-sampler order: algo adjust, then transition mask (the eos
+        # block lives inside sample_token_from_logits / the spec verify)
+        logits = ilql_adjust(step_out, logits)
+        return apply_transition_mask(tmask_j, step_out["last_tokens"], logits)
+
+    # pick an eos the unconstrained composed decode emits EARLY (position <
+    # min_new_tokens), so the min-block genuinely reroutes the decode and
+    # eos termination genuinely fires later
+    cfg0 = GenerationConfig(
+        max_new_tokens=10, do_sample=False, eos_token_id=None, pad_token_id=258
+    )
+    base = generate(
+        t_apply, t_params, lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+        ids, mask, jax.random.PRNGKey(0), cfg0, adjust_logits=composed,
+    )
+    eos = int(np.asarray(base.response_tokens)[0, 1])
+
+    def run(min_new):
+        cfg = GenerationConfig(
+            max_new_tokens=10, do_sample=False, eos_token_id=eos,
+            pad_token_id=258, min_new_tokens=min_new,
+        )
+        ref = generate(
+            t_apply, t_params, lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+            ids, mask, jax.random.PRNGKey(0), cfg, adjust_logits=composed,
+        )
+        out = _spec(
+            t, d, ids, mask, cfg, gamma=3,
+            transition_mask=tmask_j, adjust_logits=ilql_adjust,
+        )
+        return ref, out
+
+    ref, out = run(min_new=4)
+    assert (np.asarray(out.response_tokens) == np.asarray(ref.response_tokens)).all()
+    assert (np.asarray(out.response_mask) == np.asarray(ref.response_mask)).all()
+    np.testing.assert_allclose(
+        np.asarray(out.response_logprobs), np.asarray(ref.response_logprobs), atol=1e-5
+    )
+    # the eos/min features must be LOAD-BEARING in this composition:
+    ref0, out0 = run(min_new=0)
+    assert (np.asarray(out0.response_tokens) == np.asarray(ref0.response_tokens)).all()
+    assert (np.asarray(ref0.response_tokens) != np.asarray(ref.response_tokens)).any(), (
+        "min_new_tokens did not change the composed decode — inert test"
+    )
+    m0 = np.asarray(ref0.response_mask)
+    assert m0[0].sum() < m0.shape[1], "eos termination never fired — inert test"
