@@ -2,7 +2,13 @@
 sampling (capability parity: ``/root/reference/examples/nemo_ilql_inference.py``
 — the TP/PP-aware NeMo checkpoint loader + inference loop; here the mesh
 comes from the same ParallelConfig the training run used and the checkpoint
-is the trainer's saved state)."""
+is the trainer's saved state).
+
+Fast inference: set ``model.draft_model_path`` (e.g. via hparams
+``{"model.draft_model_path": "path/to/small-draft"}``) and the reshaped
+sampler rides speculative draft-and-verify — the Q-value adjustment is
+applied to the policy's verify distributions, so outputs stay exact while
+the policy runs one forward per ``draft_gamma+1`` tokens."""
 
 import os
 import sys
