@@ -650,3 +650,41 @@ def test_all_sampler_features_compose_greedy_exact():
     )
     m0 = np.asarray(ref0.response_mask)
     assert m0[0].sum() < m0.shape[1], "eos termination never fired — inert test"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "par",
+    [dict(data=2, fsdp=2, model=2), dict(data=1, fsdp=2, model=2, sequence=2)],
+    ids=["dp2_fsdp2_tp2", "fsdp2_tp2_sp2"],
+)
+def test_speculative_on_sharded_mesh(par, tmp_path):
+    """Draft-and-verify rollouts over real GSPMD meshes: dp x fsdp x tp and
+    fsdp x tp x sp (scan_layers on). Same acceptance stats as single-device
+    — the sampler program is mesh-agnostic."""
+    import trlx_tpu.trainer.ppo  # noqa: F401
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.parallel.mesh import set_global_mesh
+    from trlx_tpu.trainer import get_trainer
+
+    set_global_mesh(None)
+    cfg = default_ppo_config().evolve(
+        train=dict(total_steps=1, batch_size=8, seq_length=32,
+                   eval_interval=10**6, checkpoint_interval=10**6,
+                   tracker=None, checkpoint_dir=str(tmp_path)),
+        model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1,
+                   model_extra_kwargs=dict(scan_layers=True),
+                   draft_model_path="builtin:gpt2-test", draft_gamma=3),
+        tokenizer=dict(tokenizer_path="builtin:bytes"),
+        parallel=par,
+        method=dict(num_rollouts=8, chunk_size=8,
+                    gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                                    do_sample=True)),
+    )
+    t = get_trainer(cfg.train.trainer)(cfg, reward_fn=lambda **kw: [0.0] * 8)
+    ids = np.full((8, 8), 65, np.int32)
+    out = t.generate(ids, np.ones_like(ids))
+    m = np.asarray(jax.device_get(out.response_mask))
+    assert m.sum() > 0
+    assert 0.0 <= t.last_spec_stats["rollout/spec_acceptance_rate"] <= 1.0
+    set_global_mesh(None)
