@@ -38,7 +38,7 @@ from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 
 def pick_microbatches(batch_size: int, num_stages: int, requested: int = 0) -> int:
@@ -138,12 +138,21 @@ def pipeline_blocks(
         )
 
     def constrain(a, *spec):
-        if mesh is None or not isinstance(a, jax.core.Tracer):
+        if not isinstance(a, jax.core.Tracer):
             return a
-        full = spec + (None,) * (a.ndim - len(spec))
-        return jax.lax.with_sharding_constraint(
-            a, jax.sharding.NamedSharding(mesh, P(*full))
-        )
+        from trlx_tpu.parallel.sharding import constrain_activation
+
+        return constrain_activation(a, mesh, *spec)
+
+    # the microbatch streams are sliced per tick and injected into the
+    # [S, mb, ...] stage buffer (dim1 over data×fsdp); constraining them here,
+    # once, hands every per-tick slice to the buffer in its final layout —
+    # otherwise the split()-reshape of the batch-sharded input leaves the
+    # slices in a transposed device order the partitioner can only reconcile
+    # with an involuntary full rematerialization at each injection
+    xs = constrain(xs, None, ("data", "fsdp"))
+    masks = constrain(masks, None, ("data", "fsdp"))
+    poss = constrain(poss, None, ("data", "fsdp"))
 
     def stage_fn(stage_params, h, mask_mb, pos_mb, branch_buf, stage_cache, m_idx, stage_idx, valid):
         """One stage: apply its ``lps`` blocks to the resident microbatch."""
@@ -225,11 +234,21 @@ def pipeline_blocks(
         tick, init, (xs, masks, poss, jnp.arange(tk))
     )
 
-    # microbatch m exits the last stage at tick m + S - 1
-    hidden = ys[S - 1 :].reshape((B,) + x.shape[1:])
-    branch_input = (
-        brs[S - 1 :].reshape((B,) + x.shape[1:]) if track_branch else None
+    # microbatch m exits the last stage at tick m + S - 1. The exit streams
+    # get the mirror treatment of the feed streams: pin the per-tick layout
+    # before the slice+reshape back to [B, ...] so the drain (and its
+    # autodiff transpose) reshards via cheap collectives instead of a full
+    # rematerialization.
+    ys = constrain(ys, None, ("data", "fsdp"))
+    hidden = constrain(
+        ys[S - 1 :].reshape((B,) + x.shape[1:]), ("data", "fsdp")
     )
+    branch_input = None
+    if track_branch:
+        brs = constrain(brs, None, ("data", "fsdp"))
+        branch_input = constrain(
+            brs[S - 1 :].reshape((B,) + x.shape[1:]), ("data", "fsdp")
+        )
     new_cache = None
     if cache is not None:
         new_cache = jax.tree_util.tree_map(

@@ -79,10 +79,11 @@ def param_spec_for_path(
 ) -> P:
     """Resolve the PartitionSpec for a parameter path.
 
-    With a ``mesh``, axes that do not divide the corresponding dimension are
-    dropped (replicated) — e.g. a 50257 vocab over a 4-way model axis — so
-    sharding never fails on awkward dims; XLA still shards everything that
-    divides cleanly.
+    With a ``mesh``, each dim keeps the longest prefix of its axis group that
+    divides it (:func:`fit_spec`) — e.g. a 50257 vocab over ``('model',
+    'fsdp')`` replicates (odd vocab), while a vocab divisible by ``model``
+    but not ``model×fsdp`` still shards over ``model`` — so sharding never
+    fails on awkward dims and XLA still shards everything that divides.
     """
     for pattern, spec in _RULES:
         if re.match(pattern, path):
@@ -95,13 +96,10 @@ def param_spec_for_path(
         # per-stage Megatron partitions, ``modeling_nemo_ilql.py:219-250``);
         # at pipe=1 the axis is size 1 and the spec is a no-op
         partitions = ("pipe",) + partitions
-    partitions = partitions + (None,) * (len(shape) - len(partitions))
     partitions = partitions[: len(shape)]
     if mesh is not None:
-        partitions = tuple(
-            axis if axis is not None and shape[i] % _axis_size(mesh, axis) == 0 else None
-            for i, axis in enumerate(partitions)
-        )
+        return fit_spec(mesh, shape, partitions)
+    partitions = partitions + (None,) * (len(shape) - len(partitions))
     return P(*partitions)
 
 
@@ -149,6 +147,69 @@ def shard_params(params: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), params, param_shardings(params, mesh)
     )
+
+
+def fit_spec(mesh: Mesh, shape: Tuple[int, ...], spec: Tuple[Any, ...]) -> P:
+    """Fit a PartitionSpec to a concrete shape: per dim, keep the longest
+    prefix of the axis group whose product divides the dim (``None`` when no
+    present axis divides).
+
+    Sharding constraints written for the general case meet awkward concrete
+    dims — a microbatch of 1, a 6-wide head dim on a 4-way axis group. Padding
+    a dim onto an axis it doesn't divide gives every consumer a
+    differently-padded layout, and each reshard between them becomes a GSPMD
+    involuntary full rematerialization; dropping just the non-dividing suffix
+    keeps whatever sharding still fits. Size-1 axes that divide are KEPT —
+    they are sharding no-ops, but retaining them keeps specs stable across
+    mesh sizes (the rule table reads the same at pipe=1 and pipe=4).
+    """
+    if len(spec) > len(shape):
+        raise ValueError(
+            f"spec {tuple(spec)} has more entries than array rank {len(shape)}"
+        )
+    out = []
+    for dim, axis in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if axis is None:
+            out.append(None)
+            continue
+        names = axis if isinstance(axis, tuple) else (axis,)
+        keep: list = []
+        size = 1
+        for n in names:
+            if n not in mesh.shape:
+                continue  # absent axis contributes size 1 — skip, don't emit
+            s = mesh.shape[n]
+            if dim % (size * s):
+                break
+            keep.append(n)
+            size *= s
+        if keep:
+            out.append(tuple(keep) if len(keep) > 1 else keep[0])
+        else:
+            out.append(None)
+    return P(*out)
+
+
+def spec_shards(mesh: Mesh, spec: P) -> int:
+    """Total ways ``spec`` splits an array on ``mesh`` (1 = pure no-op)."""
+    total = 1
+    for axis in spec:
+        total *= _axis_size(mesh, axis)
+    return total
+
+
+def constrain_activation(a: jax.Array, mesh: Optional[Mesh], *spec) -> jax.Array:
+    """``with_sharding_constraint`` with the :func:`fit_spec` guard — the one
+    helper behind every activation-layout pin (decode embedding, pipeline
+    feed/drain streams, MoE dispatch). No-op without a mesh or when the
+    fitted spec shards nothing (a no-op constraint would still force full
+    replication rather than preserve layout freedom)."""
+    if mesh is None:
+        return a
+    fitted = fit_spec(mesh, a.shape, spec)
+    if spec_shards(mesh, fitted) == 1:
+        return a
+    return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, fitted))
 
 
 def batch_spec(ndim: int = 2, sequence_sharded: bool = False) -> P:
