@@ -1,0 +1,203 @@
+"""Golden-value parity fixtures for the loss math (round-4 verdict #4).
+
+Every constant below was produced by evaluating the REFERENCE formulas —
+``/root/reference/trlx/models/modeling_ppo.py:134-233`` (GAE, clipped PG/VF
+loss, k3 approx-KL), ``accelerate_ppo_trainer.py:431-461`` (k1 per-token KL
+penalty + k3 controller mean), ``modeling_ilql.py:60-132`` (the four ILQL
+terms) and ``utils/modeling.py:205-215`` (whiten, unbiased torch.var_mean) —
+in float64 torch on the fixed inputs regenerated here from seeded numpy RNGs.
+The tests assert our pure-JAX implementations reproduce those numbers, so
+"reward parity with the reference" is argued from numerics, not vibes: any
+drift in clipping, masking, discounting, expectile weighting, or the
+variance convention shows up as a hard numeric mismatch.
+
+Inputs are float64-generated but fed to our float32 kernels; tolerances are
+set to float32 roundoff (1e-5 relative), far below any semantic difference
+the fixtures guard against (e.g. biased vs unbiased whitening variance is a
+~3.5% effect at these sizes).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.models.ppo import PPOConfig, kl_penalty_rewards, kl_penalty_rewards_np
+from trlx_tpu.models.ilql import ILQLConfig
+from trlx_tpu.utils.stats import whiten
+
+
+def _arr(rng, *shape, scale=1.0):
+    return (rng.normal(size=shape) * scale).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# GAE advantages/returns (modeling_ppo.py:134-172), gamma=0.95, lam=0.9
+# ---------------------------------------------------------------------------
+GAE_ADV = [
+    [-0.7543897102, 1.1321152069, -0.9417227221, -0.7080312356, 1.9260722332],
+    [1.1374035322, -0.2266586852, 0.634008132, -0.3246129006, 0.6388800165],
+    [-0.2649319126, 0.0603865484, 0.5956663489, -0.6922855349, -0.2520988407],
+]
+GAE_RET = [
+    [-0.4496726305, 0.0921311007, -0.1912715263, 0.2325334808, -0.0249629555],
+    [-0.1647759746, -0.0988182821, 0.3177655397, -0.3414140581, -0.2141639111],
+    [0.6144660623, 0.8381784838, 0.6616970465, 0.4349556721, 0.2154105015],
+]
+GAE_ADV_WHITE = [
+    [-1.0511635768, 1.1894338718, -1.273658554, -0.9961037257, 2.1324147626],
+    [1.1957148033, -0.4243786809, 0.5978332716, -0.5407186719, 0.6036195973],
+    [-0.469835703, -0.0834557328, 0.5522948261, -0.977402594, -0.4545938937],
+]
+
+
+def _ppo_config(**overrides):
+    base = dict(
+        ppo_epochs=1, num_rollouts=8, chunk_size=8, init_kl_coef=0.1,
+        target=None, horizon=10000, gamma=0.95, lam=0.9, cliprange=0.2,
+        cliprange_value=0.2, vf_coef=1.0, scale_reward=None, ref_mean=None,
+        ref_std=None, cliprange_reward=10.0, gen_kwargs={},
+    )
+    base.update(overrides)
+    return PPOConfig(**base)
+
+
+def test_gae_matches_reference():
+    rng = np.random.default_rng(42)
+    values = _arr(rng, 3, 5)
+    rewards = _arr(rng, 3, 5, scale=0.5)
+    cfg = _ppo_config()
+    adv, ret = cfg.get_advantages_and_returns(
+        jnp.asarray(values), jnp.asarray(rewards), use_whitening=False
+    )
+    np.testing.assert_allclose(np.asarray(adv), GAE_ADV, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ret), GAE_RET, rtol=1e-5, atol=1e-5)
+
+
+def test_gae_whitened_matches_reference():
+    rng = np.random.default_rng(42)
+    values = _arr(rng, 3, 5)
+    rewards = _arr(rng, 3, 5, scale=0.5)
+    cfg = _ppo_config()
+    adv, _ = cfg.get_advantages_and_returns(
+        jnp.asarray(values), jnp.asarray(rewards), use_whitening=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(adv), GAE_ADV_WHITE, rtol=1e-5, atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# PPO clipped loss (modeling_ppo.py:176-233), cliprange=cliprange_value=0.2,
+# vf_coef=1.0, mask with per-row padding
+# ---------------------------------------------------------------------------
+PPO_GOLD = dict(
+    total=1.060299085485, pg=0.428656261919, vf=0.631642823566,
+    approx_kl=0.055831804499, pg_clipfrac=0.181818181818,
+    vf_clipfrac=0.545454545455,
+)
+
+
+def test_ppo_loss_matches_reference():
+    rng = np.random.default_rng(7)
+    logprobs = _arr(rng, 3, 5, scale=0.3)
+    old_logprobs = _arr(rng, 3, 5, scale=0.3)
+    values = _arr(rng, 3, 5)
+    old_values = _arr(rng, 3, 5)
+    advantages = _arr(rng, 3, 5)
+    returns = _arr(rng, 3, 5)
+    mask = np.array([[1, 1, 1, 1, 0], [1, 1, 0, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+    cfg = _ppo_config()
+    loss, stats = cfg.loss(
+        *(jnp.asarray(a) for a in (
+            logprobs, values, old_logprobs, old_values, advantages, returns, mask
+        ))
+    )
+    assert np.isclose(float(loss), PPO_GOLD["total"], rtol=1e-5)
+    assert np.isclose(float(stats["losses/policy_loss"]), PPO_GOLD["pg"], rtol=1e-5)
+    assert np.isclose(float(stats["losses/value_loss"]), PPO_GOLD["vf"], rtol=1e-5)
+    assert np.isclose(float(stats["policy/approx_kl"]), PPO_GOLD["approx_kl"], rtol=1e-4)
+    assert np.isclose(float(stats["policy/clipfrac"]), PPO_GOLD["pg_clipfrac"], rtol=1e-6)
+    assert np.isclose(float(stats["values/clipfrac"]), PPO_GOLD["vf_clipfrac"], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# k1 per-token KL penalty + score at final token + k3 controller mean
+# (accelerate_ppo_trainer.py:438-461), kl_coef=0.1
+# ---------------------------------------------------------------------------
+KL_REWARDS = [
+    [0.0171566957, -0.0214093605, 0.442909962, 0.0, 0.0],
+    [-0.013718258, -0.0833643945, 0.0180418521, -0.0566980576, -1.0029206316],
+    [1.9047759025, 0.0, 0.0, 0.0, 0.0],
+]
+KL_MEAN_K3 = 0.104427469911
+
+
+@pytest.mark.parametrize("impl", [kl_penalty_rewards, kl_penalty_rewards_np])
+def test_kl_penalty_rewards_match_reference(impl):
+    rng = np.random.default_rng(11)
+    lp = _arr(rng, 3, 5, scale=0.4)
+    ref_lp = _arr(rng, 3, 5, scale=0.4)
+    mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1], [1, 0, 0, 0, 0]], np.float32)
+    scores = np.array([0.5, -1.0, 2.0], np.float32)
+    if impl is kl_penalty_rewards:
+        lp, ref_lp, mask, scores = (jnp.asarray(a) for a in (lp, ref_lp, mask, scores))
+    rewards, (mean_kl, _) = impl(lp, ref_lp, mask, scores, 0.1)
+    np.testing.assert_allclose(np.asarray(rewards), KL_REWARDS, rtol=1e-5, atol=1e-6)
+    assert np.isclose(float(mean_kl), KL_MEAN_K3, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# ILQL four terms (modeling_ilql.py:60-132): gamma=0.99, tau=0.7,
+# cql_scale=0.1, awac_scale=1.0, beta=0.5, two_qs
+# ---------------------------------------------------------------------------
+ILQL_GOLD = dict(
+    q=4.640061006311, v=0.493583770748, cql=3.554958861525,
+    awac=1.237442703239, total=6.726583366451,
+)
+
+
+def test_ilql_loss_matches_reference():
+    rng = np.random.default_rng(13)
+    B, S, V = 2, 4, 7
+    A = S - 1
+    logits = _arr(rng, B, A, V)
+    qs = tuple(jnp.asarray(_arr(rng, B, A, V)) for _ in range(2))
+    target_qs = tuple(jnp.asarray(_arr(rng, B, A, V)) for _ in range(2))
+    vs = _arr(rng, B, S, 1)
+    actions = rng.integers(0, V, size=(B, A)).astype(np.int32)
+    rewards = _arr(rng, B, A, scale=0.5)
+    dones = np.array([[1, 1, 1, 0], [1, 1, 0, 0]], np.float32)
+    cfg = ILQLConfig(
+        tau=0.7, gamma=0.99, cql_scale=0.1, awac_scale=1.0, alpha=0.005,
+        beta=0.5, steps_for_target_q_sync=5, two_qs=True, gen_kwargs={},
+    )
+    loss, stats = cfg.loss(
+        jnp.asarray(logits), qs, target_qs, jnp.asarray(vs),
+        jnp.asarray(actions), jnp.asarray(rewards), jnp.asarray(dones),
+    )
+    assert np.isclose(float(stats["losses/loss_q"]), ILQL_GOLD["q"], rtol=1e-4)
+    assert np.isclose(float(stats["losses/loss_v"]), ILQL_GOLD["v"], rtol=1e-4)
+    assert np.isclose(float(stats["losses/loss_cql"]), ILQL_GOLD["cql"], rtol=1e-4)
+    assert np.isclose(float(stats["losses/loss_awac"]), ILQL_GOLD["awac"], rtol=1e-4)
+    assert np.isclose(float(loss), ILQL_GOLD["total"], rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# whiten (utils/modeling.py:205-215): torch.var_mean is unbiased — full-mask
+# whitening must match it exactly, which pins our ddof=1 convention
+# ---------------------------------------------------------------------------
+WHITEN = [
+    [-0.6772621298, -1.2605116325, -0.0592447611, 0.6874257646, 1.4863386145, 0.3405101663],
+    [-0.3989559332, -0.6581143023, 1.05394762, 2.0431389027, 0.5225565501, -1.1588833904],
+    [-0.8517965624, 2.0043276683, 0.4445339253, -1.7157614573, 0.1245913058, -1.0806192218],
+    [-0.4845193598, -0.3267887597, -0.5783270073, 0.8358353165, 0.1476010047, -0.4400223211],
+]
+
+
+def test_whiten_matches_reference():
+    rng = np.random.default_rng(5)
+    xs = _arr(rng, 4, 6)
+    out = whiten(jnp.asarray(xs))
+    np.testing.assert_allclose(np.asarray(out), WHITEN, rtol=1e-4, atol=1e-5)
+    masked = whiten(jnp.asarray(xs), jnp.ones((4, 6), jnp.float32))
+    np.testing.assert_allclose(np.asarray(masked), WHITEN, rtol=1e-4, atol=1e-5)

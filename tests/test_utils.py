@@ -75,7 +75,9 @@ def test_whiten_masked():
     w = stats.whiten(x, mask)
     valid = np.asarray(w)[np.asarray(mask) > 0]
     assert abs(valid.mean()) < 1e-5
-    assert valid.std() == pytest.approx(1.0, rel=1e-2)
+    # whiten uses the unbiased variance (reference torch.var_mean semantics,
+    # pinned by tests/test_parity_golden.py) — compare with ddof=1
+    assert valid.std(ddof=1) == pytest.approx(1.0, rel=1e-2)
 
 
 def test_logprobs_of_labels():

@@ -19,6 +19,7 @@ TPU-first design decisions:
 """
 
 import dataclasses
+import functools
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -642,6 +643,23 @@ class MLP(nn.Module):
         return _dense(cfg, cfg.hidden_size, cfg.mlp_bias, ("ffn", "embed"), "down_proj")(h)
 
 
+@functools.lru_cache(maxsize=None)
+def _warn_indivisible_experts(num_experts: int, axis: int) -> None:
+    """Warn ONCE per (experts, axis) pair: the divisibility fit silently
+    drops the expert axis, so expert-parallel dispatch degrades to replicated
+    compute — a throughput cliff that deserves a diagnosis line (same
+    contract as ``pipeline.py::pick_microbatches``). lru_cache keeps it to
+    one line instead of one per layer per trace per recompile."""
+    from trlx_tpu.utils import logging
+
+    logging.get_logger(__name__).warning(
+        "num_experts %d not divisible by mesh expert axis %d: expert-parallel "
+        "dispatch runs replicated — resize the expert axis or the expert "
+        "count to recover EP",
+        num_experts, axis,
+    )
+
+
 def _maybe_expert_mesh():
     """The traced mesh, iff its ``expert`` axis actually partitions experts
     (size > 1)."""
@@ -742,18 +760,7 @@ class MoEMLP(nn.Module):
         mesh = _maybe_expert_mesh()
 
         if mesh is not None and E % mesh.shape.get("expert", 1):
-            # the divisibility fit silently drops the expert axis — the
-            # dispatch all_to_all degrades to replicated compute, a
-            # throughput cliff that deserves a diagnosis line (same contract
-            # as pipeline.py::pick_microbatches)
-            from trlx_tpu.utils import logging
-
-            logging.get_logger(__name__).warning(
-                "num_experts %d not divisible by mesh expert axis %d: "
-                "expert-parallel dispatch runs replicated — resize the "
-                "expert axis or the expert count to recover EP",
-                E, mesh.shape.get("expert", 1),
-            )
+            _warn_indivisible_experts(E, mesh.shape.get("expert", 1))
 
         def expert_sharded(a):
             from trlx_tpu.parallel.sharding import constrain_activation

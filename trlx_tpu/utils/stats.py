@@ -22,17 +22,29 @@ def masked_mean(xs: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
     return jnp.sum(xs * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
-def masked_var(xs: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+def masked_var(
+    xs: jax.Array, mask: Optional[jax.Array] = None, ddof: int = 0
+) -> jax.Array:
     mean = masked_mean(xs, mask)
-    return masked_mean(jnp.square(xs - mean), mask)
+    n = mask.sum() if mask is not None else float(np.prod(xs.shape))
+    sq = masked_mean(jnp.square(xs - mean), mask)
+    if ddof:
+        sq = sq * (n / jnp.maximum(n - ddof, 1.0))
+    return sq
 
 
 def whiten(
     xs: jax.Array, mask: Optional[jax.Array] = None, shift_mean: bool = True
 ) -> jax.Array:
-    """Normalize to zero mean / unit variance (masked, globally under pjit)."""
+    """Normalize to zero mean / unit variance (masked, globally under pjit).
+
+    Uses the unbiased (``ddof=1``) variance to match the reference exactly
+    (``trlx/utils/modeling.py:205-215`` whitens with ``torch.var_mean``,
+    whose default is Bessel-corrected) — pinned by
+    ``tests/test_parity_golden.py``.
+    """
     mean = masked_mean(xs, mask)
-    var = masked_var(xs, mask)
+    var = masked_var(xs, mask, ddof=1)
     whitened = (xs - mean) * jax.lax.rsqrt(var + 1e-8)
     if not shift_mean:
         whitened = whitened + mean
