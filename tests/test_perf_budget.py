@@ -127,6 +127,15 @@ def test_budget_gptj_6b_fsdp2_tp2_sp2():
     _assert_within_budget("gptj_6b_fsdp2_tp2_sp2")
 
 
+@pytest.mark.slow
+def test_budget_neox_20b_tp4_ilql():
+    """The megatron_20b-shaped ILQL programs (TP4 x fsdp2, seq 1024, int8
+    Adam, bf16 params — the v4-16 capacity recipe) compile and stay within
+    budget: the strongest hardware-free guard on the >20B-scale path the
+    reference serves with NeMo (``megatron_20b.yaml:53-57``)."""
+    _assert_within_budget("neox_20b_tp4_ilql")
+
+
 def test_capacity_plan_tiny():
     """plan(): exact sharded weight/optimizer arithmetic + program costs,
     no weights materialized."""

@@ -235,8 +235,23 @@ class ILQLTrainer(TPUBaseTrainer):
             action_source = batch["input_ids"]
         hidden = backbone_out["hidden_states"]
 
-        hs_actions = batched_index_select(hidden, batch["actions_ixs"])
-        hs_states = batched_index_select(hidden, batch["states_ixs"])
+        # pin the gathered activations to the batch layout: the
+        # take_along_axis output otherwise inherits a hidden-sharded spec
+        # from the backbone that GSPMD can only reconcile with the heads'
+        # batch-sharded expectation by an involuntary full rematerialization
+        # (replicate-then-repartition) of every gathered tensor per step
+        from trlx_tpu.parallel.mesh import get_global_mesh
+        from trlx_tpu.parallel.sharding import batch_spec, constrain_activation
+
+        mesh = get_global_mesh()
+        hs_actions = constrain_activation(
+            batched_index_select(hidden, batch["actions_ixs"]),
+            mesh, *batch_spec(3),
+        )
+        hs_states = constrain_activation(
+            batched_index_select(hidden, batch["states_ixs"]),
+            mesh, *batch_spec(3),
+        )
         qs, target_qs, vs = module.apply(
             {"params": params},
             hs_actions,
