@@ -48,6 +48,8 @@ def _maybe_pipeline_mesh(cfg: "TransformerConfig"):
     mesh = get_global_mesh()
     if mesh is None or mesh.shape.get("pipe", 1) <= 1:
         return None
+    if cfg.ignore_pipe_mesh:
+        return None
     if not cfg.scan_layers:
         raise ValueError(
             "pipeline parallelism (mesh pipe>1) requires scan_layers=True — "
@@ -175,6 +177,12 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16  # activation/compute dtype
     remat: str = "none"  # none | minimal | full
     scan_layers: bool = False
+    # run unpipelined even when the global mesh has pipe > 1: the model
+    # computes replicated across pipeline stages instead of through the
+    # GPipe schedule. For small auxiliary models that ride a big model's
+    # mesh — e.g. the speculative-decoding draft, which runs replicated
+    # while the pipelined target verifies its proposals.
+    ignore_pipe_mesh: bool = False
     # attention implementation: "auto" (pallas flash kernel on TPU, xla
     # elsewhere), "xla" (dot-product, XLA-fused), or "pallas" (force flash)
     attention_impl: str = "auto"
@@ -1092,13 +1100,6 @@ class CausalTransformer(nn.Module):
         vector_ci = cache_index is not None and jnp.asarray(cache_index).ndim > 0
         use_flash = cfg.resolved_attention_impl() == "pallas" and T > 1 and not vector_ci
         pipe_mesh = None if self.is_initializing() else _maybe_pipeline_mesh(cfg)
-        if pipe_mesh is not None and vector_ci:
-            raise NotImplementedError(
-                "per-row cache indices (speculative decoding) are not "
-                "supported through the pipeline engine — the microbatch "
-                "schedule would need per-microbatch index slicing; run the "
-                "draft/policy over data/fsdp/model axes instead"
-            )
         if pipe_mesh is not None:
             x, branch_input, new_cache, aux = self._pipelined_blocks(
                 pipe_mesh, x, attention_mask, positions, use_flash,
@@ -1179,9 +1180,11 @@ class CausalTransformer(nn.Module):
         branch_at = cfg.num_layers - branch_layer if branch_layer is not None else -1
         body_block = Block(cfg, parent=None)
         in_decode = cache is not None and cache_index is not None
-        q_offset = cache_index if in_decode else 0
 
-        def make_attn_inputs(mask_mb, pos_mb):
+        def make_attn_inputs(mask_mb, pos_mb, ci_mb):
+            # ci_mb: this stage's microbatch slice of a [B]-vector
+            # cache_index (speculative decoding), or the scalar/None given
+            q_offset = ci_mb if in_decode else 0
             tm = None
             if cfg.num_experts > 0:
                 tm = (

@@ -85,12 +85,15 @@ def pipeline_blocks(
     *,
     num_stages: int,
     num_microbatches: int,
-    make_attn_inputs: Callable[[jax.Array, jax.Array], Any],
-    # (layer_params, h, attn_inputs, cache_layer, cache_index)
+    # (mask_mb, pos_mb, cache_index_mb) -> attn inputs for one microbatch;
+    # cache_index_mb is the stage's [mb] slice when cache_index is a [B]
+    # vector (speculative decoding), else the scalar/None passed in
+    make_attn_inputs: Callable[..., Any],
+    # (layer_params, h, attn_inputs, cache_layer, cache_index_mb)
     #   -> (h, new_cache_layer, aux_stats)
     apply_block: Callable[..., Tuple[jax.Array, Any, jax.Array]],
     cache: Any = None,  # pytree, leaves [L, B, ...] (stacked KV cache) or None
-    cache_index: Any = None,
+    cache_index: Any = None,  # None | scalar | [B] vector (per-row depths)
     branch_at: int = -1,  # global layer idx whose INPUT feeds the hydra branch
     mesh: Optional[Mesh] = None,
     aux_init: Optional[jax.Array] = None,  # zero aux vector (defines its width)
@@ -137,6 +140,12 @@ def pipeline_blocks(
             lambda c: c.reshape((S, lps, M, mb) + c.shape[2:]), cache
         )
 
+    # a [B]-vector cache_index (per-row cache depths — speculative decoding)
+    # is split per microbatch like the data streams; each stage selects its
+    # resident microbatch's slice by m_idx, exactly as it selects the cache
+    vector_ci = cache_index is not None and jnp.ndim(cache_index) > 0
+    ci_split = split(jnp.asarray(cache_index)) if vector_ci else None  # [M, mb]
+
     def constrain(a, *spec):
         if not isinstance(a, jax.core.Tracer):
             return a
@@ -156,7 +165,10 @@ def pipeline_blocks(
 
     def stage_fn(stage_params, h, mask_mb, pos_mb, branch_buf, stage_cache, m_idx, stage_idx, valid):
         """One stage: apply its ``lps`` blocks to the resident microbatch."""
-        aux = make_attn_inputs(mask_mb, pos_mb)
+        ci = cache_index
+        if vector_ci:
+            ci = jax.lax.dynamic_index_in_dim(ci_split, m_idx, axis=0, keepdims=False)
+        aux = make_attn_inputs(mask_mb, pos_mb, ci)
         cache_m = None
         if stage_cache is not None:
             # this stage currently serves microbatch m_idx: select its cache
@@ -173,7 +185,7 @@ def pipeline_blocks(
                     stage_idx * lps + local_idx == branch_at, h, branch_buf
                 )
             h, new_cache_layer, block_aux = apply_block(
-                layer_params, h, aux, cache_layer, cache_index
+                layer_params, h, aux, cache_layer, ci
             )
             return (h, branch_buf, aux_sum + block_aux), new_cache_layer
 

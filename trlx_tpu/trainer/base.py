@@ -181,19 +181,20 @@ class TPUBaseTrainer(BaseRLTrainer):
                 "model.draft_model_path is ignored for seq2seq models: "
                 "speculative decoding is implemented for causal LMs only"
             )
-        if config.model.draft_model_path and self.mesh.shape.get("pipe", 1) > 1:
-            logger.warning(
-                "model.draft_model_path is ignored with pipeline parallelism "
-                "(pipe > 1): per-row cache rewinds don't fit the microbatch "
-                "schedule — rollouts use the plain sampler"
-            )
-        elif config.model.draft_model_path and not self.is_seq2seq:
+        elif config.model.draft_model_path:
             from trlx_tpu.data.configs import ModelConfig as _MC
 
+            # the draft always runs UNPIPELINED: under a pipe>1 mesh it
+            # computes replicated across stages while the pipelined target
+            # verifies its proposals (per-row cache depths flow through the
+            # microbatch schedule via parallel/pipeline.py's cache_index
+            # slicing)
+            draft_extra = dict(config.model.draft_model_extra_kwargs)
+            draft_extra["ignore_pipe_mesh"] = True
             self.draft_module, draft_params, self.draft_tcfg = build_causal_lm(
                 _MC(
                     model_path=config.model.draft_model_path,
-                    model_extra_kwargs=dict(config.model.draft_model_extra_kwargs),
+                    model_extra_kwargs=draft_extra,
                 ),
                 config.parallel,
                 head=None,
