@@ -209,13 +209,22 @@ def _tiny(tmp_path, **kw):
     return d
 
 
-def test_summarize_rlhf_three_stages(tmp_path, monkeypatch):
-    """The full pipeline end-to-end at toy scale: SFT → reward model →
-    PPO using the stage-2 checkpoint as the reward."""
-    monkeypatch.delenv("MODEL_PATH", raising=False)
-    import train_sft, train_reward_model, ppo_summarize
+# shared ppo_hh/ppo_summarize toy overrides — one place to tune the recipe
+_PPO_TOY = {
+    "model.model_path": "builtin:gpt2-test",
+    "model.num_layers_unfrozen": 1,
+    "method.num_rollouts": 4,
+    "method.chunk_size": 4,
+    "method.ppo_epochs": 1,
+    "method.gen_kwargs.max_new_tokens": 5,
+}
 
-    assert train_sft.main(_tiny(tmp_path, **{"model.model_path": "builtin:gpt2-test"})) is not None
+
+def _train_toy_rm(tmp_path):
+    """Stage-2 toy reward model; asserts the pairs actually diverged
+    (loss 0.0 would mean truncation collapsed them) and the checkpoint
+    landed. Returns its directory."""
+    import train_reward_model
 
     rm_dir = str(tmp_path / "rm")
     stats = train_reward_model.main(
@@ -223,23 +232,23 @@ def test_summarize_rlhf_three_stages(tmp_path, monkeypatch):
              max_length=128, batch_size=4, total_steps=8, n_pairs=16,
              checkpoint_dir=rm_dir)
     )
-    # pairs must actually diverge (0.0 would mean truncation collapsed them)
     assert np.isfinite(stats["reward/loss"]) and stats["reward/loss"] > 0.0
     assert os.path.exists(os.path.join(rm_dir, "reward_model.pkl"))
+    return rm_dir
+
+
+def test_summarize_rlhf_three_stages(tmp_path, monkeypatch):
+    """The full pipeline end-to-end at toy scale: SFT → reward model →
+    PPO using the stage-2 checkpoint as the reward."""
+    monkeypatch.delenv("MODEL_PATH", raising=False)
+    import train_sft, ppo_summarize
+
+    assert train_sft.main(_tiny(tmp_path, **{"model.model_path": "builtin:gpt2-test"})) is not None
+
+    rm_dir = _train_toy_rm(tmp_path)
 
     trainer = ppo_summarize.main(
-        _tiny(
-            tmp_path,
-            reward_checkpoint_dir=rm_dir,
-            **{
-                "model.model_path": "builtin:gpt2-test",
-                "model.num_layers_unfrozen": 1,
-                "method.num_rollouts": 4,
-                "method.chunk_size": 4,
-                "method.ppo_epochs": 1,
-                "method.gen_kwargs.max_new_tokens": 5,
-            },
-        )
+        _tiny(tmp_path, reward_checkpoint_dir=rm_dir, **_PPO_TOY)
     )
     assert trainer is not None
 
@@ -277,6 +286,60 @@ def test_hh_ppo_with_reward_server(tmp_path, monkeypatch):
             )
         )
         assert trainer is not None
+    finally:
+        server.shutdown()
+
+
+@pytest.mark.slow
+def test_hh_ppo_with_trained_rm_server(tmp_path, monkeypatch):
+    """The FULL Triton-equivalent chain on a trained reward model (round-4
+    verdict #7): stage-2 trains a toy RM, ``serve_reward.build_scorer``
+    loads its checkpoint, a live HTTP server serves it from its own
+    (thread-decoupled) scorer, and ``ppo_hh`` trains against ``REWARD_HOST``
+    — mirroring the reference's 6B RM behind Triton-gRPC
+    (``/root/reference/examples/hh/ppo_hh.py:118-138``). The previous test
+    only exercised the lexical fallback scorer."""
+    import threading
+    from http.server import HTTPServer
+
+    import serve_reward, ppo_hh
+    from hh_util import reward_client
+    from ppo_summarize import load_reward_fn
+
+    rm_dir = _train_toy_rm(tmp_path)
+
+    rm_scorer = serve_reward.build_scorer(rm_dir)
+    served = []  # sample counts per request — proves training hit THIS scorer
+
+    def counting_scorer(samples):
+        served.append(len(samples))
+        return rm_scorer(samples)
+
+    server = HTTPServer(("127.0.0.1", 0), serve_reward.make_handler(counting_scorer))
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        monkeypatch.setenv("REWARD_HOST", f"127.0.0.1:{port}")
+        probe = ["Here is a step by step approach", "I don't know"]
+        via_http = reward_client(probe)
+        direct = [float(x) for x in load_reward_fn(rm_dir)(probe)]
+        # the server must serve the TRAINED model, not the lexical fallback
+        np.testing.assert_allclose(via_http, direct, rtol=1e-5, atol=1e-6)
+        from hh_util import lexical_helpfulness
+
+        assert via_http != [float(s) for s in lexical_helpfulness(probe)]
+        probe_requests = len(served)
+
+        monkeypatch.setenv("CONFIG_NAME", "125M")
+        trainer = ppo_hh.main(
+            _tiny(tmp_path, **{"parallel.data": -1}, **_PPO_TOY)
+        )
+        assert trainer is not None and trainer.iter_count >= 1
+        # reward_client falls back to the lexical scorer on ANY request
+        # error — a green run must prove training actually scored through
+        # the served RM, not the fallback
+        assert len(served) > probe_requests, served
+        assert sum(served[probe_requests:]) >= 4, served
     finally:
         server.shutdown()
 
