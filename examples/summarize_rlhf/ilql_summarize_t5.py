@@ -1,0 +1,113 @@
+"""Offline ILQL summarization with a seq2seq (T5) model (capability parity:
+``/root/reference/examples/summarize_rlhf/ilql_summarize_t5.py``).
+
+The reference trains flan-t5 on the TL;DR comparison pairs offline — chosen
+summaries labeled +1, rejected -1 — and evaluates with its stage-2 GPT-J
+reward model on CUDA device 1. Here the same recipe runs TPU-native: the
+seq2seq ILQL path (``trlx_tpu/models/seq2seq.py`` + ``make_experience_seq2seq``)
+consumes [prompt, completion] pairs, and the optional metric reward model is
+the stage-2 checkpoint served in-process (``ppo_summarize.load_reward_fn``)
+— set ``REWARD_CHECKPOINT_DIR`` to its directory, else eval falls back to
+ROUGE against the templated references.
+
+The reference's ``beta=[1, 2, 3]`` eval sweep carries over: evaluation
+decodes once per beta via the trainer's gen-kwarg sweep.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ilql_config
+
+from ppo_summarize import load_reward_fn
+from summarize_util import load_comparisons, load_tldr, rouge_scores
+
+
+def resolve_model():
+    """Hub flan-t5 SFT checkpoint when reachable, else the builtin T5 (the
+    shared ``summarize_util.resolve_model`` falls back to a causal gpt2,
+    which can't serve the seq2seq path)."""
+    path = os.environ.get("MODEL_PATH")
+    if path:
+        return path, path
+    try:
+        from transformers import AutoConfig
+
+        AutoConfig.from_pretrained("pvduy/flant5-xl_openai_tldr_sft")
+        return "pvduy/flant5-xl_openai_tldr_sft", "pvduy/flant5-xl_openai_tldr_sft"
+    except Exception:
+        return "builtin:t5-small", "builtin:bytes"
+
+
+def main(hparams=None):
+    model_path, tokenizer_path = resolve_model()
+
+    config = default_ilql_config().evolve(
+        train=dict(
+            seq_length=550,
+            batch_size=8,
+            total_steps=5000,
+            epochs=100,
+            eval_interval=1000,
+            checkpoint_interval=10000,
+            checkpoint_dir="ckpts/ilql_summarize_t5",
+        ),
+        model=dict(model_path=model_path, model_arch_type="seq2seq", num_layers_unfrozen=-1),
+        tokenizer=dict(tokenizer_path=tokenizer_path, truncation_side="left"),
+        optimizer=dict(name="adamw", kwargs=dict(lr=1e-6, betas=(0.9, 0.95), eps=1e-8, weight_decay=1e-6)),
+        scheduler=dict(name="cosine_annealing", kwargs=dict(T_max=5000, eta_min=1e-6, lr=1e-6)),
+        method=dict(
+            tau=0.6,
+            gamma=0.99,
+            cql_scale=0.1,
+            awac_scale=1,
+            alpha=0.0001,
+            beta=0,
+            steps_for_target_q_sync=1,
+            two_qs=True,
+            gen_kwargs=dict(max_new_tokens=50, top_k=50, beta=[1, 2, 3], temperature=1.0),
+        ),
+    )
+    if hparams:
+        from trlx_tpu.data.configs import TRLConfig
+
+        config = TRLConfig.update(config, hparams)
+
+    n_pairs = int(os.environ.get("N_PAIRS", "256"))
+    pairs = load_comparisons(n=n_pairs)
+    # [prompt, chosen] → +1 and [prompt, rejected] → -1, reference preprocess
+    samples = []
+    rewards = []
+    for p in pairs:
+        samples.append([p["prompt"], p["chosen"]])
+        rewards.append(1.0)
+        samples.append([p["prompt"], p["rejected"]])
+        rewards.append(-1.0)
+
+    tldr = load_tldr(n=64)
+    eval_prompts = [d["prompt"] for d in tldr]
+    refs = {d["prompt"]: d["label"] for d in tldr}
+
+    reward_fn = load_reward_fn(os.environ.get("REWARD_CHECKPOINT_DIR", "ckpts/reward_model"))
+
+    def metric_fn(samples, prompts, outputs, **kwargs):
+        if reward_fn is not None:
+            return {"rewards": [float(x) for x in reward_fn(samples)]}
+        return rouge_scores(outputs, [refs.get(p, "") for p in prompts])
+
+    return trlx.train(
+        samples=samples,
+        rewards=rewards,
+        eval_prompts=eval_prompts,
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else None)
