@@ -469,3 +469,29 @@ def test_sparkline_and_wandb_fallback(tmp_path, monkeypatch):
     # wandb absent or disabled -> clean no-op, never an exception
     monkeypatch.setenv("WANDB_MODE", "disabled")
     assert publish_wandb_report([], {}, "m", str(tmp_path)) is False
+
+
+def test_trial_command_launcher_template_robustness():
+    """Launcher templates substitute ONLY the known {tokens}; every other
+    brace construct — ${HOME}, ${arr[0]}, ${VAR:-default}, awk {print},
+    lone braces — passes through verbatim, and extra_env keys ride {env}
+    (advisor round-4 findings)."""
+    from trlx_tpu.sweep import _trial_command
+
+    env = {
+        "TRLX_TPU_SWEEP_RESULT": "/tmp/r.json",
+        "WANDB_API_KEY": "secret",
+        "XLA_FLAGS": "--foo",
+        "UNRELATED": "no",
+    }
+    cmd = _trial_command(
+        'ssh {host} \'echo ${HOME} ${arr[0]} ${VAR:-/tmp} { | awk {print}\' '
+        "env {env} {python} {script} {hparams}",
+        __file__, {"a": 1}, "h1", env, extra_keys=("WANDB_API_KEY", "XLA_FLAGS"),
+    )
+    for construct in ("${HOME}", "${arr[0]}", "${VAR:-/tmp}", "{ |", "{print}"):
+        assert construct in cmd, (construct, cmd)
+    assert "ssh h1" in cmd
+    assert "WANDB_API_KEY=secret" in cmd and "XLA_FLAGS=--foo" in cmd
+    assert "UNRELATED" not in cmd  # non-contract env never leaks
+    assert "TRLX_TPU_SWEEP_RESULT=/tmp/r.json" in cmd

@@ -547,10 +547,19 @@ def main(argv=None) -> int:
         raw = yaml.safe_load(f) or {}
     par = raw.get("parallel") or {}
     needed = 1
+    has_auto_axis = False
     for axis in ("data", "pipe", "fsdp", "model", "sequence", "expert"):
         v = int(par.get(axis, 1))
         if v > 1:
             needed *= v
+        elif v == -1:
+            has_auto_axis = True
+    # a -1 axis absorbs whatever devices exist, so the plan depends on the
+    # virtual pool size; default it to 8 — the mesh the committed budgets
+    # (benchmarks/perf_budgets.json) and the test conftest use — so CLI
+    # output is comparable to them on any machine
+    if has_auto_axis:
+        needed = max(needed, 8)
     if needed > 1 and "xla_force_host_platform_device_count" not in os.environ.get(
         "XLA_FLAGS", ""
     ):
@@ -581,6 +590,12 @@ def main(argv=None) -> int:
         f"(+ program temps, see programs.*.temp_bytes)",
         flush=True,
     )
+    if has_auto_axis:
+        print(
+            f"# per-device numbers are for THIS {result['n_devices']}-device "
+            "mesh; -1 axes resize with the pool (committed budgets use 8)",
+            flush=True,
+        )
     return 0
 
 

@@ -72,6 +72,7 @@ import importlib.util
 import itertools
 import json
 import os
+import re
 import subprocess
 import sys
 import threading
@@ -325,12 +326,21 @@ def _next_coordinator_port() -> int:
         return next(_PORT_COUNTER)
 
 
+# the launcher template's placeholder names — substituted by literal token
+# match (NOT str.format, whose index/attr/format-spec parsing corrupts shell
+# constructs like ${arr[0]}, ${VAR:-default} or awk {print})
+_LAUNCHER_TOKENS = re.compile(
+    r"\{(python|script|hparams|hparams_remote|host|env|env_remote)\}"
+)
+
+
 def _trial_command(
     launcher: Optional[str],
     script: str,
     hparams: Dict[str, Any],
     host: Optional[str],
     env: Dict[str, str],
+    extra_keys: Tuple[str, ...] = (),
 ):
     """Build one trial process's command: an argv list (no launcher) or a
     shell line (launcher template — run with ``shell=True`` so it behaves
@@ -349,6 +359,14 @@ def _trial_command(
 
     (``-tt`` so terminating the local ssh client also hangs up the remote
     trial — plain ssh would leave it running, holding the host's chip.)
+
+    ONLY the exact tokens above are substituted (literal regex match, not
+    ``str.format``); everything else — shell ``${HOME}``, ``${arr[0]}``,
+    ``${VAR:-default}``, awk ``{print}``, lone braces — passes through
+    verbatim with no escaping needed. ``{env}`` also carries every key the
+    caller passed via ``extra_env`` (``extra_keys``) — a user-supplied
+    ``WANDB_API_KEY`` or ``XLA_FLAGS`` must reach remote trials exactly
+    like local no-launcher ones.
     """
     if launcher is None:
         return [sys.executable, os.path.abspath(script), json.dumps(hparams)]
@@ -358,19 +376,22 @@ def _trial_command(
         return " ".join(
             f"{k}={quote(v)}"
             for k, v in sorted(env.items())
-            if k.startswith("TRLX_TPU_") or k in ("JAX_PLATFORMS", "PYTHONPATH")
+            if k.startswith("TRLX_TPU_")
+            or k in ("JAX_PLATFORMS", "PYTHONPATH")
+            or k in extra_keys
         )
 
     payload = json.dumps(hparams)
-    return launcher.format(
-        python=shlex.quote(sys.executable),
-        script=shlex.quote(os.path.abspath(script)),
-        hparams=shlex.quote(payload),
-        hparams_remote=shlex.quote(shlex.quote(payload)),
-        host=host or "localhost",
-        env=env_pairs(shlex.quote),
-        env_remote=env_pairs(lambda v: shlex.quote(shlex.quote(v))),
-    )
+    values = {
+        "python": shlex.quote(sys.executable),
+        "script": shlex.quote(os.path.abspath(script)),
+        "hparams": shlex.quote(payload),
+        "hparams_remote": shlex.quote(shlex.quote(payload)),
+        "host": host or "localhost",
+        "env": env_pairs(shlex.quote),
+        "env_remote": env_pairs(lambda v: shlex.quote(shlex.quote(v))),
+    }
+    return _LAUNCHER_TOKENS.sub(lambda m: values[m.group(1)], launcher)
 
 
 def _wait_sigterm_only(procs: List[subprocess.Popen], timeout: Optional[float], log) -> int:
@@ -476,7 +497,8 @@ def run_trial(
                     TRLX_TPU_PROCESS_ID=str(pid_i),
                 )
             cmd = _trial_command(
-                launcher, script, hparams, group[pid_i % len(group)], penv
+                launcher, script, hparams, group[pid_i % len(group)], penv,
+                extra_keys=tuple(extra_env or ()),
             )
             procs.append(
                 subprocess.Popen(
