@@ -58,3 +58,23 @@ def test_init_devices_stops_probing_on_orphan_pileup(bench, monkeypatch):
     assert err is not None
     # capped: stops probing soon after the orphan limit, not at the deadline
     assert bench._ORPHANED_PROBES <= 4
+
+
+def test_xl_stage_skips_on_cpu(bench, capsys):
+    bench._maybe_xl_stage(True, float("nan"), None)
+    assert "xl_stage" not in capsys.readouterr().err
+
+
+def test_xl_stage_respects_deadline(bench, monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_XL_DEADLINE_S", "1")
+    monkeypatch.setattr(bench, "_T0", bench.time.time() - 100)  # budget gone
+    bench._maybe_xl_stage(False, 275e12, None)
+    err = capsys.readouterr().err
+    assert "skipping gpt2-xl stage" in err and "xl_stage" not in err
+
+
+def test_xl_stage_env_kill_switch(bench, monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_XL", "0")
+    monkeypatch.setattr(bench, "_T0", bench.time.time())
+    bench._maybe_xl_stage(False, 275e12, None)
+    assert capsys.readouterr().err == ""

@@ -555,11 +555,12 @@ def main(argv=None) -> int:
         elif v == -1:
             has_auto_axis = True
     # a -1 axis absorbs whatever devices exist, so the plan depends on the
-    # virtual pool size; default it to 8 — the mesh the committed budgets
-    # (benchmarks/perf_budgets.json) and the test conftest use — so CLI
-    # output is comparable to them on any machine
-    if has_auto_axis:
-        needed = max(needed, 8)
+    # virtual pool size; default it to (at least) 8 — the mesh the committed
+    # budgets (benchmarks/perf_budgets.json) and the test conftest use — so
+    # CLI output is comparable to them on any machine. The pool must stay a
+    # multiple of the fixed-axes product or mesh construction rejects it.
+    if has_auto_axis and needed < 8:
+        needed = needed * -(-8 // needed)
     if needed > 1 and "xla_force_host_platform_device_count" not in os.environ.get(
         "XLA_FLAGS", ""
     ):
