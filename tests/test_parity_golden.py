@@ -17,6 +17,8 @@ the fixtures guard against (e.g. biased vs unbiased whitening variance is a
 ~3.5% effect at these sizes).
 """
 
+import dataclasses
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -201,3 +203,91 @@ def test_whiten_matches_reference():
     np.testing.assert_allclose(np.asarray(out), WHITEN, rtol=1e-4, atol=1e-5)
     masked = whiten(jnp.asarray(xs), jnp.ones((4, 6), jnp.float32))
     np.testing.assert_allclose(np.asarray(masked), WHITEN, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Beyond-parity algorithms, pinned to their PAPERS' formulas (the reference
+# has neither): DPO — Rafailov et al. 2023 Eq. 7 with the original repo's
+# conservative label smoothing; GRPO — DeepSeekMath Eq. 3-4 (group-relative
+# advantages ± std scaling, clipped ratio, k3 KL), plus Dr. GRPO and RLOO
+# baseline variants. Golden values evaluated in float64 numpy.
+# ---------------------------------------------------------------------------
+DPO_GOLD = dict(
+    loss=0.939205577491, margin=0.034390099432, acc=0.5,
+    loss_reffree=0.656710212192,
+)
+
+
+def test_dpo_loss_matches_paper():
+    from trlx_tpu.models.dpo import DPOConfig
+
+    rng = np.random.default_rng(21)
+    B = 6
+    pc = (rng.normal(size=B) * 5 - 40).astype(np.float32)
+    pr = (rng.normal(size=B) * 5 - 42).astype(np.float32)
+    rc = (rng.normal(size=B) * 5 - 41).astype(np.float32)
+    rr = (rng.normal(size=B) * 5 - 41.5).astype(np.float32)
+    cfg = DPOConfig(beta=0.1, label_smoothing=0.1)
+    loss, stats = cfg.loss(*(jnp.asarray(a) for a in (pc, pr, rc, rr)))
+    assert np.isclose(float(loss), DPO_GOLD["loss"], rtol=1e-5)
+    assert np.isclose(float(stats["rewards/margin"]), DPO_GOLD["margin"], rtol=1e-3)
+    assert np.isclose(float(stats["rewards/accuracy"]), DPO_GOLD["acc"])
+    cfg_rf = DPOConfig(beta=0.1, label_smoothing=0.1, reference_free=True)
+    loss_rf, _ = cfg_rf.loss(*(jnp.asarray(a) for a in (pc, pr, rc, rr)))
+    assert np.isclose(float(loss_rf), DPO_GOLD["loss_reffree"], rtol=1e-5)
+
+
+GRPO_ADV = [-0.8815328644, -0.2398409137, -0.565509461, 1.686883239,
+            0.7570561169, -1.7135182395, 0.3491741933, 0.6072879294]
+GRPO_ADV_DR = [-0.5319457055, -0.1447278362, -0.3412468682, 1.0179204099,
+               1.8683564289, -4.2288315851, 0.8617351267, 1.4987400296]
+GRPO_ADV_RLOO = [-0.7092609406, -0.1929704483, -0.4549958242, 1.3572272131,
+                 2.4911419052, -5.6384421135, 1.1489801689, 1.9983200394]
+
+
+def test_grpo_advantages_match_paper():
+    from trlx_tpu.models.grpo import group_advantages_np
+
+    rng = np.random.default_rng(22)
+    scores = (rng.normal(size=(2, 4)) * 2).reshape(-1).astype(np.float64)
+    np.testing.assert_allclose(
+        group_advantages_np(scores, 4, scale=True), GRPO_ADV, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        group_advantages_np(scores, 4, scale=False), GRPO_ADV_DR, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        group_advantages_np(scores, 4, baseline="rloo"), GRPO_ADV_RLOO, rtol=1e-5
+    )
+
+
+GRPO_GOLD = dict(
+    pg=0.092098702911, kl=0.098418415679, total=0.096035439538,
+    clipfrac=0.230769230769,
+)
+
+
+def test_grpo_loss_matches_paper():
+    from trlx_tpu.models.grpo import GRPOConfig
+    from trlx_tpu.data.default_configs import default_grpo_config
+
+    rng = np.random.default_rng(23)
+    lp = _arr(rng, 4, 5, scale=0.3)
+    old = _arr(rng, 4, 5, scale=0.3)
+    ref = _arr(rng, 4, 5, scale=0.3)
+    adv = rng.normal(size=4).astype(np.float32)
+    mask = np.array(
+        [[1, 1, 1, 0, 0], [1, 1, 1, 1, 1], [1, 0, 0, 0, 0], [1, 1, 1, 1, 0]],
+        np.float32,
+    )
+    base = default_grpo_config().method
+    # pin the golden hyperparameters explicitly — retuning the library
+    # defaults must not break a paper-parity fixture
+    cfg = dataclasses.replace(base, cliprange=0.2, beta=0.04)
+    loss, stats = cfg.loss(
+        *(jnp.asarray(a) for a in (lp, old, ref, adv, mask))
+    )
+    assert np.isclose(float(stats["losses/policy_loss"]), GRPO_GOLD["pg"], rtol=1e-4)
+    assert np.isclose(float(stats["losses/kl_loss"]), GRPO_GOLD["kl"], rtol=1e-4)
+    assert np.isclose(float(loss), GRPO_GOLD["total"], rtol=1e-4)
+    assert np.isclose(float(stats["policy/clipfrac"]), GRPO_GOLD["clipfrac"], rtol=1e-6)
