@@ -259,9 +259,23 @@ def _program_cycle_flops(config, trainer, chunk):
     compiled generate/score/train_step programs (attention, collectives,
     everything — shared by the headline and xl MFU so they are comparable).
     None when unavailable or nonsensical (the cost model's missing-key
-    sentinel is negative)."""
+    sentinel is negative).
+
+    The per-device × n_dev accounting is only valid when the batch fully
+    shards over the data axes — a replicated batch makes every device
+    recompute the same work and the multiply would inflate MFU by up to
+    n_dev×. Refuse (None) rather than report a flattering wrong number.
+    """
     import jax
 
+    dp = trainer.mesh.shape.get("data", 1) * trainer.mesh.shape.get("fsdp", 1)
+    if chunk % dp:
+        print(
+            f"bench: program-flops MFU skipped (chunk {chunk} does not shard "
+            f"over data axes {dp}; per-device accounting would overcount)",
+            file=sys.stderr,
+        )
+        return None
     try:
         from trlx_tpu.perf import hot_program_costs
 

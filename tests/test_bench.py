@@ -78,3 +78,32 @@ def test_xl_stage_env_kill_switch(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_T0", bench.time.time())
     bench._maybe_xl_stage(False, 275e12, None)
     assert capsys.readouterr().err == ""
+
+
+@pytest.mark.slow
+def test_program_cycle_flops_glue(bench):
+    """The on-chip MFU accounting path (hot_program_costs over the live
+    trainer) must produce a positive FLOPs total — exercised here on CPU so
+    the first real chip window cannot be the first time this code runs."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from trlx_tpu.trainer import get_trainer
+    import trlx_tpu.trainer.ppo  # noqa: F401
+
+    chunk = 8  # must shard over the conftest mesh's data axes (8)
+    config = bench._bench_ppo_config(
+        "builtin:gpt2-test", chunk, "/tmp/bench_glue_ckpt"
+    )
+    trainer = get_trainer(config.train.trainer)(
+        config=config,
+        reward_fn=lambda **kw: [0.0] * chunk,
+        metric_fn=None,
+        stop_sequences=[],
+        abstract_init=True,
+    )
+    flops = bench._program_cycle_flops(config, trainer, chunk)
+    assert flops is not None and flops > 0, flops
+    # a non-sharding chunk must REFUSE (per-device accounting would
+    # overcount by up to n_dev x), not emit an inflated number
+    assert bench._program_cycle_flops(config, trainer, chunk - 1) is None

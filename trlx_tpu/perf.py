@@ -195,9 +195,12 @@ def hot_program_costs(
             return SDS(shape, dtype)
         from jax.sharding import NamedSharding
 
-        return SDS(
-            shape, dtype, sharding=NamedSharding(mesh, batch_spec(len(shape)))
-        )
+        from trlx_tpu.parallel.sharding import fit_spec
+
+        # analysis shapes need not divide the mesh (e.g. a small bench chunk
+        # on a wide data axis): keep whatever prefix of the batch spec fits
+        spec = fit_spec(mesh, shape, tuple(batch_spec(len(shape))))
+        return SDS(shape, dtype, sharding=NamedSharding(mesh, spec))
 
     params = with_param_shardings(trainer.state.params)
     results: Dict[str, Dict[str, float]] = {}
