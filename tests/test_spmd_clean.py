@@ -53,6 +53,17 @@ def test_dryrun_multichip_no_involuntary_remat():
 
 
 @pytest.mark.slow
+def test_dryrun_multichip_16_no_involuntary_remat():
+    """The n=16 meshes compose FOUR >1 axes (data x pipe x fsdp x model) —
+    the regime whose transposed device orders produced the round-4/5
+    pipeline feed/drain remats; n=8's three-axis meshes cannot reproduce
+    them."""
+    _assert_no_remat_warnings(
+        "import __graft_entry__ as g; g.dryrun_multichip(16)"
+    )
+
+
+@pytest.mark.slow
 def test_ilql_20b_sharded_train_no_involuntary_remat():
     """The megatron_20b-shaped ILQL train step (TP4 x fsdp2) compiles clean:
     pins the ``batched_index_select`` constraint in ``trainer/ilql.py`` —
