@@ -346,6 +346,9 @@ def _maybe_xl_stage(on_cpu, peak, reward_fn):
                     "xl_stage": {
                         "model": "gpt2-xl (1.5B, scan_layers+remat)",
                         "samples_per_sec": round(chunk / dt, 3),
+                        "tokens_per_sec": round(
+                            chunk * (_PROMPT_TOKENS + _MAX_NEW) / dt, 1
+                        ),
                         "mfu": round(xl_mfu, 4) if xl_mfu is not None else None,
                         "cycle_s": round(dt, 2),
                         "chunk": chunk,
@@ -494,7 +497,9 @@ def main():
     if not on_cpu:
         kind = getattr(devices[0], "device_kind", "").lower()
         gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-        peaks = {"v4": 275e12, "v5e": 197e12, "v5 lite": 197e12, "v5p": 459e12, "v6e": 918e12}
+        # single source of truth shared with the runtime MFU metric
+        from trlx_tpu.observability.metrics import TPU_PEAK_FLOPS as peaks
+
         for key, val in peaks.items():
             if key in kind or key == gen:
                 peak = val  # bf16 peak per chip
@@ -531,6 +536,11 @@ def main():
         "value": round(samples_per_sec, 3),
         "unit": "samples/sec",
         "vs_baseline": round(samples_per_sec / A100_BASELINE_SAMPLES_PER_SEC, 3),
+        # observability-layer throughput fields (docs/OBSERVABILITY.md):
+        # whole-sequence tokens per wall-second, and measured MFU from the
+        # executed programs' XLA cost_analysis (null when no cost model)
+        "tokens_per_sec": round(samples_per_sec * seq, 1),
+        "mfu": round(mfu_real, 4) if np.isfinite(mfu_real) else None,
     }
     if note:
         line["note"] = note

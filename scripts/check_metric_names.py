@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Static lint: every ``stats["..."]`` key in ``trlx_tpu/`` follows the
+``namespace/name`` metric convention (docs/OBSERVABILITY.md).
+
+A grep-shaped check, deliberately dumb: it scans source text for string
+subscripts on variables named ``stats`` (``stats["time/step"]``,
+``stats[f"reward/mean{suffix}"]``) and asserts each literal key contains a
+``/`` separating a lowercase namespace from a name. Keys that predate the
+convention live in ``LEGACY_KEYS`` — shrink that set, never grow it.
+
+Exit code 0 when clean; 1 with a per-site listing otherwise. Wired into the
+fast test tier as ``tests/test_metric_names.py``.
+"""
+
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIR = os.path.join(REPO_ROOT, "trlx_tpu")
+
+# \bstats\[ : the dict must be *named* stats (not spec_stats, device_stats…)
+_KEY_RE = re.compile(r'\bstats\[\s*f?"([^"]+)"')
+
+# namespace/name: lowercase_snake namespace, then anything non-empty (names
+# may carry f-string fields, sweep suffixes, dots, @-qualifiers)
+_CONVENTION_RE = re.compile(r"^[a-z][a-z0-9_]*/\S+$")
+
+# Pre-convention keys, kept for dashboard/log continuity. Do not add to this
+# list — new metrics must be namespaced.
+LEGACY_KEYS = frozenset({
+    "learning_rate",
+    "kl_ctl_value",
+})
+
+
+def find_violations(scan_dir: str = SCAN_DIR) -> List[Tuple[str, int, str]]:
+    """All (relpath, lineno, key) whose key breaks the convention."""
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(scan_dir):
+        for filename in sorted(filenames):
+            if not filename.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, filename)
+            with open(path) as f:
+                for lineno, line in enumerate(f, start=1):
+                    for key in _KEY_RE.findall(line):
+                        if key in LEGACY_KEYS or _CONVENTION_RE.match(key):
+                            continue
+                        violations.append(
+                            (os.path.relpath(path, REPO_ROOT), lineno, key)
+                        )
+    return violations
+
+
+def scanned_keys(scan_dir: str = SCAN_DIR) -> Dict[str, int]:
+    """key → occurrence count over the tree (for the test's sanity check
+    that the scanner actually sees the codebase's stats writes)."""
+    counts: Dict[str, int] = {}
+    for dirpath, _dirnames, filenames in os.walk(scan_dir):
+        for filename in filenames:
+            if not filename.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, filename)) as f:
+                for line in f:
+                    for key in _KEY_RE.findall(line):
+                        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def main(argv=None) -> int:
+    violations = find_violations()
+    if not violations:
+        n = sum(scanned_keys().values())
+        print(f"check_metric_names: OK ({n} stats[...] sites, all namespaced)")
+        return 0
+    print("check_metric_names: metric keys violating the namespace/name convention:")
+    for relpath, lineno, key in violations:
+        print(f"  {relpath}:{lineno}: stats[\"{key}\"]")
+    print(
+        f"\n{len(violations)} violation(s). New metrics must be namespaced "
+        "(docs/OBSERVABILITY.md); LEGACY_KEYS is frozen."
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

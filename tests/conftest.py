@@ -25,6 +25,34 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def trlx_log_records():
+    """Captured LogRecords from the ``trlx_tpu`` logger tree.
+
+    The repo's logging setup (``trlx_tpu/utils/logging.py``) attaches its own
+    handler and sets ``propagate=False`` on the package root, so pytest's
+    ``caplog`` never sees these records — this fixture taps the package root
+    directly."""
+    import logging as _logging
+
+    records = []
+
+    class _Capture(_logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = _Capture(level=_logging.DEBUG)
+    logger = _logging.getLogger("trlx_tpu")
+    logger.addHandler(handler)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(handler)
+
+
 def pytest_collection_modifyitems(config, items):
     """Fast tier: tests measured >= 8s (tests/slow_tests.txt) are auto-marked
     ``slow``, so ``pytest -m "not slow"`` is a <5-min inner loop while plain

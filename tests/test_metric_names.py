@@ -1,0 +1,46 @@
+"""Fast-tier wiring for ``scripts/check_metric_names.py``: every
+``stats["..."]`` key in ``trlx_tpu/`` follows the ``namespace/name``
+convention (legacy allowlist frozen)."""
+
+import importlib.util
+import os
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_names",
+        os.path.join(
+            os.path.dirname(__file__), "..", "scripts", "check_metric_names.py"
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_all_metric_keys_are_namespaced():
+    checker = _load_checker()
+    violations = checker.find_violations()
+    assert violations == [], (
+        "stats[...] keys violating the namespace/name convention "
+        f"(docs/OBSERVABILITY.md): {violations}"
+    )
+
+
+def test_scanner_sees_the_codebase():
+    """Guard against the lint silently matching nothing (a regex typo would
+    make the convention check vacuous)."""
+    checker = _load_checker()
+    keys = checker.scanned_keys()
+    assert sum(keys.values()) >= 20, f"suspiciously few stats sites: {keys}"
+    # canonical keys the trainer loop writes must be visible to the scanner
+    assert "time/step" in keys
+    assert "time/train_step" in keys
+
+
+def test_lint_catches_a_bad_key(tmp_path):
+    checker = _load_checker()
+    bad = tmp_path / "mod.py"
+    bad.write_text('stats["no_namespace_key"] = 1.0\nstats["ok/key"] = 2.0\n')
+    violations = checker.find_violations(str(tmp_path))
+    assert [(v[2]) for v in violations] == ["no_namespace_key"]

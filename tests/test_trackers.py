@@ -1,0 +1,125 @@
+"""Tracker-layer coverage: JSONL round-trip, fallback paths, rank gating.
+
+All CPU-only/fast-tier; no wandb/tensorboard packages are required — the
+fallback tests force the ImportError path by monkeypatching the tracker
+classes, so they hold whether or not the packages exist in the image.
+"""
+
+import json
+
+import pytest
+
+from trlx_tpu.data.default_configs import default_ppo_config
+from trlx_tpu.utils import trackers as trackers_mod
+from trlx_tpu.utils.trackers import JSONLTracker, Tracker, make_tracker
+
+
+def _config(tmp_path, tracker="jsonl"):
+    return default_ppo_config().evolve(
+        train=dict(
+            tracker=tracker,
+            logging_dir=str(tmp_path / "logs"),
+            checkpoint_dir=str(tmp_path / "ckpts"),
+        ),
+        model=dict(model_path="builtin:gpt2-test"),
+        tokenizer=dict(tokenizer_path="builtin:bytes"),
+    )
+
+
+class TestJSONLTracker:
+    def test_round_trip_exact_keys_and_steps(self, tmp_path):
+        tracker = JSONLTracker(str(tmp_path), config_dict={"a": 1})
+        logged = [
+            (0, {"losses/loss": 1.5, "time/step": 0.25}),
+            (1, {"losses/loss": 1.25, "throughput/tokens_per_sec": 1000.0}),
+            (2, {"losses/loss": 1.0}),
+        ]
+        for step, stats in logged:
+            tracker.log(stats, step=step)
+        tracker.finish()
+
+        records = [json.loads(l) for l in open(tracker.path)]
+        assert [r["step"] for r in records] == [0, 1, 2]
+        for record, (_, stats) in zip(records, logged):
+            assert set(stats) <= set(record)
+            for k, v in stats.items():
+                assert record[k] == pytest.approx(v, rel=0.05)  # significant()
+        # config.json landed beside the stats
+        assert json.load(open(tmp_path / "config.json")) == {"a": 1}
+
+    def test_finish_is_idempotent(self, tmp_path):
+        tracker = JSONLTracker(str(tmp_path))
+        tracker.log({"losses/loss": 1.0}, step=0)
+        tracker.finish()
+        tracker.finish()  # double-close must not raise
+
+    def test_log_after_finish_reopens(self, tmp_path):
+        tracker = JSONLTracker(str(tmp_path))
+        tracker.log({"losses/loss": 1.0}, step=0)
+        tracker.finish()
+        tracker.log({"losses/loss": 0.5}, step=1)  # reopens, appends
+        tracker.finish()
+        records = [json.loads(l) for l in open(tracker.path)]
+        assert [r["step"] for r in records] == [0, 1]
+
+    def test_flush_every_batches_flushes_but_loses_nothing(self, tmp_path):
+        tracker = JSONLTracker(str(tmp_path), flush_every=10)
+        for step in range(5):
+            tracker.log({"losses/loss": float(step)}, step=step)
+        tracker.finish()  # close flushes the tail regardless of the knob
+        records = [json.loads(l) for l in open(tracker.path)]
+        assert [r["step"] for r in records] == list(range(5))
+
+    def test_context_manager_protocol(self, tmp_path):
+        with JSONLTracker(str(tmp_path)) as tracker:
+            tracker.log({"losses/loss": 1.0}, step=0)
+        assert tracker._f.closed
+        assert len(open(tracker.path).readlines()) == 1
+
+
+class TestMakeTracker:
+    def test_default_jsonl(self, tmp_path):
+        tracker = make_tracker(_config(tmp_path))
+        assert isinstance(tracker, JSONLTracker)
+        tracker.finish()
+
+    def test_missing_wandb_falls_back_to_jsonl_with_warning(
+        self, tmp_path, monkeypatch, trlx_log_records
+    ):
+        class Unavailable:
+            def __init__(self, *a, **kw):
+                raise ImportError("No module named 'wandb'")
+
+        monkeypatch.setattr(trackers_mod, "WandbTracker", Unavailable)
+        tracker = make_tracker(_config(tmp_path, tracker="wandb"))
+        assert isinstance(tracker, JSONLTracker)
+        assert any(
+            "falling back to JSONL" in r.getMessage() for r in trlx_log_records
+        )
+        tracker.finish()
+
+    def test_missing_tensorboard_falls_back_to_jsonl_with_warning(
+        self, tmp_path, monkeypatch, trlx_log_records
+    ):
+        class Unavailable:
+            def __init__(self, *a, **kw):
+                raise ImportError("No module named 'torch'")
+
+        monkeypatch.setattr(trackers_mod, "TensorBoardTracker", Unavailable)
+        tracker = make_tracker(_config(tmp_path, tracker="tensorboard"))
+        assert isinstance(tracker, JSONLTracker)
+        assert any(
+            "falling back to JSONL" in r.getMessage() for r in trlx_log_records
+        )
+        tracker.finish()
+
+    def test_nonzero_rank_gets_null_tracker(self, tmp_path, monkeypatch):
+        import jax
+
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        tracker = make_tracker(_config(tmp_path))
+        assert type(tracker) is Tracker  # the null tracker, exactly
+
+    def test_unknown_tracker_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="Unknown tracker"):
+            make_tracker(_config(tmp_path, tracker="mlflow"))

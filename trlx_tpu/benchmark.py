@@ -166,8 +166,42 @@ def run_task(
         "runtime_s": round(time.time() - t0, 1),
         "stats_path": os.path.join(task_dir, "stats.jsonl"),
     }
+    throughput = _throughput_summary(record["stats_path"])
+    if throughput:
+        record["throughput"] = throughput
     logger.info(f"benchmark {name}: rc={proc.returncode} ({record['runtime_s']}s)")
     return record
+
+
+_THROUGHPUT_KEYS = (
+    "throughput/tokens_per_sec",
+    "throughput/samples_per_sec",
+    "throughput/mfu",
+    "time/train_step",
+    "time/rollout",
+)
+
+
+def _throughput_summary(stats_path: str) -> Dict[str, float]:
+    """Mean of the observability layer's per-step throughput fields over a
+    task's stats stream — rides the suite's ``meta.json`` record so an A/B
+    comparison carries speed context, not just metric curves."""
+    if not os.path.exists(stats_path):
+        return {}
+    series: Dict[str, List[float]] = {}
+    with open(stats_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError:
+                continue
+            for key in _THROUGHPUT_KEYS:
+                value = record.get(key)
+                if isinstance(value, (int, float)):
+                    series.setdefault(key, []).append(float(value))
+    return {k: round(sum(v) / len(v), 6) for k, v in series.items()}
 
 
 def run_suite(
@@ -198,7 +232,11 @@ def _load_stats(run_dir: str, task: str) -> List[Dict[str, Any]]:
         return [json.loads(line) for line in f if line.strip()]
 
 
-_KEY_METRICS = ("reward/mean", "metrics/optimality", "metrics/sentiments", "losses/total_loss", "losses/loss")
+_KEY_METRICS = (
+    "reward/mean", "metrics/optimality", "metrics/sentiments",
+    "losses/total_loss", "losses/loss",
+    "throughput/tokens_per_sec", "throughput/mfu",
+)
 
 
 def compare_runs(run_a: str, run_b: str, metrics: Optional[List[str]] = None) -> str:

@@ -1,0 +1,108 @@
+"""Runtime observability: span tracing, metrics, MFU, and watchdogs.
+
+The reference framework validates performance only empirically on live GPUs;
+this repo's hardware-free *compiled* cost net (``trlx_tpu/perf.py``) guards
+programs, but nothing observed the *running* system. This subsystem closes
+that gap:
+
+- :mod:`tracing` — nestable, rank-aware spans with device fencing
+  (``block_until_ready`` at span exit) and JSONL + Chrome/Perfetto export;
+- :mod:`metrics` — counters/gauges/histograms feeding the existing
+  ``Tracker`` stream, plus tokens/sec / samples/sec / **MFU** derived by
+  joining fenced step times against XLA ``cost_analysis`` flops of the
+  exact compiled programs (``perf.lowered_costs``);
+- :mod:`watchdogs` — steady-state recompile detection and device-memory
+  gauges with CPU fallback;
+- :mod:`profiling` — ``TRLX_TPU_PROFILE=steps:3-5,dir:...`` programmatic
+  ``jax.profiler`` windows and per-step ``StepTraceAnnotation``.
+
+:class:`Observability` bundles one instance of each per trainer. See
+``docs/OBSERVABILITY.md`` for the span API and metric naming convention.
+"""
+
+import os
+from typing import Any, Dict, Optional
+
+from trlx_tpu.observability.metrics import (
+    DEFAULT_PEAK_FLOPS,
+    MetricsRegistry,
+    ThroughputMeter,
+    device_peak_flops,
+    mfu,
+    train_step_flops,
+)
+from trlx_tpu.observability.profiling import ProfileWindow, parse_profile_spec
+from trlx_tpu.observability.tracing import Span, Tracer, get_tracer, span
+from trlx_tpu.observability.watchdogs import DeviceMemoryGauge, RecompileWatchdog
+
+__all__ = [
+    "DEFAULT_PEAK_FLOPS",
+    "DeviceMemoryGauge",
+    "MetricsRegistry",
+    "Observability",
+    "ProfileWindow",
+    "RecompileWatchdog",
+    "Span",
+    "ThroughputMeter",
+    "Tracer",
+    "device_peak_flops",
+    "get_tracer",
+    "mfu",
+    "parse_profile_spec",
+    "span",
+    "train_step_flops",
+]
+
+
+class Observability:
+    """Per-trainer bundle: tracer + metrics + watchdogs + profile window.
+
+    Each trainer owns its own instance (no cross-trainer event bleed in a
+    process that builds several). ``export()`` writes the span stream next
+    to the tracker's stats (``trace.json`` + ``spans.jsonl``), process 0
+    only — the same single-writer gating as the trackers.
+    """
+
+    def __init__(self, config: Any = None, trace_dir: Optional[str] = None):
+        self.tracer = Tracer()
+        self.metrics = MetricsRegistry()
+        self.recompile = RecompileWatchdog(self.metrics)
+        # no registry mirror: the learn loop merges collect() into its stats
+        # directly; mirroring too would double-write every memory/* key and
+        # pin stale gauges into future snapshots
+        self.memory = DeviceMemoryGauge()
+        self.profile = ProfileWindow.from_env(config)
+        self.throughput = ThroughputMeter()
+        self._trace_dir = trace_dir or os.environ.get("TRLX_TPU_TRACE_DIR")
+        if self._trace_dir is None and config is not None:
+            train = getattr(config, "train", None)
+            logging_dir = getattr(train, "logging_dir", None)
+            checkpoint_dir = getattr(train, "checkpoint_dir", None)
+            if logging_dir:
+                self._trace_dir = logging_dir
+            elif checkpoint_dir:
+                self._trace_dir = os.path.join(checkpoint_dir, "logs")
+
+    def span(self, name: str, fence: Any = None, **args: Any):
+        return self.tracer.span(name, fence=fence, **args)
+
+    def export(self, directory: Optional[str] = None) -> Dict[str, str]:
+        """Write ``trace.json`` (Chrome/Perfetto) and ``spans.jsonl``.
+
+        Returns the written paths ({} when there is no directory, no
+        events, or this is a non-zero process)."""
+        directory = directory or self._trace_dir
+        if not directory or not self.tracer.events():
+            return {}
+        import jax
+
+        if jax.process_index() != 0:
+            return {}
+        return {
+            "trace": self.tracer.export_chrome_trace(
+                os.path.join(directory, "trace.json")
+            ),
+            "spans": self.tracer.export_jsonl(
+                os.path.join(directory, "spans.jsonl")
+            ),
+        }

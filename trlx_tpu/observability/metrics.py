@@ -1,0 +1,206 @@
+"""Metrics registry (counters / gauges / histograms) + throughput & MFU math.
+
+Every metric key follows the repo-wide ``namespace/name`` convention
+(enforced by ``scripts/check_metric_names.py``). The registry is a plain
+in-process sink: the trainer merges ``snapshot()`` into its per-step stats
+dict, so everything flows through the existing ``Tracker`` stream (JSONL /
+TensorBoard / W&B) with no new backend.
+
+MFU here is *measured*, not estimated: the FLOP numerator comes from XLA's
+``cost_analysis()`` of the **exact compiled program** the trainer runs (the
+same machinery as ``trlx_tpu/perf.py`` — see ``perf.lowered_costs``), joined
+against the device-fenced step time from the span tracer. ``cost_analysis``
+reports *per-device* flops, so MFU divides by the per-device peak directly.
+
+On hardware whose peak is unknown (CPU, exotic kinds), a nominal
+``DEFAULT_PEAK_FLOPS`` (1 TFLOP/s) keeps ``throughput/mfu`` defined as a
+run-over-run *relative* utilization index; set ``TRLX_TPU_PEAK_FLOPS`` (per
+device) to make it absolute.
+"""
+
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+# bf16 peak per chip — single source of truth (bench.py imports this table)
+TPU_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+# nominal per-device peak when the hardware is unknown (CPU test meshes):
+# keeps throughput/mfu defined as a relative index rather than absent
+DEFAULT_PEAK_FLOPS = 1e12
+
+
+def device_peak_flops(device=None) -> float:
+    """Per-device peak FLOP/s: ``TRLX_TPU_PEAK_FLOPS`` env override, else the
+    known TPU table by ``device_kind``, else :data:`DEFAULT_PEAK_FLOPS`."""
+    env = os.environ.get("TRLX_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    if device is None:
+        try:
+            import jax
+
+            device = jax.local_devices()[0]
+        except Exception:
+            return DEFAULT_PEAK_FLOPS
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in TPU_PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return DEFAULT_PEAK_FLOPS
+
+
+def mfu(flops_per_device: float, step_time_s: float, peak_flops_per_device: float) -> float:
+    """Model FLOP utilization of one device for one measured step.
+
+    ``flops_per_device`` must be XLA ``cost_analysis`` flops (already
+    per-device under SPMD), ``step_time_s`` a device-fenced wall time.
+    """
+    if step_time_s <= 0 or peak_flops_per_device <= 0:
+        return 0.0
+    return flops_per_device / step_time_s / peak_flops_per_device
+
+
+class MetricsRegistry:
+    """Thread-safe counters / gauges / histograms with a flat snapshot.
+
+    - counter: monotonically accumulates (``recompile/train_step``);
+    - gauge: last-write-wins (``memory/device_bytes_in_use``);
+    - histogram: per-window observations, summarized at snapshot as
+      ``name_mean`` / ``name_max`` / ``name_count`` and reset.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, List[float]] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> float:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+            return self._counters[name]
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            self._hists.setdefault(name, []).append(float(value))
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def snapshot(self, reset_histograms: bool = True) -> Dict[str, float]:
+        """Flat ``namespace/name`` → value dict for the tracker stream."""
+        with self._lock:
+            out: Dict[str, float] = dict(self._counters)
+            out.update(self._gauges)
+            for name, values in self._hists.items():
+                if not values:
+                    continue
+                out[f"{name}_mean"] = sum(values) / len(values)
+                out[f"{name}_max"] = max(values)
+                out[f"{name}_count"] = float(len(values))
+            if reset_histograms:
+                self._hists = {}
+            return out
+
+
+class ThroughputMeter:
+    """Derives per-step throughput stats from fenced step times.
+
+    ``step_stats`` returns the canonical keys the tracker stream carries:
+    ``throughput/tokens_per_sec``, ``throughput/samples_per_sec``, and —
+    when a program FLOP count is known — ``throughput/mfu`` plus
+    ``throughput/flops_per_sec_per_device``. Running totals fold in so a
+    final ``summary()`` reports whole-run averages.
+    """
+
+    def __init__(self, peak_flops_per_device: Optional[float] = None):
+        self._peak = peak_flops_per_device
+        self.total_time = 0.0
+        self.total_tokens = 0
+        self.total_samples = 0
+
+    @property
+    def peak(self) -> float:
+        if self._peak is None:
+            self._peak = device_peak_flops()
+        return self._peak
+
+    def step_stats(
+        self,
+        step_time_s: float,
+        tokens: int = 0,
+        samples: int = 0,
+        flops_per_device: Optional[float] = None,
+    ) -> Dict[str, float]:
+        stats: Dict[str, float] = {}
+        if step_time_s <= 0:
+            return stats
+        self.total_time += step_time_s
+        self.total_tokens += tokens
+        self.total_samples += samples
+        if tokens:
+            stats["throughput/tokens_per_sec"] = tokens / step_time_s
+        if samples:
+            stats["throughput/samples_per_sec"] = samples / step_time_s
+        if flops_per_device is not None and flops_per_device > 0:
+            stats["throughput/flops_per_sec_per_device"] = (
+                flops_per_device / step_time_s
+            )
+            stats["throughput/mfu"] = mfu(flops_per_device, step_time_s, self.peak)
+        return stats
+
+    def summary(self) -> Dict[str, float]:
+        if self.total_time <= 0:
+            return {}
+        out = {}
+        if self.total_tokens:
+            out["throughput/tokens_per_sec_avg"] = self.total_tokens / self.total_time
+        if self.total_samples:
+            out["throughput/samples_per_sec_avg"] = (
+                self.total_samples / self.total_time
+            )
+        return out
+
+
+def train_step_flops(jitted_fn, state: Any, batch: Any) -> Optional[float]:
+    """Per-device FLOPs of the exact compiled train step, via the same XLA
+    ``cost_analysis`` path as ``trlx_tpu/perf.py``.
+
+    Lowers ``jitted_fn`` with abstract (shape/dtype/sharding) twins of the
+    live arguments — no arrays are touched, and with the persistent compile
+    cache on, the AOT compile dedupes against the call-path executable.
+    Returns ``None`` (never raises) when the backend has no cost model or
+    lowering fails; disable entirely with ``TRLX_TPU_MFU=0``.
+    """
+    if os.environ.get("TRLX_TPU_MFU", "1") == "0":
+        return None
+    try:
+        import jax
+
+        from trlx_tpu.perf import lowered_costs
+
+        def abstract(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+                ),
+                tree,
+            )
+
+        costs = lowered_costs(jitted_fn.lower(abstract(state), abstract(batch)))
+        flops = costs.get("flops", -1.0)
+        return flops if flops > 0 else None
+    except Exception:
+        return None

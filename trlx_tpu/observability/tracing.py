@@ -1,0 +1,225 @@
+"""Lightweight span tracer for the runtime (host wall-time, device-fenced).
+
+The trainer's timers historically clocked JAX's *async dispatch* — the host
+returns from a jitted call long before the device finishes. A :class:`Span`
+therefore carries an optional **fence**: a pytree of device arrays that is
+``jax.block_until_ready``-ed at span exit, so the recorded duration is
+device-true execution time, not dispatch latency.
+
+Spans nest (a thread-local stack), are rank-aware (every event records
+``jax.process_index()`` as its Chrome-trace ``pid``), and export two ways:
+
+- ``export_jsonl(path)`` — one JSON object per span, grep/pandas friendly;
+- ``export_chrome_trace(path)`` — Chrome/Perfetto ``trace.json`` (complete
+  ``"ph": "X"`` events; containment on one ``tid`` renders as nesting).
+
+Usage::
+
+    from trlx_tpu.observability import span
+
+    with span("rollout"):
+        with span("generate") as sp:
+            out = generate(...)
+            sp.fence(out.sequences)   # block on device work at exit
+"""
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+FenceLike = Union[None, Any, Callable[[], Any]]
+
+
+def _process_index() -> int:
+    # lazy: importing/initializing jax at module import would race the
+    # platform-selection env vars set by conftest/initialize_runtime
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def _block(tree: Any) -> None:
+    import jax
+
+    jax.block_until_ready(tree)
+
+
+class Span:
+    """One timed region. ``duration`` is valid after the span closes."""
+
+    __slots__ = ("name", "depth", "args", "t0", "t1", "_fence")
+
+    def __init__(self, name: str, depth: int, args: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.depth = depth
+        self.args = args or {}
+        self.t0 = time.perf_counter()
+        self.t1: Optional[float] = None
+        self._fence: FenceLike = None
+
+    def fence(self, tree: FenceLike) -> "Span":
+        """Set the device pytree to ``block_until_ready`` at span exit."""
+        self._fence = tree
+        return self
+
+    @property
+    def duration(self) -> float:
+        """Seconds, device-fenced if a fence was set. 0.0 while open."""
+        return (self.t1 - self.t0) if self.t1 is not None else 0.0
+
+    def close(self) -> float:
+        if self._fence is not None:
+            _block(self._fence() if callable(self._fence) else self._fence)
+        self.t1 = time.perf_counter()
+        return self.duration
+
+
+class Tracer:
+    """Collects closed spans as Chrome-trace-shaped events.
+
+    Thread-safe for recording; the span *stack* is thread-local so spans
+    opened on different threads nest independently. The event buffer is
+    bounded (``max_events``): past the cap, events are dropped and counted
+    rather than growing without limit over a long run.
+    """
+
+    def __init__(self, enabled: bool = True, max_events: int = 200_000):
+        self.enabled = enabled
+        self.max_events = max_events
+        self.dropped = 0
+        self._events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._epoch = time.perf_counter()
+        self._last_duration: Dict[str, float] = {}
+
+    # -- recording ------------------------------------------------------
+
+    def _stack(self) -> List[Span]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextmanager
+    def span(
+        self, name: str, fence: FenceLike = None, **args: Any
+    ) -> Iterator[Span]:
+        """Open a nested span; closes (and fences) on exit even on error."""
+        stack = self._stack()
+        sp = Span(name, depth=len(stack), args=args)
+        if fence is not None:
+            sp.fence(fence)
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            # remove *this* span (not blindly the top): an exception that
+            # unwinds past a manually-entered inner span must not corrupt
+            # the depth bookkeeping of outer spans
+            if sp in stack:
+                stack.remove(sp)
+            dur = sp.close()
+            self._last_duration[name] = dur
+            if self.enabled:
+                self._record(sp)
+
+    def instant(self, name: str, **args: Any) -> None:
+        """A zero-duration marker event (Chrome-trace ``"ph": "i"``)."""
+        if not self.enabled:
+            return
+        event = {
+            "name": name,
+            "ph": "i",
+            "ts": (time.perf_counter() - self._epoch) * 1e6,
+            "pid": _process_index(),
+            "tid": threading.get_ident() % 2**31,
+            "s": "t",
+        }
+        if args:
+            event["args"] = args
+        self._append(event)
+
+    def _record(self, sp: Span) -> None:
+        event = {
+            "name": sp.name,
+            "ph": "X",
+            "ts": (sp.t0 - self._epoch) * 1e6,
+            "dur": (sp.t1 - sp.t0) * 1e6,
+            "pid": _process_index(),
+            "tid": threading.get_ident() % 2**31,
+        }
+        if sp.args:
+            event["args"] = dict(sp.args)
+        self._append(event)
+
+    def _append(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(event)
+
+    # -- reading / export ----------------------------------------------
+
+    def last_duration(self, name: str, default: float = 0.0) -> float:
+        """Duration of the most recently closed span named ``name``."""
+        return self._last_duration.get(name, default)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events = []
+            self.dropped = 0
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        meta = {"dropped_events": self.dropped} if self.dropped else {}
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms", **meta}
+
+    def export_chrome_trace(self, path: str) -> str:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+    def export_jsonl(self, path: str) -> str:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            for e in self.events():
+                record = {
+                    "name": e["name"],
+                    "start_s": e["ts"] / 1e6,
+                    "dur_s": e.get("dur", 0.0) / 1e6,
+                    "pid": e["pid"],
+                    "tid": e["tid"],
+                }
+                if "args" in e:
+                    record["args"] = e["args"]
+                f.write(json.dumps(record) + "\n")
+        return path
+
+
+# ---------------------------------------------------------------------------
+# module-level default tracer (library users without a trainer)
+# ---------------------------------------------------------------------------
+
+_DEFAULT_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _DEFAULT_TRACER
+
+
+@contextmanager
+def span(name: str, fence: FenceLike = None, **args: Any) -> Iterator[Span]:
+    """``with span("rollout"): ...`` on the module-level default tracer."""
+    with _DEFAULT_TRACER.span(name, fence=fence, **args) as sp:
+        yield sp

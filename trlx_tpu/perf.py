@@ -84,6 +84,15 @@ def _costs_of(lowered) -> Dict[str, float]:
     return out
 
 
+def lowered_costs(lowered) -> Dict[str, float]:
+    """Public seam for the observability layer: cost/memory analysis of an
+    already-lowered program (``jit_fn.lower(...)``). The runtime MFU metric
+    (``trlx_tpu/observability/metrics.py``) joins these flops against
+    device-fenced step times, so the numerator is the *exact* compiled
+    program the trainer runs — same accounting as :func:`hot_program_costs`."""
+    return _costs_of(lowered)
+
+
 def _train_batch_sds(trainer_name: str, B: int, P: int, N: int) -> Dict[str, Any]:
     """Abstract train-step batch for each supported trainer's loss contract."""
     SDS = jax.ShapeDtypeStruct

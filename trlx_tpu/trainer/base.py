@@ -57,6 +57,8 @@ from trlx_tpu.utils.checkpoint import (
     save_state,
     wait_for_saves,
 )
+from trlx_tpu.observability import Observability, train_step_flops
+from trlx_tpu.observability import mfu as obs_mfu
 from trlx_tpu.utils.trackers import make_tracker
 
 logger = logging.get_logger(__name__)
@@ -176,6 +178,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         self.param_mask = mask_fn(params, self.tcfg, config.model.num_layers_unfrozen)
         self.draft_module = self.draft_params = self.draft_tcfg = None
         self.last_spec_stats: Dict[str, float] = {}
+        self.last_generate_time = 0.0
         if config.model.draft_model_path and self.is_seq2seq:
             logger.warning(
                 "model.draft_model_path is ignored for seq2seq models: "
@@ -260,6 +263,11 @@ class TPUBaseTrainer(BaseRLTrainer):
         self._last_batch_sharded: Any = None
 
         self.tracker = make_tracker(config)
+        # runtime observability: span tracer, metrics registry, recompile/
+        # memory watchdogs, profiler window (docs/OBSERVABILITY.md)
+        self.obs = Observability(config)
+        self._train_step_flops: Optional[float] = None
+        self._flops_thread = None
         self.eval_pipeline: Optional[BasePipeline] = None
         self.iter_count = 0
         self.nth_evaluation = 0
@@ -367,6 +375,26 @@ class TPUBaseTrainer(BaseRLTrainer):
         schedule = self.schedule
         accum = max(1, int(getattr(self.config.train, "grad_accum", 1)))
 
+        # Pin the output state's shardings to the input state's (explicit
+        # out_shardings below). Without the pin, output shardings are
+        # reconstructed from XLA's canonicalized HloShardings, which strip
+        # size-1 mesh axes from specs (P('fsdp','model') → P() on a dp-only
+        # mesh): the step-1 output state then hashes differently from the
+        # step-1 input and step 2 silently recompiles the entire program —
+        # one full extra XLA compile and a second resident executable every
+        # run. Found by the recompile watchdog (observability/watchdogs.py).
+        from jax.sharding import NamedSharding
+
+        if all(
+            isinstance(getattr(leaf, "sharding", None), NamedSharding)
+            for leaf in jax.tree_util.tree_leaves(self.state)
+        ):
+            state_shardings = jax.tree_util.tree_map(
+                lambda leaf: leaf.sharding, self.state
+            )
+        else:  # abstract_init analysis trainers carry no real shardings
+            state_shardings = None
+
         def grads_of(params, batch, rng):
             return jax.value_and_grad(self.loss_fn, has_aux=True)(params, batch, rng)
 
@@ -428,6 +456,11 @@ class TPUBaseTrainer(BaseRLTrainer):
             )
             return new_state, stats
 
+        if state_shardings is not None:
+            # stats stay unspecified (None): XLA picks, as before
+            return jax.jit(
+                step_fn, donate_argnums=(0,), out_shardings=(state_shardings, None)
+            )
         return jax.jit(step_fn, donate_argnums=(0,))
 
     def _drop_batch_memo(self) -> None:
@@ -446,6 +479,34 @@ class TPUBaseTrainer(BaseRLTrainer):
 
             return PrefetchLoader(loader, depth)
         return loader
+
+    def _batch_token_count(self, batch: Any) -> int:
+        """Real (unpadded) tokens this batch feeds the step — from the batch
+        masks, so padding doesn't inflate ``throughput/tokens_per_sec``."""
+        items = batch._asdict() if hasattr(batch, "_asdict") else batch
+        if not isinstance(items, dict):
+            return 0
+        if "attention_mask" in items:
+            return int(np.asarray(items["attention_mask"]).sum())
+        masks = [
+            v for k, v in items.items() if k.endswith("mask") and hasattr(v, "sum")
+        ]
+        if masks:
+            return int(sum(np.asarray(m).sum() for m in masks))
+        for v in items.values():
+            if hasattr(v, "shape") and len(v.shape) >= 2:
+                return int(v.shape[0] * v.shape[1])
+        return 0
+
+    def _export_observability(self) -> None:
+        """Best-effort span export (``trace.json`` + ``spans.jsonl``) next to
+        the tracker's stats — never allowed to fail a training run."""
+        try:
+            paths = self.obs.export()
+            if paths:
+                logger.info(f"wrote span trace: {paths['trace']}")
+        except Exception as e:  # pragma: no cover - defensive
+            logger.warning(f"span trace export failed: {e}")
 
     def train_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         """One optimization step on a host batch; returns host scalar stats.
@@ -467,7 +528,58 @@ class TPUBaseTrainer(BaseRLTrainer):
             self._last_batch_host = batch
             self._last_batch_sharded = arrays
         self.state, stats = self._train_step_fn(self.state, arrays)
+        # recompile watchdog: a warm train step retracing (shape/dtype
+        # drift) is invisible otherwise — it just gets slow
+        self.obs.recompile.observe("train_step", self._train_step_fn)
         return stats
+
+    def _ensure_train_step_flops(
+        self, arrays: Optional[Dict[str, jax.Array]], wait: bool = False
+    ) -> Optional[float]:
+        """Per-device flops of the compiled train step (for MFU), computed
+        once per trainer from the exact program via ``perf.lowered_costs``.
+
+        The AOT lower+compile does not share the jit call path's executable
+        cache, so it runs on a daemon thread — the hot loop never stalls on
+        a duplicate XLA compile; ``throughput/mfu`` simply appears in the
+        stats stream once the analysis lands (typically a few steps in).
+        ``None`` while pending, unavailable, or disabled (``TRLX_TPU_MFU=0``)."""
+        if (
+            self._train_step_flops is None
+            and self._flops_thread is None
+            and self._train_step_fn is not None
+            and arrays is not None
+        ):
+            import threading
+
+            # abstract twins are built HERE (metadata only): the worker must
+            # not hold the live state/batch arrays across later donations
+            abstract = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+                ),
+                (self.state, arrays),
+            )
+
+            def work(fn=self._train_step_fn, args=abstract):
+                # -1 sentinel: tried and unavailable, don't retry
+                self._train_step_flops = train_step_flops(fn, *args) or -1.0
+
+            self._flops_thread = threading.Thread(
+                target=work, name="trlx-tpu-flops", daemon=True
+            )
+            self._flops_thread.start()
+        if (
+            wait
+            and self._flops_thread is not None
+            and self._train_step_flops is None
+        ):
+            # end-of-run join: short runs still report a final MFU; a
+            # still-compiling analysis on a big model gives up after the
+            # timeout rather than stalling exit
+            self._flops_thread.join(timeout=120.0)
+        flops = self._train_step_flops
+        return flops if flops is not None and flops > 0 else None
 
     # ------------------------------------------------------------------
     # generation
@@ -654,20 +766,26 @@ class TPUBaseTrainer(BaseRLTrainer):
         # path — a draft-less or seq2seq generate must not keep reporting a
         # stale acceptance rate from an earlier speculative call
         self.last_spec_stats = {}
-        out = fn(self.state.params, batch["input_ids"], batch["attention_mask"], rng)
-        if type(out) is tuple:  # speculative sampler: (output, stats) —
-            # GenerationOutput itself is a NamedTuple, hence the exact check
-            out, spec_stats = out
-            # recorded for make_experience's stats (rollout observability:
-            # the knob this informs is model.draft_gamma)
-            self.last_spec_stats = {
-                "rollout/spec_acceptance_rate": float(
-                    np.asarray(jax.device_get(spec_stats["acceptance_rate"]))
-                ),
-                "rollout/spec_rounds": int(
-                    np.asarray(jax.device_get(spec_stats["rounds"]))
-                ),
-            }
+        # fenced span: duration is device-true decode time, not dispatch
+        # latency (nests under make_experience's "rollout" span)
+        with self.obs.span("generate", eval_mode=bool(eval_mode)) as sp:
+            out = fn(self.state.params, batch["input_ids"], batch["attention_mask"], rng)
+            if type(out) is tuple:  # speculative sampler: (output, stats) —
+                # GenerationOutput itself is a NamedTuple, hence the exact check
+                out, spec_stats = out
+                # recorded for make_experience's stats (rollout observability:
+                # the knob this informs is model.draft_gamma)
+                self.last_spec_stats = {
+                    "rollout/spec_acceptance_rate": float(
+                        np.asarray(jax.device_get(spec_stats["acceptance_rate"]))
+                    ),
+                    "rollout/spec_rounds": int(
+                        np.asarray(jax.device_get(spec_stats["rounds"]))
+                    ),
+                }
+            sp.fence((out.sequences, out.response_tokens))
+        self.last_generate_time = sp.duration
+        self.obs.recompile.observe("generate", fn)
         return out
 
     def generate_eval(self, input_ids, attention_mask=None, **kwargs) -> GenerationOutput:
@@ -736,6 +854,8 @@ class TPUBaseTrainer(BaseRLTrainer):
             all_prompts: List[str] = []
             all_outputs: List[str] = []
             all_samples: List[str] = []
+            # device-true: every generate() call below fences on its outputs
+            # at span exit, so this loop timer no longer reads dispatch
             gen_time = time()
             for batch in loader:
                 out = self.generate_eval(
@@ -834,27 +954,41 @@ class TPUBaseTrainer(BaseRLTrainer):
             leave=True,
         )
 
-        profile_dir = getattr(self.config.train, "profile_dir", None)
-        profiling = False
+        profile = self.obs.profile
         for _ in range(self.config.train.epochs):
             for batch in self._maybe_prefetch(self.train_dataloader):
                 for _ in range(self.n_updates_per_batch):
-                    if profile_dir and self.iter_count == 1 and not profiling:
-                        jax.profiler.start_trace(profile_dir)
-                        profiling = True
-                    if profiling and self.iter_count >= 5:
-                        jax.profiler.stop_trace()
-                        profiling = False
-                    forward_time = time()
-                    device_stats = self.train_step(batch)
+                    profile.on_step_start(self.iter_count)
+                    with profile.step_annotation("train", self.iter_count):
+                        with self.obs.span("train_step") as sp:
+                            device_stats = self.train_step(batch)
+                            # fence on the new state AND the stat outputs:
+                            # the donated-state update can still be in
+                            # flight after the stats land, and without any
+                            # fence the timer reads async dispatch latency
+                            sp.fence((self.state, device_stats))
                     stats = filter_non_scalars(to_host(device_stats))
-                    forward_time = time() - forward_time
-                    stats["time/step"] = forward_time
+                    step_time = sp.duration
+                    stats["time/step"] = step_time
+                    stats["time/train_step"] = step_time
                     batch_size = next(
                         v.shape[0] for v in batch.values() if hasattr(v, "shape")
                     ) if isinstance(batch, dict) else self.config.train.batch_size
+                    stats.update(
+                        self.obs.throughput.step_stats(
+                            step_time,
+                            tokens=self._batch_token_count(batch),
+                            samples=batch_size,
+                            flops_per_device=self._ensure_train_step_flops(
+                                self._last_batch_sharded
+                            ),
+                        )
+                    )
+                    stats.update(self.obs.memory.collect())
+                    stats.update(self.obs.metrics.snapshot())
                     clock.tick(batch_size)
                     stats["time/per_1k_samples"] = clock.get_stat(1000)
+                    profile.on_step_end(self.iter_count)
                     self.iter_count += 1
 
                     if self.iter_count % self.config.train.checkpoint_interval == 0:
@@ -886,18 +1020,28 @@ class TPUBaseTrainer(BaseRLTrainer):
                     tbar.update()
 
                     if self.iter_count >= self.total_steps:
-                        if profiling:
-                            jax.profiler.stop_trace()
-                            profiling = False
+                        profile.stop()
+                        # the flops analysis runs on a daemon thread; join it
+                        # here so even a run too short for it to land mid-loop
+                        # still reports a final measured MFU
+                        flops = self._ensure_train_step_flops(
+                            self._last_batch_sharded, wait=True
+                        )
+                        if flops and "throughput/mfu" not in stats:
+                            stats["throughput/mfu"] = obs_mfu(
+                                flops, step_time, self.obs.throughput.peak
+                            )
                         self._drop_batch_memo()
                         results = self.evaluate()
                         stats.update(results)
+                        stats.update(self.obs.throughput.summary())
                         self.tracker.log(stats, step=self.iter_count)
                         self._report_sweep(stats)
                         subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
                         self.save(os.path.join(self.config.train.checkpoint_dir, subfolder))
                         tbar.close()
                         wait_for_saves()  # async saves must land before exit
+                        self._export_observability()
                         return results
 
                     self.tracker.log(stats, step=self.iter_count)
@@ -905,10 +1049,10 @@ class TPUBaseTrainer(BaseRLTrainer):
                 self.post_backward_callback()
             self._drop_batch_memo()  # free the batch's HBM before rollouts
             self.post_epoch_callback()
-        if profiling:
-            jax.profiler.stop_trace()
+        profile.stop()
         tbar.close()
         wait_for_saves()  # async saves must land before exit
+        self._export_observability()
         return results
 
     # ------------------------------------------------------------------

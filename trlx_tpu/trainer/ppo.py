@@ -20,6 +20,7 @@ math (KL penalty, masked stats) runs on device in one jitted program per
 shape bucket.
 """
 
+from contextlib import ExitStack
 from time import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -286,57 +287,78 @@ class PPOTrainer(TPUBaseTrainer):
         exp_time = time()
 
         while len(elements) < num_rollouts:
-            batch = next(self.prompt_iterator)
-            prompt_ids = np.asarray(batch["input_ids"], np.int32)
-            prompt_mask = np.asarray(batch["attention_mask"], np.int32)
+            with self.obs.span("rollout") as rollout_sp:
+                batch = next(self.prompt_iterator)
+                prompt_ids = np.asarray(batch["input_ids"], np.int32)
+                prompt_mask = np.asarray(batch["attention_mask"], np.int32)
 
-            gen_time = time()
-            gen_out = self.generate(prompt_ids, prompt_mask)
+                gen_time = time()
+                # generate() opens its own fenced "generate" span, nested
+                # under this "rollout" span in the Chrome/Perfetto export
+                gen_out = self.generate(prompt_ids, prompt_mask)
 
-            # dispatch the scoring forward immediately on the generation's
-            # device arrays — it needs nothing from the host, so it runs
-            # while the host decodes strings and calls reward_fn below
-            B, P = prompt_ids.shape
-            N = int(gen_out.response_tokens.shape[1])
-            score_fn = self._get_score_fn((B, P, N))
-            score_out = score_fn(
-                self.state.params,
-                self.ref_params,
-                gen_out.sequences,
-                shard_batch({"prompt_mask": prompt_mask}, self.mesh)["prompt_mask"],
-                gen_out.response_tokens,
-                gen_out.response_mask,
-            )
+                # dispatch the scoring forward immediately on the generation's
+                # device arrays — it needs nothing from the host, so it runs
+                # while the host decodes strings and calls reward_fn below.
+                # The "score" span deliberately covers dispatch → host landing
+                # (closing at the blocking to_host below), so the recorded
+                # time includes the overlap window rather than serializing it
+                B, P = prompt_ids.shape
+                N = int(gen_out.response_tokens.shape[1])
+                score_fn = self._get_score_fn((B, P, N))
+                with ExitStack() as score_ctx:
+                    # ExitStack (not a plain `with`) because the span must
+                    # stay open across the deliberately-interleaved decode/
+                    # reward work below, yet still close if any of it raises
+                    score_sp = score_ctx.enter_context(self.obs.span("score"))
+                    score_out = score_fn(
+                        self.state.params,
+                        self.ref_params,
+                        gen_out.sequences,
+                        shard_batch({"prompt_mask": prompt_mask}, self.mesh)["prompt_mask"],
+                        gen_out.response_tokens,
+                        gen_out.response_mask,
+                    )
+                    self.obs.recompile.observe("score", score_fn)
 
-            # start the device→host copies of the scoring outputs without
-            # blocking, then fetch the (already finished) generation outputs;
-            # the string decode + reward_fn below genuinely overlap the
-            # scoring forward and its transfer
-            for leaf in jax.tree_util.tree_leaves(score_out):
-                if hasattr(leaf, "copy_to_host_async"):
-                    leaf.copy_to_host_async()
-            host_gen = to_host(
-                {
-                    "response_tokens": gen_out.response_tokens,
-                    "response_mask": gen_out.response_mask,
-                }
-            )
-            response_tokens = np.asarray(host_gen["response_tokens"])
-            response_mask = np.asarray(host_gen["response_mask"])
-            stats["time/exp_generate"] = time() - gen_time
-            stats.update(self.last_spec_stats)
+                    # start the device→host copies of the scoring outputs without
+                    # blocking, then fetch the (already finished) generation outputs;
+                    # the string decode + reward_fn below genuinely overlap the
+                    # scoring forward and its transfer
+                    for leaf in jax.tree_util.tree_leaves(score_out):
+                        if hasattr(leaf, "copy_to_host_async"):
+                            leaf.copy_to_host_async()
+                    host_gen = to_host(
+                        {
+                            "response_tokens": gen_out.response_tokens,
+                            "response_mask": gen_out.response_mask,
+                        }
+                    )
+                    response_tokens = np.asarray(host_gen["response_tokens"])
+                    response_mask = np.asarray(host_gen["response_mask"])
+                    stats["time/exp_generate"] = time() - gen_time
+                    stats["time/generate"] = self.last_generate_time
+                    stats.update(self.last_spec_stats)
 
-            samples, prompts, outputs = self.decode(
-                prompt_ids, response_tokens, append_eos_token=True
-            )
+                    samples, prompts, outputs = self.decode(
+                        prompt_ids, response_tokens, append_eos_token=True
+                    )
 
-            score_time = time()
-            scores = np.asarray(
-                self.reward_fn(samples=samples, prompts=prompts, outputs=outputs),
-                dtype=np.float32,
-            )
-            stats["time/exp_score"] = time() - score_time
-            host = to_host(score_out)  # usually landed already (async copy)
+                    with self.obs.span("reward") as reward_sp:
+                        scores = np.asarray(
+                            self.reward_fn(samples=samples, prompts=prompts, outputs=outputs),
+                            dtype=np.float32,
+                        )
+                    stats["time/reward"] = reward_sp.duration
+                    stats["time/exp_score"] = reward_sp.duration
+                    host = to_host(score_out)  # usually landed already (async copy)
+                stats["time/score"] = score_sp.duration
+            stats["time/rollout"] = rollout_sp.duration
+            gen_tokens = int(response_mask.sum())
+            if rollout_sp.duration > 0 and gen_tokens:
+                stats["throughput/rollout_tokens_per_sec"] = (
+                    gen_tokens / rollout_sp.duration
+                )
 
             # reward scaling/clipping (reference :350-366)
             scores_mean, scores_std = self.running_moments.update(scores)
