@@ -542,6 +542,13 @@ def main():
         "tokens_per_sec": round(samples_per_sec * seq, 1),
         "mfu": round(mfu_real, 4) if np.isfinite(mfu_real) else None,
     }
+    # rollout-pipeline overlap (docs/PERFORMANCE.md): fraction of the last
+    # cycle's rollout wall-time in which host reward scoring was hidden
+    # behind device generation (0.0 on the depth-0 serial path)
+    overlap = trainer.make_experience_stats.get("throughput/rollout_overlap_frac")
+    line["rollout_overlap_frac"] = (
+        round(float(overlap), 4) if overlap is not None else None
+    )
     if note:
         line["note"] = note
     # the headline contract is emitted BEFORE the optional xl stage: an

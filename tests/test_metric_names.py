@@ -36,6 +36,10 @@ def test_scanner_sees_the_codebase():
     # canonical keys the trainer loop writes must be visible to the scanner
     assert "time/step" in keys
     assert "time/train_step" in keys
+    # rollout-pipeline keys (docs/PERFORMANCE.md) are namespaced, not
+    # allowlisted — the convention covers them like any other metric
+    assert "time/rollout_host" in keys
+    assert "throughput/rollout_overlap_frac" in keys
 
 
 def test_lint_catches_a_bad_key(tmp_path):

@@ -333,9 +333,11 @@ def test_ppo_smoke_emits_throughput_and_trace(tmp_path):
         "throughput/samples_per_sec",
         "throughput/mfu",
         "time/rollout",
+        "time/rollout_host",
         "time/score",
         "time/train_step",
         "time/step",
+        "throughput/rollout_overlap_frac",
         "memory/host_rss_bytes",
     ):
         assert key in keys, f"stats stream is missing {key}: {sorted(keys)}"

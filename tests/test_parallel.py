@@ -112,3 +112,44 @@ def test_param_specs_cover_whole_tree():
     assert len(flat_p) == len(flat_s)
     for p, s in zip(flat_p, flat_s):
         assert len(tuple(s)) <= p.ndim
+
+
+def test_param_spec_warns_when_large_dim_drops_axis_group(trlx_log_records):
+    """A param dim divisible by NO axis of its group silently replicates;
+    above the byte threshold that now gets a one-line diagnosis (advisor
+    r5), mirroring _warn_indivisible_experts. Small params stay silent, and
+    so do raw fit_spec calls (activation constraints: a dropped group skips
+    the constraint, nothing replicates)."""
+    from trlx_tpu.parallel.sharding import fit_spec
+
+    mesh = make_mesh(ParallelConfig(data=2, fsdp=2, model=2))
+
+    def warnings_for(fn, *args):
+        trlx_log_records.clear()
+        result = fn(*args)
+        return result, [
+            r.getMessage() for r in trlx_log_records if r.levelname == "WARNING"
+        ]
+
+    path = "backbone/wte/embedding"  # rule: P(("model", "fsdp"), None)
+    # large (>= 8 MiB at 4 B/elem) + odd vocab over model*fsdp -> warn
+    spec, msgs = warnings_for(param_spec_for_path, path, (2_097_153, 4), mesh)
+    assert spec == P(None, None)
+    assert len(msgs) == 1 and "replicates" in msgs[0] and path in msgs[0], msgs
+    # warn-once: the same signature never logs twice
+    _, msgs = warnings_for(param_spec_for_path, path, (2_097_153, 4), mesh)
+    assert msgs == []
+    # small params replicate silently (cheap, usually deliberate)
+    _, msgs = warnings_for(param_spec_for_path, path, (259, 64), mesh)
+    assert msgs == []
+    # a dividing dim sheds nothing and stays silent
+    spec, msgs = warnings_for(param_spec_for_path, path, (2_097_152, 4), mesh)
+    assert spec == P(("model", "fsdp"), None)
+    assert msgs == []
+    # raw fit_spec (the activation-constraint path) NEVER warns: there a
+    # dropped group means "constraint skipped", not "array replicated"
+    fitted, msgs = warnings_for(
+        fit_spec, mesh, (1, 2_097_153, 4), (("data", "fsdp"), None, None)
+    )
+    assert fitted == P(None, None, None)
+    assert msgs == []

@@ -495,3 +495,40 @@ def test_trial_command_launcher_template_robustness():
     assert "WANDB_API_KEY=secret" in cmd and "XLA_FLAGS=--foo" in cmd
     assert "UNRELATED" not in cmd  # non-contract env never leaks
     assert "TRLX_TPU_SWEEP_RESULT=/tmp/r.json" in cmd
+
+
+def test_trial_command_warns_on_placeholder_near_miss(trlx_log_records):
+    """A typo'd placeholder ({pyhton}, {hparam}, {HOST}) survives
+    substitution silently into the shell line — the builder now flags it;
+    genuine shell/awk braces stay silent (advisor r5)."""
+    from trlx_tpu.sweep import _trial_command
+
+    def warnings_for(launcher):
+        trlx_log_records.clear()
+        _trial_command(launcher, __file__, {"a": 1}, "h1", {})
+        return [
+            r.getMessage() for r in trlx_log_records if r.levelname == "WARNING"
+        ]
+
+    # exact tokens substitute: nothing survives, nothing warns
+    assert warnings_for("{python} {script} {hparams}") == []
+    # near misses: typo, missing plural, wrong case
+    for bad, hint in (("{pyhton}", "python"), ("{hparam}", "hparams"), ("{HOST}", "host")):
+        msgs = warnings_for(f"{bad} {{script}} {{hparams}}")
+        assert len(msgs) == 1 and bad.strip("{}") in msgs[0] and hint in msgs[0], (
+            bad, msgs
+        )
+    # warn-once per template: a 200-trial sweep diagnoses its typo once
+    assert warnings_for("{pyhton} {script} {hparams}") == []
+    # shell/awk constructs that merely *look* braced stay silent
+    assert warnings_for(
+        "ssh {host} 'echo ${HOME} ${arr[0]} ${VAR:-/tmp} | awk {print}' "
+        "{python} {script} {hparams}"
+    ) == []
+    # brace text inside substituted VALUES is the user's business: only the
+    # template is scanned
+    trlx_log_records.clear()
+    from trlx_tpu.sweep import _trial_command as tc
+
+    tc("{python} {script} {hparams}", __file__, {"fmt": "{host} {pyhton}"}, "h1", {})
+    assert [r for r in trlx_log_records if r.levelname == "WARNING"] == []

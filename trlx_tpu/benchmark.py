@@ -177,8 +177,10 @@ _THROUGHPUT_KEYS = (
     "throughput/tokens_per_sec",
     "throughput/samples_per_sec",
     "throughput/mfu",
+    "throughput/rollout_overlap_frac",
     "time/train_step",
     "time/rollout",
+    "time/rollout_host",
 )
 
 
@@ -236,6 +238,7 @@ _KEY_METRICS = (
     "reward/mean", "metrics/optimality", "metrics/sentiments",
     "losses/total_loss", "losses/loss",
     "throughput/tokens_per_sec", "throughput/mfu",
+    "throughput/rollout_overlap_frac",
 )
 
 

@@ -243,6 +243,15 @@ class TrainConfig:
     # runs the current step. 0 disables.
     prefetch_batches: int = 2
 
+    # Software-pipelined experience collection: up to this many rollout
+    # chunks' host work (string decode, reward_fn, device→host fetches) may
+    # be in flight on a background worker while the device generates the
+    # next chunk. Within one make_experience call the params never change,
+    # so the overlap is exactly equivalent to the serial schedule — the
+    # store is bit-identical under a fixed seed (docs/PERFORMANCE.md).
+    # 0 = the serial reference path.
+    rollout_pipeline_depth: int = 2
+
     from_dict = classmethod(_strict_from_dict)
 
 

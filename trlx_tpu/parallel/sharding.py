@@ -18,6 +18,7 @@ Rules apply to *paths*, so the same table covers the backbone, value heads,
 Q heads, and any future module that follows the naming convention.
 """
 
+import functools
 import re
 from typing import Any, Dict, Optional, Tuple
 
@@ -98,7 +99,23 @@ def param_spec_for_path(
         partitions = ("pipe",) + partitions
     partitions = partitions[: len(shape)]
     if mesh is not None:
-        return fit_spec(mesh, shape, partitions)
+        fitted = fit_spec(mesh, shape, partitions)
+        # diagnosis for silently-replicated LARGE params: a dim that sheds
+        # its whole (present, >1-sized) axis group costs real memory —
+        # activation constraints go through fit_spec directly and stay
+        # silent (there a dropped group just skips the constraint)
+        if int(np.prod(shape)) * 4 >= _REPLICATE_WARN_BYTES:
+            for dim, axis, kept in zip(shape, partitions, tuple(fitted)):
+                if axis is None or kept is not None:
+                    continue
+                names = axis if isinstance(axis, tuple) else (axis,)
+                present = tuple(n for n in names if n in mesh.shape)
+                group = 1
+                for n in present:
+                    group *= mesh.shape[n]
+                if group > 1:
+                    _warn_dropped_axis_group(path, tuple(shape), dim, present, group)
+        return fitted
     partitions = partitions + (None,) * (len(shape) - len(partitions))
     return P(*partitions)
 
@@ -146,6 +163,33 @@ def shard_params(params: Any, mesh: Mesh) -> Any:
     """Place a parameter pytree onto the mesh per the rule table."""
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), params, param_shardings(params, mesh)
+    )
+
+
+# Params at or above this size (bytes, assuming 4 B/element — specs see only
+# shapes, not dtypes) get a diagnosis line when a dim sheds its entire axis
+# group; smaller ones replicate silently (cheap and usually deliberate).
+# Scoped to *params* (``param_spec_for_path``): for activation constraints
+# the same drop means the constraint is skipped to preserve layout freedom
+# (``constrain_activation``'s no-op path), not that anything replicates.
+_REPLICATE_WARN_BYTES = 8 << 20
+
+
+@functools.lru_cache(maxsize=None)
+def _warn_dropped_axis_group(path, shape, dim, names, group) -> None:
+    """Warn ONCE per (param, shape, axes) signature: the divisibility fit
+    silently drops *every* axis of the group, so a large param replicates —
+    up to ``group``× the memory and none of the sharding the rule table
+    intended. Same warn-once contract as
+    ``models/transformer.py::_warn_indivisible_experts``."""
+    from trlx_tpu.utils import logging
+
+    logging.get_logger(__name__).warning(
+        "param %s of shape %s (>= %d MiB assuming 4 B/elem): the %d-sized dim "
+        "is divisible by no prefix of mesh axes %s (combined size %d) — the "
+        "dim replicates instead of sharding; resize the dim or the mesh axes "
+        "to recover it",
+        path, shape, _REPLICATE_WARN_BYTES >> 20, dim, names, group,
     )
 
 

@@ -4,6 +4,11 @@ Reference: ``trlx/pipeline/__init__.py:9-97``. Instead of torch DataLoaders,
 ``create_loader`` returns a lightweight host-side ``BatchLoader`` producing
 numpy batches (collated to fixed shapes) — the host→device boundary is the
 trainer's jitted step, which donates the arrays to the mesh.
+
+Concurrency helpers live alongside the registry: :class:`PrefetchLoader`
+(background-thread batch collation) here, and the bounded rollout chunk
+pipeline in :mod:`trlx_tpu.pipeline.rollout_pipeline` (device generation
+overlapping host reward scoring — docs/PERFORMANCE.md).
 """
 
 import random
@@ -144,7 +149,13 @@ class PrefetchLoader:
                 q.get_nowait()  # unblock a put in flight
             except queue.Empty:
                 pass
-            t.join(timeout=5)
+            try:
+                t.join(timeout=5)
+            except Exception:
+                # interpreter shutdown: an infinite prompt iterator holding
+                # this loader is GC'd after threading's teardown — the daemon
+                # worker is already dead, the join just can't say so
+                pass
 
 
 class BasePipeline:

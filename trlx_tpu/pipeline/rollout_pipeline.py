@@ -1,0 +1,271 @@
+"""Bounded-depth software pipeline for experience collection.
+
+PPO rollout collection is the wall-clock hot loop (SURVEY §3.2), and the
+per-chunk schedule is inherently two-sided: *device* work (KV-cache
+generation, the scoring forward) that the main thread dispatches, and *host*
+work (string decode, ``reward_fn``, device→host fetches) that needs nothing
+from the device beyond the landed arrays. Serially, the device idles while
+the host scores chunk *k*; pipelined, the main thread dispatches chunk
+*k+1*'s generation while a background worker drains chunk *k*'s host work.
+Within one ``make_experience`` call the policy params never change, so the
+overlap is exactly equivalent to the serial schedule, not approximate
+(OPPO, arxiv 2509.25762; PipelineRL, arxiv 2509.19128).
+
+:class:`RolloutPipeline` is the chunk state machine behind that overlap:
+
+- **one** worker thread executes submitted ``work()`` closures FIFO, so
+  completion order equals submission order by construction;
+- a chunk is *in flight* from ``submit()`` until its ``finalize`` callback
+  returns; ``submit()`` blocks while ``depth`` chunks are in flight
+  (bounded memory: at most ``depth`` chunks of host arrays coexist);
+- ``finalize(result)`` runs on the **submitting** thread, in submission
+  order — the home for sequential dependencies (PPO's running-moments
+  update) that must see chunks in the same order as the serial path;
+- worker exceptions propagate to the submitting thread on the next
+  ``submit()``/``drain()`` (original traceback preserved), after which the
+  pipeline cancels remaining work and joins the worker — no leaked threads,
+  no silently dropped chunks;
+- overlap accounting: ``host_work_s`` (time inside ``work()`` calls) and
+  ``wait_s`` (time the submitting thread blocked on the pipeline) feed
+  ``throughput/rollout_overlap_frac`` = host work hidden behind device work
+  ÷ total rollout time (see docs/PERFORMANCE.md).
+
+Chunk states: SUBMITTED → RUNNING → DONE → FINALIZED (or CANCELLED after an
+error). The single worker + FIFO queues make the machine simple enough to
+be obviously deterministic; depth only bounds *concurrency*, never order.
+"""
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+__all__ = ["RolloutPipeline", "PipelineStats"]
+
+_END = object()  # worker shutdown sentinel
+
+
+@dataclass
+class PipelineStats:
+    """Aggregate timing of one pipeline lifetime (all fields in seconds)."""
+
+    depth: int = 0
+    chunks: int = 0
+    # total time inside work() on the worker thread
+    host_work_s: float = 0.0
+    # time the submitting thread spent blocked waiting for the worker
+    # (submit backpressure + drain) — host work NOT hidden behind device work
+    wait_s: float = 0.0
+    # per-chunk host-work durations, submission order
+    chunk_host_s: List[float] = field(default_factory=list)
+
+    @property
+    def overlap_s(self) -> float:
+        """Host work genuinely hidden behind the submitting thread's device
+        work: everything the worker did minus what the submitter waited for."""
+        return max(0.0, self.host_work_s - self.wait_s)
+
+    def overlap_frac(self, total_s: float) -> float:
+        """``overlap_s`` as a fraction of a caller-supplied total rollout
+        wall time (the ``throughput/rollout_overlap_frac`` gauge)."""
+        if total_s <= 0.0:
+            return 0.0
+        return min(1.0, self.overlap_s / total_s)
+
+
+class _Chunk:
+    __slots__ = ("index", "work", "result", "error")
+
+    def __init__(self, index: int, work: Callable[[], Any]):
+        self.index = index
+        self.work = work
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+
+class RolloutPipeline:
+    """Single-worker, bounded in-flight chunk pipeline with ordered drain.
+
+    Usage::
+
+        pipe = RolloutPipeline(depth=2, finalize=fold_into_store,
+                               tracer=obs.tracer)
+        with pipe:
+            while more_chunks:
+                dev = dispatch_device_work()          # main thread
+                pipe.submit(lambda d=dev: host_work(d))  # worker thread
+        # __exit__ drains: every finalize has run, worker joined
+
+    ``finalize`` is optional; without it ``submit``/``drain`` simply retire
+    completed chunks. ``tracer`` (a :class:`trlx_tpu.observability.Tracer`)
+    is optional; with it, the time the submitting thread blocks on the
+    pipeline is recorded as ``rollout/device_idle`` spans — the device-idle
+    accounting visible in the Perfetto export.
+    """
+
+    def __init__(
+        self,
+        depth: int = 2,
+        finalize: Optional[Callable[[Any], Any]] = None,
+        name: str = "rollout",
+        tracer: Any = None,
+    ):
+        if depth < 1:
+            raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+        self.depth = depth
+        self.name = name
+        self.stats = PipelineStats(depth=depth)
+        self._finalize = finalize
+        self._tracer = tracer
+        self._todo: "queue.Queue" = queue.Queue()
+        self._done: "queue.Queue" = queue.Queue()
+        self._cancel = threading.Event()
+        self._in_flight = 0
+        self._submitted = 0
+        self._finalized = 0
+        self._failed: Optional[_Chunk] = None
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._worker_loop, name=f"trlx-{name}-pipeline", daemon=True
+        )
+        self._worker.start()
+
+    # -- worker side ----------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        if self._tracer is not None and hasattr(self._tracer, "alias_current_thread"):
+            # one stable named track per role across pipeline incarnations
+            # (a fresh worker thread per make_experience call would otherwise
+            # scatter the trace over one near-empty row per collection cycle)
+            self._tracer.alias_current_thread(f"{self.name} pipeline worker")
+        while True:
+            chunk = self._todo.get()
+            if chunk is _END:
+                return
+            if self._cancel.is_set():
+                # an earlier chunk failed (or the consumer bailed): retire
+                # without executing so a blocked submit/drain still wakes
+                chunk.error = _Cancelled()
+                self._done.put(chunk)
+                continue
+            t0 = time.perf_counter()
+            try:
+                chunk.result = chunk.work()
+            except BaseException as e:  # propagated to the submitting thread
+                chunk.error = e
+                self._cancel.set()
+            finally:
+                dt = time.perf_counter() - t0
+                self.stats.host_work_s += dt
+                self.stats.chunk_host_s.append(dt)
+            self._done.put(chunk)
+
+    # -- submitting-thread side -----------------------------------------
+
+    def _retire_one(self, block: bool) -> bool:
+        """Finalize the next completed chunk (submission order == completion
+        order: one FIFO worker). Returns False when nothing was retired."""
+        try:
+            if block:
+                t0 = time.perf_counter()
+                if self._tracer is not None:
+                    with self._tracer.span(f"{self.name}/device_idle"):
+                        chunk = self._done.get()
+                else:
+                    chunk = self._done.get()
+                self.stats.wait_s += time.perf_counter() - t0
+            else:
+                chunk = self._done.get_nowait()
+        except queue.Empty:
+            return False
+        self._in_flight -= 1
+        if chunk.error is not None:
+            if not isinstance(chunk.error, _Cancelled):
+                self._failed = self._failed or chunk
+            return True
+        # gate on _failed only (NOT the async _cancel flag): chunks that
+        # completed before the failure point retire in order ahead of the
+        # failed chunk (FIFO worker), and must finalize deterministically —
+        # racing on _cancel would drop a completed prefix chunk or not
+        # depending on when the worker flips the flag
+        if self._failed is None:
+            try:
+                if self._finalize is not None:
+                    self._finalize(chunk.result)
+                self._finalized += 1
+                self.stats.chunks += 1
+            except BaseException:
+                self._cancel.set()
+                raise
+        return True
+
+    def _raise_failed(self) -> None:
+        if self._failed is not None:
+            err = self._failed.error
+            self._failed = None
+            self.close()
+            raise err
+
+    def submit(self, work: Callable[[], Any]) -> None:
+        """Enqueue one chunk's host work; blocks (finalizing completed chunks
+        in order) while ``depth`` chunks are already in flight. Raises a prior
+        chunk's worker/finalize exception instead of accepting new work."""
+        if self._closed:
+            raise RuntimeError("submit() on a closed RolloutPipeline")
+        # retire everything already completed (keeps the caller's view of
+        # finalized results fresh), then block down below the depth bound
+        while self._retire_one(block=False):
+            pass
+        while self._in_flight >= self.depth:
+            self._retire_one(block=True)
+        self._raise_failed()
+        self._in_flight += 1
+        self._submitted += 1
+        self._todo.put(_Chunk(self._submitted - 1, work))
+
+    def drain(self) -> None:
+        """Block until every submitted chunk is finalized (or a failure is
+        raised). Safe to call repeatedly; ``__exit__`` calls it on success."""
+        while self._in_flight > 0:
+            self._retire_one(block=True)
+        self._raise_failed()
+
+    @property
+    def in_flight(self) -> int:
+        return self._in_flight
+
+    def close(self) -> None:
+        """Cancel outstanding work and join the worker. Idempotent; never
+        raises. Pending un-finalized chunks are dropped, not finalized."""
+        if self._closed:
+            return
+        self._closed = True
+        self._cancel.set()
+        self._todo.put(_END)
+        self._worker.join(timeout=30)
+        # drop whatever completed after cancellation without finalizing
+        while True:
+            try:
+                self._done.get_nowait()
+                self._in_flight -= 1
+            except queue.Empty:
+                break
+
+    def __enter__(self) -> "RolloutPipeline":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            try:
+                self.drain()
+            finally:
+                self.close()
+        else:
+            # the submitting thread failed: don't run more finalizes under an
+            # exception — cancel, join, and let the original error propagate
+            self.close()
+
+
+class _Cancelled(Exception):
+    """Internal marker: chunk retired un-run after an earlier failure."""

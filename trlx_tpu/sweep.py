@@ -68,6 +68,7 @@ standard pod setup; Ray ships results through its object store instead).
 """
 
 import argparse
+import functools
 import importlib.util
 import itertools
 import json
@@ -329,9 +330,47 @@ def _next_coordinator_port() -> int:
 # the launcher template's placeholder names — substituted by literal token
 # match (NOT str.format, whose index/attr/format-spec parsing corrupts shell
 # constructs like ${arr[0]}, ${VAR:-default} or awk {print})
-_LAUNCHER_TOKENS = re.compile(
-    r"\{(python|script|hparams|hparams_remote|host|env|env_remote)\}"
+_PLACEHOLDERS = (
+    "python", "script", "hparams", "hparams_remote", "host", "env", "env_remote"
 )
+_LAUNCHER_TOKENS = re.compile(r"\{(%s)\}" % "|".join(_PLACEHOLDERS))
+
+# {token}-shaped survivors of substitution, for the typo check below; `$`
+# lookbehind keeps shell ${VAR} expansions out, and the bare-word shape keeps
+# awk '{print $1}' and friends out
+_BRACE_TOKEN = re.compile(r"(?<!\$)\{([A-Za-z_][A-Za-z0-9_]*)\}")
+
+
+@functools.lru_cache(maxsize=None)
+def _warn_placeholder_near_misses(launcher: str) -> None:
+    """A typo'd placeholder is not an error to the template engine — only the
+    exact tokens substitute, so ``{pyhton}``, ``{hparam}``, or ``{HOST}``
+    ride into the shell verbatim and the trial fails (or silently misruns)
+    far from the typo. Scans the *template with the known tokens stripped*
+    (never the substituted values — an hparam whose text contains
+    ``{host}`` is the user's business) and warns for any surviving
+    ``{token}`` that is case-insensitively equal or close (difflib ≥ 0.8) to
+    a known placeholder; genuine shell/awk braces don't resemble one and
+    stay silent. ``lru_cache``: the template is fixed for a sweep's
+    lifetime, so the diagnosis prints once, not once per trial."""
+    import difflib
+
+    known = sorted(_PLACEHOLDERS)
+    for token in _BRACE_TOKEN.findall(_LAUNCHER_TOKENS.sub("", launcher)):
+        lowered = token.lower()
+        if lowered in known:
+            hint = lowered  # wrong case — {PYTHON} is not {python}
+        else:
+            close = difflib.get_close_matches(lowered, known, n=1, cutoff=0.8)
+            if not close:
+                continue
+            hint = close[0]
+        logger.warning(
+            "launcher template: '{%s}' survived substitution but looks like "
+            "the placeholder '{%s}' — it will reach the shell verbatim; "
+            "known placeholders: %s",
+            token, hint, ", ".join("{%s}" % k for k in known),
+        )
 
 
 def _trial_command(
@@ -367,6 +406,13 @@ def _trial_command(
     caller passed via ``extra_env`` (``extra_keys``) — a user-supplied
     ``WANDB_API_KEY`` or ``XLA_FLAGS`` must reach remote trials exactly
     like local no-launcher ones.
+
+    Pass-through is also where typos hide: a ``{token}`` that *almost* names
+    a placeholder (``{pyhton}``, ``{hparam}``, ``{HOST}``) survives
+    substitution and reaches the shell verbatim, so the template is scanned
+    and near-misses are warned about (genuine shell/awk braces and brace
+    text inside substituted *values* stay silent — see
+    :func:`_warn_placeholder_near_misses`).
     """
     if launcher is None:
         return [sys.executable, os.path.abspath(script), json.dumps(hparams)]
@@ -391,6 +437,7 @@ def _trial_command(
         "env": env_pairs(shlex.quote),
         "env_remote": env_pairs(lambda v: shlex.quote(shlex.quote(v))),
     }
+    _warn_placeholder_near_misses(launcher)
     return _LAUNCHER_TOKENS.sub(lambda m: values[m.group(1)], launcher)
 
 

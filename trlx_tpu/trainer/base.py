@@ -469,16 +469,26 @@ class TPUBaseTrainer(BaseRLTrainer):
         self._last_batch_host = None
         self._last_batch_sharded = None
 
-    def _maybe_prefetch(self, loader):
-        """Wrap the training loader in background-thread prefetch
-        (``train.prefetch_batches`` deep) so collation overlaps the device
-        step — the reference's DataLoader-worker capability."""
-        depth = getattr(self.config.train, "prefetch_batches", 0)
-        if depth and loader is not None:
+    def _maybe_prefetch(self, loader, depth: Optional[int] = None):
+        """Wrap a loader in background-thread prefetch (``depth`` batches
+        ahead, default ``train.prefetch_batches``) so collation overlaps the
+        device step — the reference's DataLoader-worker capability."""
+        if depth is None:
+            depth = getattr(self.config.train, "prefetch_batches", 0)
+        if depth and depth > 0 and loader is not None:
             from trlx_tpu.pipeline import PrefetchLoader
 
             return PrefetchLoader(loader, depth)
         return loader
+
+    def _maybe_prefetch_prompts(self, loader):
+        """Prompt-side seam of :meth:`_maybe_prefetch`, gated on the rollout
+        pipeline depth (``train.rollout_pipeline_depth``): prompt collation
+        runs ahead on a background thread so ``next(prompt_iterator)`` never
+        stalls the chunk dispatch loop in ``make_experience``. One worker
+        preserves batch order, so rollout determinism is unaffected."""
+        depth = int(getattr(self.config.train, "rollout_pipeline_depth", 0) or 0)
+        return self._maybe_prefetch(loader, depth)
 
     def _batch_token_count(self, batch: Any) -> int:
         """Real (unpadded) tokens this batch feeds the step — from the batch

@@ -75,7 +75,9 @@ class GRPOTrainer(PPOTrainer):
             shuffle=True,
             seed=self.config.train.seed,
         )
-        self.prompt_iterator = infinite_loader(loader)
+        # same prompt-prefetch seam as PPO (GRPO's make_experience is still
+        # serial — prefetch only overlaps collation, not reward scoring)
+        self.prompt_iterator = infinite_loader(self._maybe_prefetch_prompts(loader))
 
     # scoring reuses PPOTrainer._get_score_fn, which adapts to the head-less
     # policy (no value output, branch params bound at the tree root)

@@ -43,45 +43,100 @@ from trlx_tpu.utils.stats import logprobs_of_labels  # noqa: F401 (parity surfac
 logger = logging.get_logger(__name__)
 
 
+# samples per pipelined tokenization chunk: large enough that the worker's
+# per-chunk overhead is noise, small enough that index-building overlaps a
+# meaningful fraction of the tokenization tail
+_TOKENIZE_CHUNK = 64
+
+
+def _fold_tokenized(
+    samples: List[Union[str, List[str]]],
+    tokenizer: Optional[Tokenizer],
+    max_length: int,
+    pipeline_depth: int,
+    fold,
+    chunk_size: int = _TOKENIZE_CHUNK,
+) -> None:
+    """Feed ``fold`` tokenized sample chunks in order.
+
+    With ``pipeline_depth`` > 0 and a tokenizer, chunks tokenize on a
+    :class:`~trlx_tpu.pipeline.rollout_pipeline.RolloutPipeline` worker while
+    ``fold`` (the per-sample index/reward shaping) drains earlier chunks on
+    the calling thread — the offline twin of the PPO generation/reward
+    overlap. One worker + ordered drain ⇒ output identical to the serial
+    path, element for element."""
+    if tokenizer is None:
+        fold(list(samples))  # already tokenized
+        return
+    # `> 0` (not truthiness): any non-positive depth means serial, matching
+    # PPO's gate — a -1 "disable" value must not reach RolloutPipeline
+    if pipeline_depth > 0 and len(samples) > chunk_size:
+        from trlx_tpu.pipeline.rollout_pipeline import RolloutPipeline
+
+        with RolloutPipeline(
+            depth=pipeline_depth, finalize=fold, name="ilql_tokenize"
+        ) as pipe:
+            for start in range(0, len(samples), chunk_size):
+                part = samples[start : start + chunk_size]
+                pipe.submit(
+                    lambda part=part: [
+                        tokenize_dialogue(s, tokenizer, max_length) for s in part
+                    ]
+                )
+        return
+    fold([tokenize_dialogue(s, tokenizer, max_length) for s in samples])
+
+
+def _causal_sample_arrays(sample) -> tuple:
+    """Per-sample causal index math: (input_ids, actions_ixs, states_ixs,
+    dones) — shared by the serial and pipelined paths of
+    :func:`make_experience`."""
+    length = 0
+    input_ids = np.array([t for m in sample for t in m.tokens], dtype=np.int32)
+    actions_ixs = []
+    for dm in sample:
+        if dm.is_output:
+            # actions index into the *shifted* sequence: the action chosen
+            # at state t is the token emitted at position t+1
+            actions_ixs.append(
+                np.arange(length - 1, length + len(dm.tokens) - 1, dtype=np.int32)
+            )
+        length += len(dm.tokens)
+    ixs = np.concatenate(actions_ixs) if actions_ixs else np.zeros(0, np.int32)
+    states_ixs = np.concatenate([ixs, np.array([length - 1], np.int32)])
+    dones = np.array([1] * (len(states_ixs) - 1) + [0], dtype=np.int32)
+    return input_ids, ixs, states_ixs, dones
+
+
 def make_experience(
     samples: List[Union[str, List[str]]],
     rewards: List[float],
     tokenizer: Optional[Tokenizer] = None,
     max_length: int = 2048,
     verbose: bool = True,
+    pipeline_depth: int = 0,
 ) -> ILQLRolloutStorage:
     """Tokenize samples and shape rewards into an :class:`ILQLRolloutStorage`
-    (reference ``accelerate_ilql_trainer.py:30-99``)."""
+    (reference ``accelerate_ilql_trainer.py:30-99``). ``pipeline_depth`` > 0
+    overlaps chunked tokenization (background worker) with the per-sample
+    index building here — the result is identical to the serial path."""
     if verbose:
         logger.info("Collecting rollouts")
-    if tokenizer is not None:
-        samples = [tokenize_dialogue(s, tokenizer, max_length) for s in samples]
 
     all_input_ids = []
     all_actions_ixs = []
     all_states_ixs = []
     all_dones = []
-    for sample in samples:
-        length = 0
-        all_input_ids.append(
-            np.array([t for m in sample for t in m.tokens], dtype=np.int32)
-        )
-        actions_ixs = []
-        for dm in sample:
-            if dm.is_output:
-                # actions index into the *shifted* sequence: the action chosen
-                # at state t is the token emitted at position t+1
-                actions_ixs.append(
-                    np.arange(length - 1, length + len(dm.tokens) - 1, dtype=np.int32)
-                )
-            length += len(dm.tokens)
-        ixs = np.concatenate(actions_ixs) if actions_ixs else np.zeros(0, np.int32)
-        states_ixs = np.concatenate([ixs, np.array([length - 1], np.int32)])
-        all_dones.append(
-            np.array([1] * (len(states_ixs) - 1) + [0], dtype=np.int32)
-        )
-        all_actions_ixs.append(ixs)
-        all_states_ixs.append(states_ixs)
+
+    def fold(chunk):
+        for sample in chunk:
+            input_ids, ixs, states_ixs, dones = _causal_sample_arrays(sample)
+            all_input_ids.append(input_ids)
+            all_actions_ixs.append(ixs)
+            all_states_ixs.append(states_ixs)
+            all_dones.append(dones)
+
+    _fold_tokenized(samples, tokenizer, max_length, pipeline_depth, fold)
 
     sample_lengths = np.array(list(map(len, all_input_ids)))
     output_lengths = np.array(list(map(len, all_actions_ixs)))
@@ -123,32 +178,38 @@ def make_experience_seq2seq(
     tokenizer: Optional[Tokenizer] = None,
     max_length: int = 2048,
     verbose: bool = True,
+    pipeline_depth: int = 0,
 ) -> ILQLSeq2SeqRolloutStorage:
     """Seq2seq variant: the prompt feeds the encoder, the output becomes the
     decoder sequence with actions/states indexed over decoder positions
     (reference ``make_experience_seq2seq``,
-    ``accelerate_ilql_trainer.py:175-240``)."""
+    ``accelerate_ilql_trainer.py:175-240``). ``pipeline_depth`` as in
+    :func:`make_experience`."""
     if verbose:
         logger.info("Collecting rollouts")
-    if tokenizer is not None:
-        samples = [tokenize_dialogue(s, tokenizer, max_length) for s in samples]
 
     all_input_ids = []
     all_output_ids = []
     all_actions_ixs = []
     all_states_ixs = []
     all_dones = []
-    for sample in samples:
-        prompt_tokens = [t for m in sample if not m.is_output for t in m.tokens]
-        output_tokens = [t for m in sample if m.is_output for t in m.tokens]
-        all_input_ids.append(np.asarray(prompt_tokens, np.int32))
-        all_output_ids.append(np.asarray(output_tokens, np.int32))
-        length = len(output_tokens)
-        actions_ixs = np.arange(0, max(length - 1, 0), dtype=np.int32)
-        states_ixs = np.concatenate([actions_ixs, np.array([max(length - 1, 0)], np.int32)])
-        all_dones.append(np.array([1] * (len(states_ixs) - 1) + [0], np.int32))
-        all_actions_ixs.append(actions_ixs)
-        all_states_ixs.append(states_ixs)
+
+    def fold(chunk):
+        for sample in chunk:
+            prompt_tokens = [t for m in sample if not m.is_output for t in m.tokens]
+            output_tokens = [t for m in sample if m.is_output for t in m.tokens]
+            all_input_ids.append(np.asarray(prompt_tokens, np.int32))
+            all_output_ids.append(np.asarray(output_tokens, np.int32))
+            length = len(output_tokens)
+            actions_ixs = np.arange(0, max(length - 1, 0), dtype=np.int32)
+            states_ixs = np.concatenate(
+                [actions_ixs, np.array([max(length - 1, 0)], np.int32)]
+            )
+            all_dones.append(np.array([1] * (len(states_ixs) - 1) + [0], np.int32))
+            all_actions_ixs.append(actions_ixs)
+            all_states_ixs.append(states_ixs)
+
+    _fold_tokenized(samples, tokenizer, max_length, pipeline_depth, fold)
 
     returns = np.asarray(rewards, dtype=np.float64)
     returns = returns - returns.mean()
@@ -189,13 +250,18 @@ class ILQLTrainer(TPUBaseTrainer):
     def make_experience(
         self, samples, rewards, max_length: int = 2048
     ) -> None:
+        # the rollout pipeline knob gates the offline overlap too: chunked
+        # tokenization on a background worker, index building in the drain
+        depth = int(getattr(self.config.train, "rollout_pipeline_depth", 0) or 0)
         if self.is_seq2seq:
             self.store = make_experience_seq2seq(
-                samples, rewards, self.tokenizer, max_length=max_length
+                samples, rewards, self.tokenizer, max_length=max_length,
+                pipeline_depth=depth,
             )
         else:
             self.store = make_experience(
-                samples, rewards, self.tokenizer, max_length=max_length
+                samples, rewards, self.tokenizer, max_length=max_length,
+                pipeline_depth=depth,
             )
 
     # ------------------------------------------------------------------

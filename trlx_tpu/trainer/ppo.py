@@ -121,7 +121,9 @@ class PPOTrainer(TPUBaseTrainer):
         loader = pipeline.create_loader(
             self.config.method.chunk_size, shuffle=True, seed=self.config.train.seed
         )
-        self.prompt_iterator = infinite_loader(loader)
+        # prompt collation prefetches on a background thread when the rollout
+        # pipeline is on, so chunk dispatch never stalls on next(...)
+        self.prompt_iterator = infinite_loader(self._maybe_prefetch_prompts(loader))
 
     def _extra_checkpoint_state(self) -> Dict[str, Any]:
         return {
@@ -160,8 +162,9 @@ class PPOTrainer(TPUBaseTrainer):
         Deliberately score-free: it is dispatched the moment generation
         finishes and its outputs copy to host asynchronously, so the device
         scoring forward + transfer genuinely overlap the host-side string
-        decode and ``reward_fn``; the KL-penalty reward assembly then runs
-        on host (:func:`trlx_tpu.models.ppo.kl_penalty_rewards_np`)."""
+        decode and ``reward_fn`` (and, with ``rollout_pipeline_depth`` > 0,
+        the next chunk's generation); the KL-penalty reward assembly then
+        runs on host (:func:`trlx_tpu.models.ppo.kl_penalty_rewards_np`)."""
         if batch_shape in self._score_fns:
             return self._score_fns[batch_shape]
 
@@ -274,134 +277,278 @@ class PPOTrainer(TPUBaseTrainer):
         self._score_fns[batch_shape] = fn
         return fn
 
-    def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:  # noqa: C901
+    # The per-chunk rollout work splits into three stages with distinct
+    # concurrency homes (docs/PERFORMANCE.md):
+    #
+    #   device   — main thread: prompt fetch, jitted generation, scoring-
+    #              forward dispatch + async device→host copies;
+    #   host     — worker thread when train.rollout_pipeline_depth > 0:
+    #              string decode, reward_fn, landing the device arrays.
+    #              Pure w.r.t. its inputs (no trainer state mutation);
+    #   finalize — main thread, strictly in submission order: running-
+    #              moments update (the one sequential dependency — reward
+    #              scaling must fold chunks in order), KL-penalty assembly,
+    #              PPORLElement construction.
+    #
+    # Within one make_experience call the params never change, so running
+    # chunk k+1's generation while chunk k's host work drains is *exactly*
+    # equivalent to the serial schedule: the store is bit-identical under a
+    # fixed seed (tests/test_rollout_pipeline.py pins this).
+
+    def _rollout_chunk_device(self, stats: Dict[str, float]) -> Dict[str, Any]:
+        """Main-thread device side of one chunk: prompt fetch, generation,
+        and the scoring-forward dispatch with async device→host copies."""
+        batch = next(self.prompt_iterator)
+        prompt_ids = np.asarray(batch["input_ids"], np.int32)
+        prompt_mask = np.asarray(batch["attention_mask"], np.int32)
+
+        gen_time = time()
+        # generate() opens its own fenced "generate" span, nested under the
+        # caller's "rollout" span in the Chrome/Perfetto export
+        gen_out = self.generate(prompt_ids, prompt_mask)
+        stats["time/exp_generate"] = time() - gen_time
+        stats["time/generate"] = self.last_generate_time
+        stats.update(self.last_spec_stats)
+
+        # dispatch the scoring forward immediately on the generation's
+        # device arrays — it needs nothing from the host, so it runs while
+        # the host stage decodes strings and calls reward_fn
+        B, P = prompt_ids.shape
+        N = int(gen_out.response_tokens.shape[1])
+        score_fn = self._get_score_fn((B, P, N))
+        score_out = score_fn(
+            self.state.params,
+            self.ref_params,
+            gen_out.sequences,
+            shard_batch({"prompt_mask": prompt_mask}, self.mesh)["prompt_mask"],
+            gen_out.response_tokens,
+            gen_out.response_mask,
+        )
+        self.obs.recompile.observe("score", score_fn)
+        # start the device→host copies of the scoring outputs without
+        # blocking: by the time the host stage asks for these arrays they
+        # have usually landed
+        for leaf in jax.tree_util.tree_leaves(score_out):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        return {
+            "prompt_ids": prompt_ids,
+            "prompt_mask": prompt_mask,
+            "gen_out": gen_out,
+            "score_out": score_out,
+        }
+
+    def _rollout_chunk_host(self, dev: Dict[str, Any]) -> Dict[str, Any]:
+        """Host side of one chunk (pipeline worker when depth > 0): fetch the
+        generation outputs, decode strings, run ``reward_fn``, land the
+        scoring outputs. The "score" span covers execution → host landing of
+        the scoring forward: it deliberately stays open across the
+        interleaved decode/reward work, so the recorded time includes the
+        overlap window rather than serializing it."""
+        host_t0 = time()
+        # named `stats` so scripts/check_metric_names.py lints these keys too
+        stats: Dict[str, float] = {}
+        with ExitStack() as score_ctx:
+            # ExitStack (not a plain `with`) mirrors the historical shape:
+            # the span must close even if decode/reward raises mid-overlap
+            score_sp = score_ctx.enter_context(self.obs.span("score"))
+            host_gen = to_host(
+                {
+                    "response_tokens": dev["gen_out"].response_tokens,
+                    "response_mask": dev["gen_out"].response_mask,
+                }
+            )
+            response_tokens = np.asarray(host_gen["response_tokens"])
+            response_mask = np.asarray(host_gen["response_mask"])
+
+            samples, prompts, outputs = self.decode(
+                dev["prompt_ids"], response_tokens, append_eos_token=True
+            )
+            with self.obs.span("reward") as reward_sp:
+                scores = np.asarray(
+                    self.reward_fn(samples=samples, prompts=prompts, outputs=outputs),
+                    dtype=np.float32,
+                )
+            stats["time/reward"] = reward_sp.duration
+            stats["time/exp_score"] = reward_sp.duration
+            host = to_host(dev["score_out"])  # usually landed already (async copy)
+        stats["time/score"] = score_sp.duration
+        return {
+            "prompt_ids": dev["prompt_ids"],
+            "prompt_mask": dev["prompt_mask"],
+            "response_tokens": response_tokens,
+            "response_mask": response_mask,
+            "scores": scores,
+            "host": host,
+            "stats": stats,
+            "host_s": time() - host_t0,
+        }
+
+    def _rollout_chunk_finalize(
+        self,
+        chunk: Dict[str, Any],
+        elements: list,
+        stats: Dict[str, float],
+        acc: Dict[str, float],
+    ) -> None:
+        """Ordered tail of one chunk — the sequential dependencies. Runs on
+        the main thread in submission order in BOTH modes, so reward scaling
+        (running moments) and the store contents are bit-identical between
+        depth 0 and depth ≥ 1."""
+        stats.update(chunk["stats"])
+        acc["host_s"] += chunk["host_s"]
+        scores = chunk["scores"]
+        response_mask = chunk["response_mask"]
+        response_tokens = chunk["response_tokens"]
+        host = chunk["host"]
+
+        # reward scaling/clipping (reference :350-366)
+        scores_mean, scores_std = self.running_moments.update(scores)
+        stats["exp_scores/mean"] = float(scores_mean)
+        stats["exp_scores/std"] = float(scores_std)
+        stats["exp_scores/running_mean"] = float(self.running_moments.mean)
+        stats["exp_scores/running_std"] = float(self.running_moments.std)
+        if self.config.method.scale_reward == "running":
+            scores /= max(self.running_moments.std, 1e-8)
+        elif self.config.method.scale_reward == "ref":
+            scores /= max(self.ref_std or 1.0, 1e-8)
+        clip = self.config.method.cliprange_reward
+        if clip:
+            scores = np.clip(scores, -clip, clip)
+
+        # KL-penalty reward assembly on host (numpy twin of the device
+        # math; [B, N] arrays — microseconds)
+        rewards, (mean_kl, mean_kl_per_seq) = kl_penalty_rewards_np(
+            host["logprobs"], host["ref_logprobs"], response_mask,
+            scores, self.kl_ctl.value,
+        )
+        acc["kl_sum"] += mean_kl
+        acc["kl_batches"] += 1
+        acc["gen_tokens"] += int(response_mask.sum())
+        acc["chunks"] += 1
+        stats["policy/sqrt_kl"] = float(np.sqrt(max(mean_kl, 0.0)))
+
+        prompt_ids, prompt_mask = chunk["prompt_ids"], chunk["prompt_mask"]
+        for i in range(prompt_ids.shape[0]):
+            n_i = int(response_mask[i].sum())
+            if n_i == 0:
+                continue
+            query = prompt_ids[i][prompt_mask[i] > 0]
+            elements.append(
+                PPORLElement(
+                    query_tensor=query,
+                    response_tensor=response_tokens[i, :n_i],
+                    logprobs=np.asarray(host["logprobs"][i, :n_i]),
+                    values=np.asarray(host["values"][i, :n_i]),
+                    rewards=rewards[i, :n_i],
+                )
+            )
+
+    def _collect_serial(
+        self, num_rollouts: int, elements: list, stats: Dict[str, float],
+        acc: Dict[str, float],
+    ) -> None:
+        """Depth-0 reference implementation: each chunk runs device → host →
+        finalize strictly in sequence. Kept verbatim as the equivalence
+        baseline the pipelined path is tested against."""
+        while len(elements) < num_rollouts:
+            # the span feeds the trace; the time/rollout *stat* is computed
+            # uniformly for both modes in make_experience (wall ÷ chunks)
+            with self.obs.span("rollout"):
+                dev = self._rollout_chunk_device(stats)
+                chunk = self._rollout_chunk_host(dev)
+            self._rollout_chunk_finalize(chunk, elements, stats, acc)
+        stats["throughput/rollout_overlap_frac"] = 0.0
+
+    def _collect_pipelined(
+        self, num_rollouts: int, depth: int, elements: list,
+        stats: Dict[str, float], acc: Dict[str, float],
+    ) -> None:
+        """Software-pipelined collection: the main thread keeps the device
+        busy (chunk k+1's generation dispatches as soon as chunk k's lands)
+        while up to ``depth`` chunks of host work drain on the pipeline
+        worker. Finalization happens on this thread in submission order —
+        see the stage map above for why the result is bit-identical."""
+        from collections import deque
+
+        from trlx_tpu.pipeline.rollout_pipeline import RolloutPipeline
+
+        # upper-bound row count of each in-flight chunk, submission order
+        rows_in_flight: deque = deque()
+
+        def finalize(chunk: Dict[str, Any]) -> None:
+            rows_in_flight.popleft()
+            self._rollout_chunk_finalize(chunk, elements, stats, acc)
+
+        t0 = time()
+        with RolloutPipeline(
+            depth=depth, finalize=finalize, name="rollout", tracer=self.obs.tracer
+        ) as pipe:
+            while True:
+                # submit while even full chunks cannot cover the target; when
+                # the in-flight upper bound says "maybe enough", drain and
+                # re-check with exact counts (rows with empty responses are
+                # dropped at finalize). The set of chunks processed is
+                # therefore exactly the serial loop's.
+                if len(elements) + sum(rows_in_flight) >= num_rollouts:
+                    pipe.drain()
+                    if len(elements) >= num_rollouts:
+                        break
+                    continue
+                # the "rollout" span covers the device side only here; the
+                # host side shows up as "rollout/overlap" on the worker tid
+                with self.obs.span("rollout", pipelined=True) as rollout_sp:
+                    dev = self._rollout_chunk_device(stats)
+                stats["time/rollout_device"] = rollout_sp.duration
+                rows_in_flight.append(int(dev["prompt_ids"].shape[0]))
+
+                def work(dev=dev):
+                    # fenced: the span closes only once the scoring outputs
+                    # are device-complete, so its duration is host-true
+                    with self.obs.span("rollout/overlap") as sp:
+                        sp.fence(dev["score_out"])
+                        return self._rollout_chunk_host(dev)
+
+                pipe.submit(work)
+            pipe_stats = pipe.stats
+        stats["throughput/rollout_overlap_frac"] = pipe_stats.overlap_frac(
+            time() - t0
+        )
+
+    def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:
         """Collect ``num_rollouts`` experiences into the store (reference
-        ``accelerate_ppo_trainer.py:251-489``)."""
+        ``accelerate_ppo_trainer.py:251-489``), overlapping device generation
+        with host reward scoring when ``train.rollout_pipeline_depth`` > 0."""
         logger.info("Collecting rollouts")
         if self.prompt_iterator is None:
             raise RuntimeError("add_prompt_pipeline must be called before make_experience")
 
+        depth = int(getattr(self.config.train, "rollout_pipeline_depth", 0) or 0)
         stats: Dict[str, float] = {}
-        elements = []
-        kl_sum, kl_batches = 0.0, 0
+        elements: list = []
+        acc: Dict[str, float] = {
+            "kl_sum": 0.0, "kl_batches": 0, "host_s": 0.0,
+            "gen_tokens": 0, "chunks": 0,
+        }
         exp_time = time()
 
-        while len(elements) < num_rollouts:
-            with self.obs.span("rollout") as rollout_sp:
-                batch = next(self.prompt_iterator)
-                prompt_ids = np.asarray(batch["input_ids"], np.int32)
-                prompt_mask = np.asarray(batch["attention_mask"], np.int32)
+        if depth > 0:
+            self._collect_pipelined(num_rollouts, depth, elements, stats, acc)
+        else:
+            self._collect_serial(num_rollouts, elements, stats, acc)
 
-                gen_time = time()
-                # generate() opens its own fenced "generate" span, nested
-                # under this "rollout" span in the Chrome/Perfetto export
-                gen_out = self.generate(prompt_ids, prompt_mask)
-
-                # dispatch the scoring forward immediately on the generation's
-                # device arrays — it needs nothing from the host, so it runs
-                # while the host decodes strings and calls reward_fn below.
-                # The "score" span deliberately covers dispatch → host landing
-                # (closing at the blocking to_host below), so the recorded
-                # time includes the overlap window rather than serializing it
-                B, P = prompt_ids.shape
-                N = int(gen_out.response_tokens.shape[1])
-                score_fn = self._get_score_fn((B, P, N))
-                with ExitStack() as score_ctx:
-                    # ExitStack (not a plain `with`) because the span must
-                    # stay open across the deliberately-interleaved decode/
-                    # reward work below, yet still close if any of it raises
-                    score_sp = score_ctx.enter_context(self.obs.span("score"))
-                    score_out = score_fn(
-                        self.state.params,
-                        self.ref_params,
-                        gen_out.sequences,
-                        shard_batch({"prompt_mask": prompt_mask}, self.mesh)["prompt_mask"],
-                        gen_out.response_tokens,
-                        gen_out.response_mask,
-                    )
-                    self.obs.recompile.observe("score", score_fn)
-
-                    # start the device→host copies of the scoring outputs without
-                    # blocking, then fetch the (already finished) generation outputs;
-                    # the string decode + reward_fn below genuinely overlap the
-                    # scoring forward and its transfer
-                    for leaf in jax.tree_util.tree_leaves(score_out):
-                        if hasattr(leaf, "copy_to_host_async"):
-                            leaf.copy_to_host_async()
-                    host_gen = to_host(
-                        {
-                            "response_tokens": gen_out.response_tokens,
-                            "response_mask": gen_out.response_mask,
-                        }
-                    )
-                    response_tokens = np.asarray(host_gen["response_tokens"])
-                    response_mask = np.asarray(host_gen["response_mask"])
-                    stats["time/exp_generate"] = time() - gen_time
-                    stats["time/generate"] = self.last_generate_time
-                    stats.update(self.last_spec_stats)
-
-                    samples, prompts, outputs = self.decode(
-                        prompt_ids, response_tokens, append_eos_token=True
-                    )
-
-                    with self.obs.span("reward") as reward_sp:
-                        scores = np.asarray(
-                            self.reward_fn(samples=samples, prompts=prompts, outputs=outputs),
-                            dtype=np.float32,
-                        )
-                    stats["time/reward"] = reward_sp.duration
-                    stats["time/exp_score"] = reward_sp.duration
-                    host = to_host(score_out)  # usually landed already (async copy)
-                stats["time/score"] = score_sp.duration
-            stats["time/rollout"] = rollout_sp.duration
-            gen_tokens = int(response_mask.sum())
-            if rollout_sp.duration > 0 and gen_tokens:
-                stats["throughput/rollout_tokens_per_sec"] = (
-                    gen_tokens / rollout_sp.duration
-                )
-
-            # reward scaling/clipping (reference :350-366)
-            scores_mean, scores_std = self.running_moments.update(scores)
-            stats["exp_scores/mean"] = float(scores_mean)
-            stats["exp_scores/std"] = float(scores_std)
-            stats["exp_scores/running_mean"] = float(self.running_moments.mean)
-            stats["exp_scores/running_std"] = float(self.running_moments.std)
-            if self.config.method.scale_reward == "running":
-                scores /= max(self.running_moments.std, 1e-8)
-            elif self.config.method.scale_reward == "ref":
-                scores /= max(self.ref_std or 1.0, 1e-8)
-            clip = self.config.method.cliprange_reward
-            if clip:
-                scores = np.clip(scores, -clip, clip)
-
-            # KL-penalty reward assembly on host (numpy twin of the device
-            # math; [B, N] arrays — microseconds)
-            rewards, (mean_kl, mean_kl_per_seq) = kl_penalty_rewards_np(
-                host["logprobs"], host["ref_logprobs"], response_mask,
-                scores, self.kl_ctl.value,
-            )
-            kl_sum += mean_kl
-            kl_batches += 1
-            stats["policy/sqrt_kl"] = float(np.sqrt(max(mean_kl, 0.0)))
-
-            for i in range(B):
-                n_i = int(response_mask[i].sum())
-                if n_i == 0:
-                    continue
-                query = prompt_ids[i][prompt_mask[i] > 0]
-                elements.append(
-                    PPORLElement(
-                        query_tensor=query,
-                        response_tensor=response_tokens[i, :n_i],
-                        logprobs=np.asarray(host["logprobs"][i, :n_i]),
-                        values=np.asarray(host["values"][i, :n_i]),
-                        rewards=rewards[i, :n_i],
-                    )
-                )
-
-        self.mean_kl = kl_sum / max(kl_batches, 1)
+        self.mean_kl = acc["kl_sum"] / max(acc["kl_batches"], 1)
         stats["kl_ctl_value"] = self.kl_ctl.value
-        stats["time/exp"] = time() - exp_time
+        stats["time/rollout_host"] = acc["host_s"]
+        total = time() - exp_time
+        stats["time/exp"] = total
+        # whole-collection aggregates with identical definitions in BOTH
+        # modes (wall per chunk; generated tokens ÷ collection wall time) —
+        # the benchmark suite's A/B report then measures real speedup, never
+        # a per-mode metric redefinition
+        stats["time/rollout"] = total / max(acc["chunks"], 1)
+        if total > 0 and acc["gen_tokens"]:
+            stats["throughput/rollout_tokens_per_sec"] = acc["gen_tokens"] / total
         self.make_experience_stats = stats
         self.tracker.log(stats, step=iter_count)
 

@@ -38,10 +38,14 @@ def whiten(
 ) -> jax.Array:
     """Normalize to zero mean / unit variance (masked, globally under pjit).
 
-    Uses the unbiased (``ddof=1``) variance to match the reference exactly
-    (``trlx/utils/modeling.py:205-215`` whitens with ``torch.var_mean``,
-    whose default is Bessel-corrected) — pinned by
-    ``tests/test_parity_golden.py``.
+    Uses the unbiased (``ddof=1``) variance, matching the reference's
+    *single-process* convention (``trlx/utils/modeling.py:205-215`` whitens
+    with ``torch.var_mean``, Bessel-corrected by default) — pinned by
+    ``tests/test_parity_golden.py``. Parity is with that single-process path
+    only: the reference's distributed branch (``get_global_statistics:190``,
+    taken under ``dist.is_initialized()``) accumulates a *biased* variance
+    across ranks, so multi-GPU reference runs whiten slightly differently.
+    Under a global mesh there is exactly one code path — this one.
     """
     mean = masked_mean(xs, mask)
     var = masked_var(xs, mask, ddof=1)
