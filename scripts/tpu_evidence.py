@@ -402,6 +402,30 @@ def write_report(out_dir: str, allow_publish: bool = False) -> None:
             lines += [""]
         except Exception:
             pass
+    cb_path = os.path.join(out_dir, "continuous_batching.json")
+    if os.path.exists(cb_path):
+        try:
+            with open(cb_path) as f:
+                cb = json.load(f)
+            lines += [
+                "## Continuous-batching rollout A/B (slot-refill vs serial chunked decode)",
+                "",
+                f"- serial: {cb['serial']['rollout_tokens_per_sec']} rollout tok/s "
+                f"(padded_decode_frac {cb['serial']['padded_decode_frac']}); "
+                f"continuous: {cb['continuous']['rollout_tokens_per_sec']} tok/s "
+                f"(padded_decode_frac {cb['continuous']['padded_decode_frac']}) "
+                f"→ **{cb['speedup']}×** wall-clock, padded-waste drop "
+                f"{cb['padded_frac_drop']}",
+                f"- heterogeneous-length workload: mean response "
+                f"{cb['serial']['response_len_mean']} / max "
+                f"{cb['serial']['response_len_max']} of "
+                f"{cb['config']['max_new_tokens']} tokens; "
+                f"{cb['continuous'].get('refill_prefills')} refill prefills over "
+                f"{cb['continuous'].get('segments')} segments",
+                "",
+            ]
+        except Exception:
+            pass
     if walks:
         opts = [r["metrics/optimality"] for r in walks if "metrics/optimality" in r]
         if opts:
@@ -461,6 +485,10 @@ def main(argv=None):
         "gpt2_xl": (GPT2_XL_CODE, 3600),
         "profile": (PROFILE_CODE.format(out_dir=args.out), 3600),
         "speculative": (None, 1800),  # A/B rollout throughput, chip-native
+        # serial vs continuous-batching rollout collection on the
+        # heterogeneous-length workload — prices the slot-refill engine on
+        # the same chip window that prices speculative decoding
+        "continuous_batching": (None, 1800),
     }
     only = args.only.split(",") if args.only else list(stages)
     ok = {}
@@ -482,6 +510,18 @@ def main(argv=None):
                 [
                     sys.executable, "-m", "trlx_tpu.benchmark", "speculative",
                     "--output", os.path.join(args.out, "speculative.json"),
+                ],
+                args.out, timeout_s,
+            )
+        elif name == "continuous_batching":
+            # same entry as the committed CPU artifact
+            # (benchmarks/CONTINUOUS_BATCHING_cpu.json)
+            ok[name] = run_stage(
+                name,
+                [
+                    sys.executable, "-m", "trlx_tpu.benchmark",
+                    "continuous-batching",
+                    "--output", os.path.join(args.out, "continuous_batching.json"),
                 ],
                 args.out, timeout_s,
             )

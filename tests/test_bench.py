@@ -42,8 +42,57 @@ def test_init_devices_falls_back_after_wait_budget(bench, monkeypatch):
     monkeypatch.setenv("BENCH_ACCEL_WAIT", "0")  # budget exhausted immediately
     devices, err, _attempts = bench._init_devices()
     assert err is not None, "exhausted budget must report the failure"
-    assert len(calls) == 1  # no pointless re-probe past the deadline
+    # with zero budget no useful probe fits: none is launched (BENCH_r05:
+    # attempt 6 finished at "-45s of wait budget left" — overrun seconds
+    # came straight out of the CPU-fallback bench's driver window)
+    assert len(calls) == 0
     assert devices[0].platform == "cpu"
+
+
+def test_init_devices_clamps_probe_to_remaining_budget(bench, monkeypatch):
+    """Mid-loop: attempts are clamped to the remaining budget (never
+    overrun it) and skipped entirely once below the useful probe floor."""
+    clock = {"t": 1000.0}
+    monkeypatch.setattr(bench.time, "time", lambda: clock["t"])
+    monkeypatch.setattr(
+        bench.time, "sleep", lambda s: clock.__setitem__("t", clock["t"] + s)
+    )
+    calls = []
+
+    def fake_probe(timeout_s):
+        calls.append(timeout_s)
+        clock["t"] += timeout_s  # the probe hung for its whole timeout
+        return False
+
+    monkeypatch.setattr(bench, "_probe_accelerator", fake_probe)
+    monkeypatch.setenv("BENCH_ACCEL_WAIT", "200")
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "120")
+    devices, err, _attempts = bench._init_devices()
+    # attempt 1 runs at the full 120s and consumes it + 35s backoff;
+    # attempt 2 is CLAMPED to the 45s remainder and exhausts the budget ->
+    # immediate fallback. No attempt ever finishes past the deadline.
+    assert calls == [120.0, 45.0]
+    assert clock["t"] <= 1000.0 + 200.0 + 1e-6
+    assert err is not None
+    assert devices[0].platform == "cpu"
+
+
+def test_init_devices_small_budget_still_probes_once(bench, monkeypatch):
+    """A budget below the probe timeout but above the floor still gets one
+    (clamped) probe — a healthy chip that initializes fast is not skipped."""
+    calls = []
+
+    def fake_probe(timeout_s):
+        calls.append(timeout_s)
+        return True  # chip comes up quickly
+
+    monkeypatch.setattr(bench, "_probe_accelerator", fake_probe)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setenv("BENCH_ACCEL_WAIT", "60")
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "120")
+    devices, err, _attempts = bench._init_devices()
+    assert err is None
+    assert len(calls) == 1 and calls[0] <= 60.0
 
 
 def test_init_devices_stops_probing_on_orphan_pileup(bench, monkeypatch):

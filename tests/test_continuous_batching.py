@@ -1,0 +1,448 @@
+"""Continuous-batching rollout generation (docs/PERFORMANCE.md).
+
+Three contracts, per the slot-refill engine's design:
+
+- **bit-parity** — every sequence decoded through the engine (any slot, any
+  refill timing, any bucket size) reproduces plain ``generate``'s tokens /
+  logprobs / values / mask for that prompt bit-for-bit under per-row RNG —
+  including eos, ``min_new_tokens``, and transition-logit-mask composition;
+- **state machine** — deterministic slot-order harvest, queue exhaustion
+  (partial batches decode to completion), width validation, padding of
+  narrow prompt chunks, exception propagation out of the PPO collection
+  loop with no leaked pipeline worker;
+- **equivalence** — PPO ``make_experience`` with ``train.continuous_batching``
+  on vs off (both under ``per_row_rng``) fills the store with the same
+  elements up to sequence order; GRPO's group-aware harvest preserves group
+  advantages exactly.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.data.configs import ModelConfig
+from trlx_tpu.models.builder import build_causal_lm
+from trlx_tpu.models.transformer import make_kv_cache
+from trlx_tpu.ops.sampling import GenerationConfig, generate, per_row_keys
+from trlx_tpu.ops.slot_refill import make_slot_refill_fns
+from trlx_tpu.pipeline.continuous_batching import ContinuousBatchingEngine
+
+_EOS = 3
+_PAD = 258
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    module, params, tcfg = build_causal_lm(
+        ModelConfig(model_path="builtin:gpt2-test"), head="value"
+    )
+
+    def apply_fn(p, ids, **kw):
+        return module.apply({"params": p}, ids, **kw)
+
+    return apply_fn, params, tcfg
+
+
+def _eos_boost(step_out, logits):
+    # boost eos so responses end at heterogeneous lengths (exercises refill)
+    return logits.at[..., _EOS].add(4.0)
+
+
+def _prompt_set(n, P, seed=1):
+    rs = np.random.RandomState(seed)
+    prompts = rs.randint(0, 200, (n, P)).astype(np.int32)
+    masks = np.ones_like(prompts)
+    for i in range(n):  # vary left padding across rows
+        pad = i % 3
+        prompts[i, :pad] = _PAD
+        masks[i, :pad] = 0
+    return prompts, masks
+
+
+def _reference_rows(apply_fn, params, tcfg, config, prompts, masks, rng, B, adjust):
+    """Plain generate in batches of B with per-row keys — the ground truth
+    each engine-decoded sequence must reproduce bit-for-bit."""
+    gen = jax.jit(
+        lambda p, ids, m, r: generate(
+            apply_fn, p, lambda b, s: make_kv_cache(tcfg, b, s),
+            ids, m, r, config, adjust_logits=adjust,
+        )
+    )
+    n = prompts.shape[0]
+    ref, keys = {}, {}
+    for c0 in range(0, n, B):
+        batch, bm = prompts[c0 : c0 + B], masks[c0 : c0 + B]
+        if batch.shape[0] < B:  # repeat-pad the tail chunk to the full width
+            extra = B - batch.shape[0]
+            batch = np.concatenate([batch, np.tile(batch[-1:], (extra, 1))])
+            bm = np.concatenate([bm, np.tile(bm[-1:], (extra, 1))])
+        rng, call = jax.random.split(rng)
+        out = gen(params, jnp.asarray(batch), jnp.asarray(bm), call)
+        ks = np.asarray(per_row_keys(call, B))
+        for i in range(min(B, n - c0)):
+            ref[c0 + i] = {
+                "tokens": np.asarray(out.response_tokens[i]),
+                "logprobs": np.asarray(out.response_logprobs[i]),
+                "values": np.asarray(out.response_values[i]),
+                "mask": np.asarray(out.response_mask[i]),
+            }
+            keys[c0 + i] = ks[i]
+    return ref, keys
+
+
+def _engine_rows(apply_fn, params, tcfg, config, prompts, masks, keys, B,
+                 adjust, segment_len=3):
+    """Run the same prompts through the slot-refill engine; returns
+    {prompt index: completed fields} + the engine (for stats assertions)."""
+    P = prompts.shape[1]
+    fns = make_slot_refill_fns(
+        apply_fn, lambda b, s: make_kv_cache(tcfg, b, s), B, P, config,
+        adjust_logits=adjust, segment_len=segment_len, params_example=params,
+    )
+    engine = ContinuousBatchingEngine(fns, params, _PAD)
+    n = prompts.shape[0]
+    engine.enqueue_prompts(
+        prompts, masks, np.stack([keys[j] for j in range(n)])
+    )
+    got = {}
+    while engine.busy:
+        for c in engine.step():
+            got[c.index] = {
+                "tokens": c.tokens, "logprobs": c.logprobs,
+                "values": c.values, "mask": c.mask,
+            }
+    return got, engine
+
+
+class TestBitParity:
+    def test_tokens_logprobs_values_identical_with_refill(self, tiny_lm):
+        """10 heterogeneous-length prompts through 4 slots (refills at
+        bucket sizes 1/2/4) reproduce plain generate bit-for-bit —
+        eos + min_new_tokens + adjust-hook composition included."""
+        apply_fn, params, tcfg = tiny_lm
+        B, P, N = 4, 8, 10
+        config = GenerationConfig(
+            max_new_tokens=N, eos_token_id=_EOS, pad_token_id=_PAD,
+            min_new_tokens=2, per_row_rng=True,
+        )
+        prompts, masks = _prompt_set(10, P)
+        rng = jax.random.PRNGKey(0)
+        ref, keys = _reference_rows(
+            apply_fn, params, tcfg, config, prompts, masks, rng, B, _eos_boost
+        )
+        got, engine = _engine_rows(
+            apply_fn, params, tcfg, config, prompts, masks, keys, B, _eos_boost
+        )
+        lens = {int(ref[j]["mask"].sum()) for j in ref}
+        assert len(lens) > 1, "workload must be heterogeneous to exercise refill"
+        assert engine.stats.refill_prefills > 1  # refills actually happened
+        assert set(got) == set(ref)
+        for j in ref:
+            for field in ("tokens", "mask", "logprobs", "values"):
+                np.testing.assert_array_equal(
+                    ref[j][field], got[j][field], err_msg=f"prompt {j} {field}"
+                )
+
+    def test_transition_logit_mask_composition(self, tiny_lm):
+        """An absorbing transition mask (trainer ``logit_mask`` semantics)
+        composes identically in both samplers."""
+        from trlx_tpu.ops.sampling import apply_transition_mask
+
+        apply_fn, params, tcfg = tiny_lm
+        B, P, N = 4, 8, 8
+        V = 259  # builtin:bytes/gpt2-test vocab size
+        trans = np.ones((V, V), bool)
+        trans[:64, :] = False
+        trans[:64, _EOS] = True
+        tmask = jnp.asarray(trans)
+
+        def adjust(step_out, logits):
+            return apply_transition_mask(tmask, step_out["last_tokens"], logits)
+
+        config = GenerationConfig(
+            max_new_tokens=N, eos_token_id=_EOS, pad_token_id=_PAD,
+            per_row_rng=True,
+        )
+        prompts, masks = _prompt_set(8, P, seed=7)
+        ref, keys = _reference_rows(
+            apply_fn, params, tcfg, config, prompts, masks,
+            jax.random.PRNGKey(5), B, adjust,
+        )
+        got, _ = _engine_rows(
+            apply_fn, params, tcfg, config, prompts, masks, keys, B, adjust
+        )
+        assert {int(ref[j]["mask"].sum()) for j in ref} != {N}
+        for j in ref:
+            for field in ("tokens", "mask", "logprobs", "values"):
+                np.testing.assert_array_equal(
+                    ref[j][field], got[j][field], err_msg=f"prompt {j} {field}"
+                )
+
+
+class TestEngineStateMachine:
+    def _engine(self, tiny_lm, B=4, P=8, N=6, segment_len=2):
+        apply_fn, params, tcfg = tiny_lm
+        config = GenerationConfig(
+            max_new_tokens=N, eos_token_id=None, pad_token_id=_PAD,
+            per_row_rng=True,
+        )
+        fns = make_slot_refill_fns(
+            apply_fn, lambda b, s: make_kv_cache(tcfg, b, s), B, P, config,
+            segment_len=segment_len, params_example=params,
+        )
+        return ContinuousBatchingEngine(fns, params, _PAD), config
+
+    def test_harvest_order_and_exhaustion(self, tiny_lm):
+        """No eos → all rows run N steps: one full batch completes together
+        (slot order), then the partial tail batch decodes to completion."""
+        engine, config = self._engine(tiny_lm)
+        prompts, masks = _prompt_set(6, 8)
+        keys = np.asarray(per_row_keys(jax.random.PRNGKey(0), 6))
+        engine.enqueue_prompts(prompts, masks, keys)
+        # slots fill lazily inside step(): everything queued until then
+        assert engine.pending == 6 and engine.live == 0
+
+        completed = []
+        while engine.busy:
+            completed.extend(engine.step())
+        # submission order fills slots 0..3 first, then 4,5 refill in slot
+        # order: harvest order equals submission order here
+        assert [c.index for c in completed] == list(range(6))
+        assert engine.live == 0 and engine.pending == 0
+        assert not engine.busy
+        assert engine.stats.harvested == 6
+        assert engine.stats.refilled_rows == 6
+        # the tail batch ran 2 live rows on 4 slots: utilization < 1
+        assert 0.0 < engine.stats.slot_utilization < 1.0
+        assert engine.stats.padded_decode_frac == pytest.approx(
+            1.0 - engine.stats.slot_utilization
+        )
+        for c in completed:  # no eos: full-length responses
+            assert int(c.mask.sum()) == 6
+        # step() on a drained engine is a no-op
+        assert engine.step() == []
+
+    def test_prompt_width_validation_and_padding(self, tiny_lm):
+        engine, _ = self._engine(tiny_lm)
+        keys = np.asarray(per_row_keys(jax.random.PRNGKey(0), 2))
+        with pytest.raises(ValueError, match="exceeds the engine"):
+            engine.enqueue_prompts(
+                np.zeros((2, 12), np.int32), np.ones((2, 12), np.int32), keys
+            )
+        # narrower chunks left-pad to the engine width and still complete
+        engine.enqueue_prompts(
+            np.full((2, 5), 65, np.int32), np.ones((2, 5), np.int32), keys
+        )
+        done = []
+        while engine.busy:
+            done.extend(engine.step())
+        assert len(done) == 2
+        assert done[0].prompt_ids.shape == (8,)
+        assert int(done[0].prompt_mask.sum()) == 5
+
+    def test_metrics_payload_registered_names(self, tiny_lm):
+        engine, _ = self._engine(tiny_lm)
+        metrics = engine.stats.metrics()
+        assert set(metrics) == {
+            "throughput/slot_utilization",
+            "rollout/padded_decode_frac",
+            "rollout/refill_prefills",
+            "rollout/refilled_rows",
+            "rollout/segments",
+        }
+
+
+# ---------------------------------------------------------------------------
+# PPO / GRPO make_experience equivalence
+# ---------------------------------------------------------------------------
+
+PROMPTS = ["hello world", "the quick brown fox", "lorem ipsum", "foo bar"] * 4
+
+_WORKER_NAME = "trlx-rollout-pipeline"
+
+
+def _pipeline_threads():
+    return [
+        t for t in threading.enumerate() if t.name == _WORKER_NAME and t.is_alive()
+    ]
+
+
+def _absorbing_mask():
+    # ~25%/step absorb chance → geometric response lengths
+    # (builtin:bytes vocab: 0..255 bytes, 256 bos, 257 eos, 258 pad = 259)
+    V, eos = 259, 257
+    mask = np.ones((V, V), bool)
+    mask[0:64, :] = False
+    mask[0:64, eos] = True
+    return mask
+
+
+def _letter_reward(samples, prompts, outputs, **kwargs):
+    return [float(sum(c in "aeiou" for c in o)) for o in outputs]
+
+
+def _ppo_trainer(tmp_path, tag, continuous, reward_fn=_letter_reward, depth=2):
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401 (registration)
+    import trlx_tpu.trainer.ppo  # noqa: F401 (registration)
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+
+    cfg = default_ppo_config().evolve(
+        train=dict(
+            seq_length=48,
+            batch_size=8,
+            total_steps=4,
+            checkpoint_interval=1000,
+            checkpoint_dir=str(tmp_path / f"ckpts_{tag}"),
+            tracker=None,
+            rollout_pipeline_depth=depth,
+            continuous_batching=continuous,
+            continuous_batching_segment=3,
+        ),
+        model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+        method=dict(
+            num_rollouts=16,
+            chunk_size=4,
+            ppo_epochs=1,
+            gen_kwargs=dict(
+                max_new_tokens=8, top_k=0, top_p=1.0, do_sample=True,
+                per_row_rng=True,
+            ),
+        ),
+    )
+    trainer = get_trainer(cfg.train.trainer)(
+        config=cfg, reward_fn=reward_fn, metric_fn=None, stop_sequences=[],
+        logit_mask=_absorbing_mask(),
+    )
+    trainer.add_prompt_pipeline(
+        get_pipeline(cfg.train.pipeline)(PROMPTS, 40, trainer.tokenizer)
+    )
+    return trainer
+
+
+def _canonical(store):
+    out = {}
+    for e in store.history:
+        key = (
+            tuple(np.asarray(e.query_tensor).tolist()),
+            tuple(np.asarray(e.response_tensor).tolist()),
+        )
+        out[key] = e
+    return out
+
+
+class TestPPOEquivalence:
+    def test_same_store_up_to_order(self, tmp_path):
+        """Acceptance: continuous batching on vs off (both per-row RNG, same
+        seed) collects the same 16 sequences with identical logprobs /
+        values / rewards, merely in a different order — the chunk barrier is
+        a scheduling artifact, not a semantic one."""
+        serial = _ppo_trainer(tmp_path, "serial", continuous=False, depth=0)
+        continuous = _ppo_trainer(tmp_path, "cb", continuous=True, depth=2)
+        serial.make_experience(16)
+        continuous.make_experience(16)
+
+        assert len(serial.store) == len(continuous.store) == 16
+        a, b = _canonical(serial.store), _canonical(continuous.store)
+        assert set(a) == set(b)
+        for key in a:
+            for field in ("logprobs", "values", "rewards"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(a[key], field)),
+                    np.asarray(getattr(b[key], field)),
+                    err_msg=field,
+                )
+        # heterogeneous lengths, so the engine really did slot refills
+        lengths = {len(np.asarray(e.response_tensor)) for e in serial.store.history}
+        assert len(lengths) > 1
+        stats = continuous.make_experience_stats
+        assert stats["rollout/refilled_rows"] == 16
+        assert 0.0 < stats["throughput/slot_utilization"] <= 1.0
+        assert stats["rollout/padded_decode_frac"] == pytest.approx(
+            1.0 - stats["throughput/slot_utilization"]
+        )
+        # the serial path reports the mask-derived twin of the same gauges
+        sstats = serial.make_experience_stats
+        assert 0.0 < sstats["throughput/slot_utilization"] <= 1.0
+        assert _pipeline_threads() == []
+
+    def test_reward_error_propagates_no_leaked_worker(self, tmp_path):
+        calls = {"n": 0}
+
+        def exploding_reward(samples, prompts, outputs, **kwargs):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise RuntimeError("reward backend down")
+            return [0.0] * len(outputs)
+
+        trainer = _ppo_trainer(
+            tmp_path, "err", continuous=True, reward_fn=exploding_reward
+        )
+        with pytest.raises(RuntimeError, match="reward backend down"):
+            trainer.make_experience(16)
+        assert _pipeline_threads() == []  # drained and joined, not leaked
+
+    def test_inline_host_path_when_depth_zero(self, tmp_path):
+        """continuous_batching composes with rollout_pipeline_depth=0: the
+        host stage runs inline, no worker thread is ever constructed."""
+        trainer = _ppo_trainer(tmp_path, "inline", continuous=True, depth=0)
+        trainer.make_experience(8)
+        assert len(trainer.store) == 8
+        assert _pipeline_threads() == []
+
+
+def test_grpo_group_aware_equivalence(tmp_path):
+    """GRPO with continuous batching: groups reassemble from individually
+    harvested members — same elements and bit-identical group advantages as
+    the serial path."""
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401 (registration)
+    import trlx_tpu.trainer.grpo  # noqa: F401 (registration)
+    from trlx_tpu.data.default_configs import default_grpo_config
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+
+    def make(tag, continuous):
+        cfg = default_grpo_config().evolve(
+            train=dict(
+                seq_length=48, batch_size=8, total_steps=2,
+                checkpoint_interval=1000,
+                checkpoint_dir=str(tmp_path / f"ckpts_{tag}"), tracker=None,
+                continuous_batching=continuous, continuous_batching_segment=3,
+            ),
+            model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+            tokenizer=dict(tokenizer_path="builtin:bytes"),
+            method=dict(
+                num_rollouts=16, chunk_size=8, group_size=4, ppo_epochs=1,
+                gen_kwargs=dict(
+                    max_new_tokens=8, top_k=0, top_p=1.0, do_sample=True,
+                    per_row_rng=True,
+                ),
+            ),
+        )
+        trainer = get_trainer(cfg.train.trainer)(
+            config=cfg, reward_fn=lambda samples, prompts, outputs, **kw: [
+                float(len(o)) for o in outputs
+            ],
+            metric_fn=None, stop_sequences=[], logit_mask=_absorbing_mask(),
+        )
+        trainer.add_prompt_pipeline(
+            get_pipeline(cfg.train.pipeline)(PROMPTS, 40, trainer.tokenizer)
+        )
+        return trainer
+
+    serial = make("s", False)
+    continuous = make("c", True)
+    serial.make_experience(16)
+    continuous.make_experience(16)
+    assert len(serial.store) == len(continuous.store) == 16
+    a, b = _canonical(serial.store), _canonical(continuous.store)
+    assert set(a) == set(b)
+    for key in a:
+        np.testing.assert_array_equal(
+            np.asarray(a[key].logprobs), np.asarray(b[key].logprobs)
+        )
+        assert a[key].advantage == b[key].advantage

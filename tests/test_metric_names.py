@@ -40,6 +40,13 @@ def test_scanner_sees_the_codebase():
     # allowlisted — the convention covers them like any other metric
     assert "time/rollout_host" in keys
     assert "throughput/rollout_overlap_frac" in keys
+    # continuous-batching keys (docs/PERFORMANCE.md): the slot-accounting
+    # gauges and the engine's refill/segment counters
+    assert "throughput/slot_utilization" in keys
+    assert "rollout/padded_decode_frac" in keys
+    assert "rollout/refill_prefills" in keys
+    assert "rollout/refilled_rows" in keys
+    assert "rollout/segments" in keys
 
 
 def test_lint_catches_a_bad_key(tmp_path):

@@ -54,6 +54,14 @@ def test_budget_gpt2_test():
 
 
 @pytest.mark.slow
+def test_budget_gpt2_test_cb():
+    """The continuous-batching rollout programs: bucketed refill prefill +
+    slot-refill segment decode (ops/slot_refill.py) — a lost logits-span
+    restriction or a broken scatter shows up as a flop/byte jump here."""
+    _assert_within_budget("gpt2_test_cb")
+
+
+@pytest.mark.slow
 def test_budget_ilql_gpt2_test():
     """ILQL's programs: twin-Q/CQL train step + the advantage-reshaping
     sampler (a different generate program than PPO's)."""

@@ -178,6 +178,9 @@ _THROUGHPUT_KEYS = (
     "throughput/samples_per_sec",
     "throughput/mfu",
     "throughput/rollout_overlap_frac",
+    "throughput/rollout_tokens_per_sec",
+    "throughput/slot_utilization",
+    "rollout/padded_decode_frac",
     "time/train_step",
     "time/rollout",
     "time/rollout_host",
@@ -239,6 +242,9 @@ _KEY_METRICS = (
     "losses/total_loss", "losses/loss",
     "throughput/tokens_per_sec", "throughput/mfu",
     "throughput/rollout_overlap_frac",
+    "throughput/rollout_tokens_per_sec",
+    "throughput/slot_utilization",
+    "rollout/padded_decode_frac",
 )
 
 
@@ -402,6 +408,163 @@ def measure_speculative(
     return results
 
 
+def measure_continuous_batching(
+    policy_layers: int = 8,
+    policy_hidden: int = 128,
+    batch_size: int = 16,
+    prompt_len: int = 16,
+    max_new_tokens: int = 96,
+    num_rollouts: int = 64,
+    absorb_frac: float = 0.08,
+    segment_len: int = 8,
+    rounds: int = 3,
+    seed: int = _SEED,
+) -> Dict[str, Any]:
+    """Rollout-collection A/B: serial chunked decode vs continuous batching
+    (slot-refill segment decode, docs/PERFORMANCE.md) on a synthetic
+    heterogeneous-response-length workload.
+
+    Length heterogeneity is synthesized with a transition ``logit_mask``
+    whose first ``absorb_frac`` of the byte vocabulary allows only eos as
+    the next token: each decode step absorbs with roughly that probability,
+    so response lengths are ~geometric in ``[1, max_new_tokens]`` — the
+    regime where the serial path's batch-tail padding waste is largest. Both
+    modes sample with per-row RNG (``gen_kwargs.per_row_rng``), so they
+    decode the *same* per-prompt sequences: the tokens-per-second ratio is a
+    pure scheduling comparison, not a workload change
+    (tests/test_continuous_batching.py pins the store equivalence).
+
+    Reports per mode: ``throughput/rollout_tokens_per_sec``, per-chunk
+    ``time/rollout``, ``rollout/padded_decode_frac`` and
+    ``throughput/slot_utilization``, plus the wall-clock speedup. Runs on
+    whatever backend JAX selected (CPU program-level ratios or on-chip
+    numbers — the evidence chain runs it in ``scripts/tpu_evidence.py``).
+    """
+    import numpy as np
+
+    from trlx_tpu.trlx import initialize_runtime
+
+    initialize_runtime()  # honors TRLX_TPU_PLATFORM before any backend init
+
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401  (registration)
+    import trlx_tpu.trainer.ppo  # noqa: F401  (registers PPOTrainer)
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+
+    absorb_n = max(1, int(absorb_frac * 256))
+    # builtin:bytes vocab: ids 0..255 bytes, 256 bos, 257 eos, 258 pad (=259)
+    vocab, eos = 259, 257
+    logit_mask = np.ones((vocab, vocab), bool)
+    logit_mask[:absorb_n, :] = False
+    logit_mask[:absorb_n, eos] = True
+
+    policy_extra = dict(
+        num_layers=policy_layers,
+        hidden_size=policy_hidden,
+        num_heads=max(4, policy_hidden // 32),
+        intermediate_size=4 * policy_hidden,
+    )
+    results: Dict[str, Any] = {
+        "config": dict(
+            policy=policy_extra,
+            batch_size=batch_size,
+            prompt_len=prompt_len,
+            max_new_tokens=max_new_tokens,
+            num_rollouts=num_rollouts,
+            absorb_frac=absorb_frac,
+            segment_len=segment_len,
+            rounds=rounds,
+        )
+    }
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        return [float(sum(c in "aeiou" for c in o)) for o in outputs]
+
+    rs = np.random.RandomState(seed)
+    prompts = [
+        "".join(chr(97 + c) for c in rs.randint(0, 26, prompt_len))
+        for _ in range(max(num_rollouts, 4 * batch_size))
+    ]
+
+    for mode in ("serial", "continuous"):
+        cfg = default_ppo_config().evolve(
+            train=dict(
+                seq_length=prompt_len + max_new_tokens,
+                batch_size=batch_size,
+                total_steps=1,
+                checkpoint_interval=10_000_000,
+                tracker=None,
+                seed=seed,
+                continuous_batching=(mode == "continuous"),
+                continuous_batching_segment=segment_len,
+            ),
+            model=dict(
+                model_path="builtin:gpt2-test",
+                num_layers_unfrozen=1,
+                model_extra_kwargs=dict(policy_extra),
+            ),
+            tokenizer=dict(tokenizer_path="builtin:bytes"),
+            method=dict(
+                num_rollouts=num_rollouts,
+                chunk_size=batch_size,
+                gen_kwargs=dict(
+                    max_new_tokens=max_new_tokens, top_k=0, top_p=1.0,
+                    do_sample=True, per_row_rng=True,
+                ),
+            ),
+        )
+        trainer = get_trainer(cfg.train.trainer)(
+            cfg, reward_fn=reward_fn, logit_mask=logit_mask
+        )
+        trainer.add_prompt_pipeline(
+            get_pipeline(cfg.train.pipeline)(prompts, prompt_len, trainer.tokenizer)
+        )
+        trainer.make_experience(num_rollouts)  # compile warmup, untimed
+        t0 = time.time()
+        for _ in range(rounds):
+            trainer.store.clear_history()
+            trainer.make_experience(num_rollouts)
+        dt = time.time() - t0
+        es = trainer.make_experience_stats
+        lengths = [
+            int(np.asarray(e.response_tensor).shape[0])
+            for e in trainer.store.history
+        ]
+        results[mode] = {
+            "seconds": round(dt, 3),
+            "rollout_tokens_per_sec": round(
+                float(es.get("throughput/rollout_tokens_per_sec", 0.0)), 1
+            ),
+            "time_rollout_s": round(float(es.get("time/rollout", 0.0)), 4),
+            "padded_decode_frac": round(
+                float(es.get("rollout/padded_decode_frac", 0.0)), 4
+            ),
+            "slot_utilization": round(
+                float(es.get("throughput/slot_utilization", 0.0)), 4
+            ),
+            "response_len_mean": round(float(np.mean(lengths)), 2) if lengths else 0.0,
+            "response_len_max": int(np.max(lengths)) if lengths else 0,
+        }
+        if mode == "continuous":
+            results[mode]["refill_prefills"] = int(
+                es.get("rollout/refill_prefills", 0)
+            )
+            results[mode]["segments"] = int(es.get("rollout/segments", 0))
+    results["speedup"] = round(
+        results["serial"]["seconds"] / max(results["continuous"]["seconds"], 1e-9), 3
+    )
+    results["padded_frac_drop"] = round(
+        results["serial"]["padded_decode_frac"]
+        - results["continuous"]["padded_decode_frac"],
+        4,
+    )
+    import jax
+
+    results["backend"] = jax.default_backend()
+    return results
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
@@ -423,6 +586,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     spec_p.add_argument("--policy-hidden", type=int, default=256)
     spec_p.add_argument("--gamma", type=int, default=4)
     spec_p.add_argument("--rounds", type=int, default=8)
+    cb_p = sub.add_parser(
+        "continuous-batching",
+        help="A/B rollout collection: serial chunked decode vs slot-refill "
+        "continuous batching on a heterogeneous-length workload",
+    )
+    cb_p.add_argument("--output", default=None, help="write JSON here (default stdout)")
+    cb_p.add_argument("--policy-layers", type=int, default=8)
+    cb_p.add_argument("--policy-hidden", type=int, default=128)
+    cb_p.add_argument("--batch-size", type=int, default=16)
+    cb_p.add_argument("--max-new-tokens", type=int, default=96)
+    cb_p.add_argument("--num-rollouts", type=int, default=64)
+    cb_p.add_argument("--absorb-frac", type=float, default=0.08)
+    cb_p.add_argument("--segment-len", type=int, default=8)
+    cb_p.add_argument("--rounds", type=int, default=3)
     args = parser.parse_args(argv)
 
     if args.cmd == "run":
@@ -433,6 +610,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             policy_layers=args.policy_layers,
             policy_hidden=args.policy_hidden,
             gamma=args.gamma,
+            rounds=args.rounds,
+        )
+        text = json.dumps(result, indent=2)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text + "\n")
+        print(text)
+        return 0
+    if args.cmd == "continuous-batching":
+        result = measure_continuous_batching(
+            policy_layers=args.policy_layers,
+            policy_hidden=args.policy_hidden,
+            batch_size=args.batch_size,
+            max_new_tokens=args.max_new_tokens,
+            num_rollouts=args.num_rollouts,
+            absorb_frac=args.absorb_frac,
+            segment_len=args.segment_len,
             rounds=args.rounds,
         )
         text = json.dumps(result, indent=2)

@@ -252,6 +252,27 @@ class TrainConfig:
     # 0 = the serial reference path.
     rollout_pipeline_depth: int = 2
 
+    # Continuous-batching rollout generation (docs/PERFORMANCE.md): decode
+    # runs as fixed-size segments over per-slot state; finished sequences
+    # are harvested at segment boundaries (shipped individually into the
+    # rollout pipeline's host stage) and their freed KV-cache slots refill
+    # from the prompt queue — the device batch stays full instead of every
+    # chunk draining at the pace of its longest row. Wins grow with
+    # response-length *variance*. Rollout sampling switches to per-row RNG
+    # streams (required for slot invariance), so sampled tokens differ from
+    # the serial path's batch-wide stream; per-sequence they are
+    # bit-identical to plain generate under per-row RNG
+    # (tests/test_continuous_batching.py). Causal-LM PPO/GRPO only
+    # (seq2seq and speculative decoding keep the serial path).
+    # False = the serial chunked reference path, byte-for-byte unchanged.
+    continuous_batching: bool = False
+
+    # Decode steps per compiled segment between harvest/refill points.
+    # Smaller segments harvest/refill sooner (higher slot utilization,
+    # lower completion latency) at the cost of more host round-trips and
+    # refill prefills per collection.
+    continuous_batching_segment: int = 8
+
     from_dict = classmethod(_strict_from_dict)
 
 

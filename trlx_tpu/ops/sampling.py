@@ -30,6 +30,14 @@ class GenerationConfig:
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
     min_new_tokens: int = 0
+    # Per-row RNG streams: row i samples from its own key chain
+    # ``fold_in(rng, i)`` split once per decode step, so a sequence's sampled
+    # tokens depend only on (its key, its step) — never on batch composition
+    # or slot position. Required by (and implied by) continuous-batching
+    # rollouts, where a sequence migrates through refilled cache slots; the
+    # default batch-wide stream is kept for byte-for-byte compatibility of
+    # existing runs.
+    per_row_rng: bool = False
 
     @staticmethod
     def from_gen_kwargs(kwargs: Dict[str, Any], eos_token_id=None, pad_token_id=0) -> "GenerationConfig":
@@ -95,22 +103,49 @@ def process_logits(
 
 
 
+def per_row_keys(rng: jax.Array, batch_size: int) -> jax.Array:
+    """Derive ``[B, 2]`` independent per-row key chains from one key.
+
+    Row ``i``'s chain starts at ``fold_in(rng, i)``; every decode step splits
+    it once (``split_row_keys``). The single source of truth for BOTH the
+    plain sampler's ``per_row_rng`` mode and the continuous-batching engine —
+    they must agree exactly for the slot-refill bit-parity guarantee."""
+    return jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+        jnp.arange(batch_size, dtype=jnp.int32)
+    )
+
+
+def split_row_keys(keys: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """One decode step of every row's chain: ``[B, 2]`` keys → (next chain
+    keys, this step's sample keys), both ``[B, 2]``."""
+    pairs = jax.vmap(lambda k: jax.random.split(k))(keys)  # [B, 2, 2]
+    return pairs[:, 0], pairs[:, 1]
+
+
 def sample_token_from_logits(
     logits: jax.Array,  # [B, V] raw last-position logits
     step_out: Dict[str, Any],
-    sample_rng: jax.Array,
+    sample_rng: jax.Array,  # [2] batch-wide key, or [B, 2] per-row keys
     config: GenerationConfig,
-    step: jax.Array,
+    step: jax.Array,  # scalar, or [B] per-slot decode steps
     adjust_logits: Optional[Callable[[Dict[str, Any], jax.Array], jax.Array]],
 ) -> Tuple[jax.Array, jax.Array]:
-    """Shared sampling semantics for both decode loops: adjust-logits hook,
+    """Shared sampling semantics for every decode loop: adjust-logits hook,
     min_new_tokens eos blocking, temperature/top-k/top-p filtering,
-    sample-or-argmax, and behavior logprob of the chosen token."""
+    sample-or-argmax, and behavior logprob of the chosen token.
+
+    ``sample_rng`` may be one batch-wide key (historical behavior) or a
+    ``[B, 2]`` stack of per-row keys; ``step`` may be a scalar (all rows in
+    lockstep) or a ``[B]`` vector (continuous batching: slots at different
+    depths). Per-row sampling is a vmapped categorical, so row ``i``'s token
+    depends only on its own key and logits."""
     if adjust_logits is not None:
         logits = adjust_logits(step_out, logits)
     logits = logits.astype(jnp.float32)
     if config.eos_token_id is not None and config.min_new_tokens > 0:
-        block_eos = step < config.min_new_tokens
+        block_eos = jnp.asarray(step < config.min_new_tokens)
+        if block_eos.ndim:  # [B] per-slot steps → broadcast over the vocab
+            block_eos = block_eos[:, None]
         logits = jnp.where(
             block_eos
             & (jnp.arange(logits.shape[-1])[None, :] == config.eos_token_id),
@@ -119,7 +154,12 @@ def sample_token_from_logits(
         )
     filtered = process_logits(logits, config.temperature, config.top_k, config.top_p)
     if config.do_sample:
-        next_token = jax.random.categorical(sample_rng, filtered, axis=-1)
+        if sample_rng.ndim == 2:  # per-row key chains
+            next_token = jax.vmap(
+                lambda k, row: jax.random.categorical(k, row)
+            )(sample_rng, filtered)
+        else:
+            next_token = jax.random.categorical(sample_rng, filtered, axis=-1)
     else:
         next_token = jnp.argmax(filtered, axis=-1)
     logprob = jnp.take_along_axis(
@@ -214,7 +254,10 @@ def generate(
         rng: jax.Array
 
     def sample_step(carry: Carry) -> Carry:
-        rng, sample_rng = jax.random.split(carry.rng)
+        if config.per_row_rng:
+            rng, sample_rng = split_row_keys(carry.rng)
+        else:
+            rng, sample_rng = jax.random.split(carry.rng)
         next_token, logprob = sample_token_from_logits(
             carry.logits, carry.step_out, sample_rng, config, carry.step, adjust_logits
         )
@@ -279,7 +322,7 @@ def generate(
         step_out={**last_step_info(prefill_out), "last_tokens": input_ids[:, -1]},
         done=jnp.zeros((B,), bool),
         step=jnp.asarray(0, jnp.int32),
-        rng=rng,
+        rng=per_row_keys(rng, B) if config.per_row_rng else rng,
     )
     final = jax.lax.while_loop(cond, sample_step, init)
 
@@ -341,7 +384,10 @@ def generate_seq2seq(
         rng: jax.Array
 
     def sample_step(carry: Carry) -> Carry:
-        rng, sample_rng = jax.random.split(carry.rng)
+        if config.per_row_rng:
+            rng, sample_rng = split_row_keys(carry.rng)
+        else:
+            rng, sample_rng = jax.random.split(carry.rng)
         next_token, logprob = sample_token_from_logits(
             carry.logits, carry.step_out, sample_rng, config, carry.step, adjust_logits
         )
@@ -388,7 +434,7 @@ def generate_seq2seq(
         step_out={**last_step_info(out0), "last_tokens": start[:, 0]},
         done=jnp.zeros((B,), bool),
         step=jnp.asarray(0, jnp.int32),
-        rng=rng,
+        rng=per_row_keys(rng, B) if config.per_row_rng else rng,
     )
     final = jax.lax.while_loop(cond, sample_step, init)
 

@@ -1,0 +1,413 @@
+"""Slot-refill decode: the device half of continuous-batching rollouts.
+
+The plain sampler (``ops/sampling.py::generate``) runs a whole ``[B]`` batch
+until the *longest* row finishes — every early-EOS row burns decode steps as
+padding, and nothing reaches the host until the chunk drains. Here decode is
+restructured into fixed-size **segments** over per-slot state: one compiled
+program with static shapes, reused across segments. After each segment the
+host harvests finished slots and refills them with fresh prompts via an
+on-demand prefill into the freed KV-cache rows, so the device batch stays
+full while the prompt queue lasts (PipelineRL, arXiv:2509.19128; OPPO,
+arXiv:2509.25762).
+
+Bit-parity contract (pinned by ``tests/test_continuous_batching.py``): under
+per-row RNG (``GenerationConfig.per_row_rng``) every sequence's tokens /
+logprobs / values / mask are **bit-identical** to what plain ``generate``
+produces for that prompt at the same padded prompt width and batch size.
+The ingredients:
+
+- per-row key chains (``sampling.per_row_keys`` / ``split_row_keys``): a
+  row's sample stream depends only on (its key, its step), never on batch
+  composition or slot position;
+- per-slot ``cache_index`` vectors (the machinery the speculative path
+  already drove through ``models/transformer.py::Attention``): slots decode
+  at different depths inside one forward;
+- the refill is gather-prefill-scatter: only the ``R`` fresh prompts run a
+  prefill forward (same structure as plain ``generate``'s prefill —
+  ``logits_span=(P-1, P)``, slot-mask attention — at power-of-two bucket
+  batch sizes), then scatter into the freed slots with drop-mode indexing.
+  Total refill cost over a collection is the serial path's prefill cost
+  (every prompt prefills exactly once), NOT a full-batch forward per refill
+  event. Rows are row-independent in every dense op, so a row's prefill
+  output is bit-identical across batch sizes (pinned by the parity tests);
+- finished slots freeze (no buffer/step/rng writes), so harvested rows are
+  exactly what the plain loop would have produced, and refilling later
+  cannot disturb them.
+
+Host-side orchestration (queue, harvest order, stats) lives in
+``trlx_tpu/pipeline/continuous_batching.py``.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.ops.sampling import (
+    GenerationConfig,
+    last_step_info,
+    sample_token_from_logits,
+    split_row_keys,
+)
+
+__all__ = ["SlotState", "SlotRefillFns", "make_slot_refill_fns"]
+
+
+class SlotState(NamedTuple):
+    """Per-slot decode state threaded through refill/segment programs.
+
+    ``B`` slots over a ``[B, S = P + N]`` KV cache; all leaves static-shaped
+    so one compiled segment program serves the whole collection."""
+
+    tokens: jax.Array  # [B, N] response tokens (pad after eos)
+    logprobs: jax.Array  # [B, N] behavior logprobs
+    values: jax.Array  # [B, N] value-head outputs (0 if no head)
+    mask: jax.Array  # [B, N] 1 on real response tokens (incl. eos)
+    slot_mask: jax.Array  # [B, S] attention slot mask over the cache
+    cache: Any  # KV cache pytree ([B, S, ...] or scanned [L, B, S, ...])
+    logits: jax.Array  # [B, V] logits feeding the next sample
+    step_out: Any  # last-position model-output views (adjust_logits hook)
+    prompt_len: jax.Array  # [B] real (unpadded) prompt lengths
+    done: jax.Array  # [B] finished (or empty) slots — frozen in decode
+    step: jax.Array  # [B] per-slot decode step
+    rng: jax.Array  # [B, 2] per-slot key chains
+
+
+class SlotRefillFns(NamedTuple):
+    """The compiled slot-refill programs + static shape info."""
+
+    init_state: Callable[[], SlotState]  # fresh all-empty state (host-cheap)
+    # (params, state, ids [r,P], mask [r,P], slot_idx [r], keys [r,2]) —
+    # host wrapper that pads r to a power-of-two bucket and dispatches the
+    # cached compiled program for that bucket
+    refill_rows: Callable[..., SlotState]
+    refill_program: Callable[[int], Callable]  # bucket size → compiled fn
+    prewarm: Callable[[Any, SlotState], SlotState]  # once-per-fns bucket warmup
+    decode_segment: Callable[..., Tuple[SlotState, jax.Array, jax.Array]]
+    batch_size: int
+    prompt_len: int  # padded prompt width P (fixed per engine)
+    max_new_tokens: int
+
+
+def _row_where(flag: jax.Array, new: Any, old: Any) -> Any:
+    """Masked per-row merge for a pytree of ``[B, ...]`` leaves (batch axis
+    first). Scalar/None leaves pass through untouched."""
+    B = flag.shape[0]
+
+    def merge(n, o):
+        if n is None or not hasattr(n, "ndim") or n.ndim == 0:
+            return n
+        return jnp.where(flag.reshape((B,) + (1,) * (n.ndim - 1)), n, o)
+
+    return jax.tree_util.tree_map(merge, new, old, is_leaf=lambda x: x is None)
+
+
+def _row_set(buf: jax.Array, val: jax.Array, col: jax.Array, live: jax.Array) -> jax.Array:
+    """Write ``val[i]`` into ``buf[i, col[i]]`` for live rows; frozen rows
+    keep their buffer untouched (a finished-but-unharvested slot must never
+    be clobbered by clamped out-of-range writes)."""
+    written = jax.vmap(
+        lambda row, v, c: jax.lax.dynamic_update_slice(row, v[None], (c,))
+    )(buf, val.astype(buf.dtype), col)
+    return jnp.where(live[:, None], written, buf)
+
+
+def make_slot_refill_fns(
+    apply_fn: Callable[..., Dict[str, Any]],
+    init_cache_fn: Callable[[int, int], Any],
+    batch_size: int,
+    prompt_len: int,
+    config: GenerationConfig,
+    adjust_logits: Optional[Callable[[Dict[str, Any], jax.Array], jax.Array]] = None,
+    segment_len: int = 8,
+    params_example: Any = None,
+    jit: bool = True,
+) -> SlotRefillFns:
+    """Build the (jitted) slot-refill programs for one shape bucket.
+
+    ``apply_fn(params, input_ids, attention_mask, positions, cache,
+    cache_index, ...)`` is the model wrappers' ``__call__``;
+    ``params_example`` (real params or ShapeDtypeStructs) is needed once to
+    shape the ``step_out`` carry of the empty state via ``eval_shape`` —
+    nothing is executed. ``config.per_row_rng`` must be True: slot migration
+    is only stream-invariant under per-row key chains.
+    """
+    if not config.per_row_rng:
+        config = dataclasses.replace(config, per_row_rng=True)
+    B, P, N = batch_size, prompt_len, config.max_new_tokens
+    S = P + N
+
+    def empty_state() -> SlotState:
+        cache = init_cache_fn(B, S)
+        # step_out structure comes from an abstract prefill — shapes only
+        out_sds = jax.eval_shape(
+            lambda p, c: apply_fn(
+                p,
+                jnp.zeros((B, P), jnp.int32),
+                attention_mask=jnp.zeros((B, S), jnp.int32),
+                positions=None,
+                cache=c,
+                cache_index=jnp.asarray(0, jnp.int32),
+                logits_span=(P - 1, P),
+            ),
+            params_example,
+            cache,
+        )
+        step_out = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape[:1] + s.shape[2:], s.dtype),
+            last_step_info_abstract(out_sds),
+        )
+        step_out["last_tokens"] = jnp.zeros((B,), jnp.int32)
+        logits_sds = out_sds["logits"]
+        return SlotState(
+            tokens=jnp.full((B, N), config.pad_token_id, jnp.int32),
+            logprobs=jnp.zeros((B, N), jnp.float32),
+            values=jnp.zeros((B, N), jnp.float32),
+            mask=jnp.zeros((B, N), jnp.int32),
+            slot_mask=jnp.zeros((B, S), jnp.int32),
+            cache=cache,
+            # native model dtype: plain generate carries raw logits, and the
+            # adjust-logits hook must see identical bits in both samplers
+            logits=jnp.zeros((B, logits_sds.shape[-1]), logits_sds.dtype),
+            step_out=step_out,
+            prompt_len=jnp.zeros((B,), jnp.int32),
+            done=jnp.ones((B,), bool),  # empty slots never decode
+            step=jnp.zeros((B,), jnp.int32),
+            rng=jnp.zeros((B, 2), jnp.uint32),
+        )
+
+    def last_step_info_abstract(out_sds: Dict[str, Any]) -> Dict[str, Any]:
+        # eval_shape twin of sampling.last_step_info (keeps [B, 1, ...] dims
+        # so the zeros() above can drop the per-step axis uniformly)
+        from trlx_tpu.ops.sampling import _NON_CARRY_KEYS
+
+        return {
+            k: v
+            for k, v in out_sds.items()
+            if k not in _NON_CARRY_KEYS and v is not None
+        }
+
+    def _make_refill(R: int):
+        def refill(
+            params: Any,
+            state: SlotState,
+            input_ids: jax.Array,  # [R, P] left-padded fresh prompts
+            prompt_mask: jax.Array,  # [R, P]
+            slot_idx: jax.Array,  # [R] target slots; >= B = padding (dropped)
+            new_keys: jax.Array,  # [R, 2] per-row key chains
+        ) -> SlotState:
+            """Gather-prefill-scatter into freed cache slots: only the ``R``
+            refilled rows run the prefill forward (cost ``R·P`` tokens — the
+            exact serial-path prefill cost amortized over the run, instead
+            of a full ``B·P`` forward per refill event), then scatter into
+            the big state at ``slot_idx``. Out-of-range indices (the
+            power-of-two bucket padding) drop: every lane write is
+            deterministic, no duplicate-index races."""
+            input_ids = input_ids.astype(jnp.int32)
+            prompt_mask = prompt_mask.astype(jnp.int32)
+            slot_mask_r = jnp.concatenate(
+                [prompt_mask, jnp.zeros((R, N), jnp.int32)], axis=1
+            )
+            out = apply_fn(
+                params,
+                input_ids,
+                attention_mask=slot_mask_r,
+                positions=None,
+                cache=init_cache_fn(R, S),
+                cache_index=jnp.asarray(0, jnp.int32),
+                logits_span=(P - 1, P),
+            )
+            step_out_r = {**last_step_info(out), "last_tokens": input_ids[:, -1]}
+
+            def scat(big, rows):
+                if big is None or not hasattr(big, "ndim") or big.ndim == 0:
+                    return big
+                return big.at[slot_idx].set(rows.astype(big.dtype), mode="drop")
+
+            def scat_cache(big, rows):
+                if big.ndim - 4 == 0:
+                    return big.at[slot_idx].set(rows.astype(big.dtype), mode="drop")
+                # scanned layout [L, B, S, KV, D]: batch axis 1
+                return big.at[:, slot_idx].set(rows.astype(big.dtype), mode="drop")
+
+            tree_scat = lambda big, rows: jax.tree_util.tree_map(  # noqa: E731
+                scat, big, rows, is_leaf=lambda x: x is None
+            )
+            return SlotState(
+                tokens=scat(state.tokens, jnp.full((R, N), config.pad_token_id, jnp.int32)),
+                logprobs=scat(state.logprobs, jnp.zeros((R, N), jnp.float32)),
+                values=scat(state.values, jnp.zeros((R, N), jnp.float32)),
+                mask=scat(state.mask, jnp.zeros((R, N), jnp.int32)),
+                slot_mask=scat(state.slot_mask, slot_mask_r),
+                cache=jax.tree_util.tree_map(scat_cache, state.cache, out["cache"]),
+                logits=scat(state.logits, out["logits"][:, -1, :]),
+                step_out=tree_scat(state.step_out, step_out_r),
+                prompt_len=scat(state.prompt_len, jnp.sum(prompt_mask, axis=1)),
+                done=scat(state.done, jnp.zeros((R,), bool)),
+                step=scat(state.step, jnp.zeros((R,), jnp.int32)),
+                rng=scat(state.rng, new_keys),
+            )
+
+        return refill
+
+    _refill_cache: Dict[int, Callable] = {}
+    _warmed = {"done": False}
+
+    def refill_program(bucket: int) -> Callable:
+        """The compiled refill program for one power-of-two bucket size."""
+        if bucket not in _refill_cache:
+            fn = _make_refill(bucket)
+            _refill_cache[bucket] = jax.jit(fn) if jit else fn
+        return _refill_cache[bucket]
+
+    def prewarm(params: Any, state: SlotState) -> SlotState:
+        """Compile every refill bucket with dropped no-op calls (all
+        ``slot_idx = B``) so a collection's completion pattern never
+        triggers a mid-run XLA compile. Runs ONCE per fns — these programs
+        are cached per shape bucket, so later engines over the same fns
+        (one per ``make_experience`` call) skip straight through instead of
+        re-executing ~2·B·P tokens of dead prefill every collection.
+
+        The no-op results thread through ``state`` (content unchanged —
+        every write drops): jit's executable cache keys on input *placement*
+        as well as avals, and real refill calls always see computed
+        (committed) state leaves. The first bucket runs twice so even it
+        gets a committed-state cache entry."""
+        if _warmed["done"]:
+            return state
+        buckets = [1]
+        while buckets[-1] < B:
+            buckets.append(min(buckets[-1] * 2, B))
+        for bucket in [buckets[0]] + buckets:
+            state = refill_program(bucket)(
+                params,
+                state,
+                jnp.full((bucket, P), config.pad_token_id, jnp.int32),
+                jnp.zeros((bucket, P), jnp.int32),
+                jnp.full((bucket,), B, jnp.int32),  # out of range: drop
+                jnp.zeros((bucket, 2), jnp.asarray(state.rng).dtype),
+            )
+        _warmed["done"] = True
+        return state
+
+    def refill_rows(
+        params: Any,
+        state: SlotState,
+        input_ids: Any,  # [r, P] host or device rows, r <= B
+        prompt_mask: Any,
+        slot_idx: Any,  # [r] distinct target slots
+        new_keys: Any,
+    ) -> SlotState:
+        """Host wrapper: round ``r`` up to the next power-of-two bucket
+        (padding rows carry ``slot_idx = B`` and scatter-drop), so at most
+        ``log2(B)+1`` refill programs ever compile while the prefill cost
+        stays within 2× of the rows actually refilled."""
+        import numpy as np
+
+        input_ids = np.asarray(input_ids, np.int32)
+        prompt_mask = np.asarray(prompt_mask, np.int32)
+        slot_idx = np.asarray(slot_idx, np.int32)
+        new_keys = np.asarray(new_keys)
+        r = input_ids.shape[0]
+        bucket = 1
+        while bucket < r:
+            bucket *= 2
+        bucket = min(bucket, max(B, 1))
+        if bucket < r:  # r > B cannot happen (more rows than slots)
+            raise ValueError(f"refilling {r} rows into {B} slots")
+        if bucket > r:
+            pad = bucket - r
+            input_ids = np.concatenate(
+                [input_ids, np.full((pad, P), config.pad_token_id, np.int32)]
+            )
+            prompt_mask = np.concatenate([prompt_mask, np.zeros((pad, P), np.int32)])
+            slot_idx = np.concatenate([slot_idx, np.full((pad,), B, np.int32)])
+            new_keys = np.concatenate(
+                [new_keys, np.zeros((pad, 2), new_keys.dtype)]
+            )
+        return refill_program(bucket)(
+            params, state, jnp.asarray(input_ids), jnp.asarray(prompt_mask),
+            jnp.asarray(slot_idx), jnp.asarray(new_keys),
+        )
+
+    def decode_segment(params: Any, state: SlotState):
+        """Up to ``segment_len`` decode steps over live slots; early exit
+        when every slot is done. Returns ``(state, live_steps, steps_run)``
+        — the utilization numerators/denominators for
+        ``throughput/slot_utilization`` / ``rollout/padded_decode_frac``."""
+
+        def sample_step(carry):
+            st, live_steps, k = carry
+            new_rng, sample_rng = split_row_keys(st.rng)
+            next_token, logprob = sample_token_from_logits(
+                st.logits, st.step_out, sample_rng, config, st.step, adjust_logits
+            )
+            live = ~st.done
+            next_token = jnp.where(live, next_token, config.pad_token_id).astype(jnp.int32)
+            tokens = _row_set(st.tokens, next_token, st.step, live)
+            logprobs = _row_set(st.logprobs, jnp.where(live, logprob, 0.0), st.step, live)
+            value = st.step_out.get("value", jnp.zeros((B,), jnp.float32))
+            values = _row_set(st.values, jnp.where(live, value, 0.0), st.step, live)
+            mask = _row_set(st.mask, live.astype(jnp.int32), st.step, live)
+
+            done = st.done
+            if config.eos_token_id is not None:
+                done = done | (live & (next_token == config.eos_token_id))
+            # a live row that just wrote its N-th column is finished even
+            # without eos — plain generate's loop exits at step N; here the
+            # row must freeze so the next (clamped) write can't clobber its
+            # last column while it awaits harvest
+            done = done | (live & (st.step + 1 >= N))
+
+            slot = P + st.step  # [B] per-slot cache column
+            slot_mask = _row_set(st.slot_mask, live.astype(jnp.int32), slot, live)
+
+            out = apply_fn(
+                params,
+                next_token[:, None],
+                attention_mask=slot_mask,
+                positions=(st.prompt_len + st.step)[:, None],
+                cache=st.cache,
+                cache_index=slot,
+            )
+            step_out = {**last_step_info(out), "last_tokens": next_token}
+            new_st = SlotState(
+                tokens=tokens,
+                logprobs=logprobs,
+                values=values,
+                mask=mask,
+                slot_mask=slot_mask,
+                # the forward wrote every row's k/v at its own slot; done
+                # rows wrote into dead (masked) columns — harmless
+                cache=out["cache"],
+                logits=_row_where(live, out["logits"][:, -1, :], st.logits),
+                step_out=_row_where(live, step_out, st.step_out),
+                prompt_len=st.prompt_len,
+                done=done,
+                step=jnp.where(live, st.step + 1, st.step),
+                rng=_row_where(live, new_rng, st.rng),
+            )
+            return new_st, live_steps + jnp.sum(live.astype(jnp.int32)), k + 1
+
+        def cond(carry):
+            st, _, k = carry
+            return (k < segment_len) & ~jnp.all(st.done)
+
+        st, live_steps, steps = jax.lax.while_loop(
+            cond, sample_step, (state, jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
+        )
+        return st, live_steps, steps
+
+    if jit:
+        decode_segment = jax.jit(decode_segment)
+    return SlotRefillFns(
+        init_state=empty_state,
+        refill_rows=refill_rows,
+        refill_program=refill_program,
+        prewarm=prewarm,
+        decode_segment=decode_segment,
+        batch_size=B,
+        prompt_len=P,
+        max_new_tokens=N,
+    )

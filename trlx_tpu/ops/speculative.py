@@ -158,6 +158,14 @@ def generate_speculative(
     head is attached), the draft's just ``logits``. Fully jittable with
     static ``config``/``gamma``.
     """
+    if config.per_row_rng:
+        raise NotImplementedError(
+            "per_row_rng is not supported by the speculative sampler: its "
+            "accept/reject stream is round-structured, not per-step, so a "
+            "slot-position-invariant per-row chain has no lossless analogue "
+            "here. Use the plain sampler (no draft model) for "
+            "continuous-batching rollouts."
+        )
     B, P = input_ids.shape
     N = config.max_new_tokens
     G = gamma

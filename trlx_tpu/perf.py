@@ -42,11 +42,23 @@ TRAINER_PROGRAMS = {
     "sfttrainer": ("train_step",),
 }
 
+# Extra programs when train.continuous_batching is on: the refill prefill
+# and the segment decode replace plain generate's monolithic loop as the
+# rollout hot path (ops/slot_refill.py).
+CONTINUOUS_BATCHING_PROGRAMS = ("cb_refill", "cb_segment")
+
+
+def _config_programs(config: TRLConfig) -> Tuple[str, ...]:
+    programs = TRAINER_PROGRAMS[config.train.trainer.lower()]
+    if bool(getattr(config.train, "continuous_batching", False)):
+        programs = programs + CONTINUOUS_BATCHING_PROGRAMS
+    return programs
+
 
 def budget_programs() -> Dict[str, Tuple[str, ...]]:
     """Config name → the program set its budget must contain."""
     return {
-        name: TRAINER_PROGRAMS[config.train.trainer.lower()]
+        name: _config_programs(config)
         for name, (config, _) in budget_configs().items()
     }
 
@@ -183,6 +195,8 @@ def hot_program_costs(
     trainer_name = type(trainer).__name__.lower()
     if programs is None:
         programs = TRAINER_PROGRAMS.get(trainer_name, ("train_step",))
+        if bool(getattr(config.train, "continuous_batching", False)):
+            programs = programs + CONTINUOUS_BATCHING_PROGRAMS
 
     B, P, N = batch_size, prompt_len, gen_len
     SDS = jax.ShapeDtypeStruct
@@ -234,6 +248,42 @@ def hot_program_costs(
                     jax.random.PRNGKey(0),
                 )
             )
+
+        if any(p in programs for p in CONTINUOUS_BATCHING_PROGRAMS):
+            # the continuous-batching rollout programs: the on-demand refill
+            # prefill and the fixed-size segment decode (ops/slot_refill.py)
+            # — lowered over an abstract SlotState so nothing materializes
+            gen_kwargs = dict(trainer.generate_kwargs)
+            gen_kwargs["max_new_tokens"] = N
+            gen_kwargs["per_row_rng"] = True
+            gen_config = GenerationConfig.from_gen_kwargs(
+                gen_kwargs,
+                eos_token_id=trainer.tokenizer.eos_token_id,
+                pad_token_id=trainer.tokenizer.pad_token_id,
+            )
+            seg = max(
+                1,
+                int(getattr(config.train, "continuous_batching_segment", 8) or 8),
+            )
+            fns = trainer._get_slot_refill_fns(gen_config, (), B, P, seg)
+            state_sds = jax.eval_shape(fns.init_state)
+            if "cb_refill" in programs:
+                # the full-bucket (R = B) refill program: worst-case refill
+                # cost; smaller power-of-two buckets are strictly cheaper
+                results["cb_refill"] = _costs_of(
+                    fns.refill_program(B).lower(
+                        params,
+                        state_sds,
+                        batch_sds((B, P), np.int32),
+                        batch_sds((B, P), np.int32),
+                        SDS((B,), np.int32),
+                        SDS((B, 2), np.uint32),
+                    )
+                )
+            if "cb_segment" in programs:
+                results["cb_segment"] = _costs_of(
+                    fns.decode_segment.lower(params, state_sds)
+                )
 
         if "score" in programs:
             fn = trainer._get_score_fn((B, P, N))
@@ -324,6 +374,9 @@ def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
 
     - ``gpt2_test``: tiny PPO — exercised in the fast test tier so the net
       runs in the <5-min loop;
+    - ``gpt2_test_cb``: the same tiny PPO with ``train.continuous_batching``
+      — adds the slot-refill rollout programs (refill prefill + segment
+      decode) to the guarded set;
     - ``gpt2_small``: the flagship bench model (BASELINE.md);
     - ``gptj_6b_scan``: the large-model path — scan_layers + full remat, the
       program shape that runs on pods. Abstract weights: never materialized;
@@ -348,6 +401,17 @@ def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
     return {
         "gpt2_test": (
             base.evolve(
+                model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "gpt2_test_cb": (
+            # the continuous-batching rollout programs (refill prefill +
+            # segment decode) on the tiny config — guards the slot-refill
+            # hot path the same way gpt2_test guards plain generate
+            base.evolve(
+                train=dict(continuous_batching=True),
                 model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
                 tokenizer=dict(tokenizer_path="builtin:bytes"),
             ),

@@ -727,20 +727,14 @@ class TPUBaseTrainer(BaseRLTrainer):
             self._generate_fns[key] = jax.jit(fn)
         return self._generate_fns[key]
 
-    def generate(
-        self,
-        input_ids: np.ndarray,
-        attention_mask: Optional[np.ndarray] = None,
-        eval_mode: bool = False,
-        **kwargs,
-    ) -> GenerationOutput:
-        """Sample continuations for a left-padded prompt batch.
-
-        Rollout generation uses ``gen_experience_kwargs`` when configured
-        (reference ``generate`` vs ``generate_eval``,
-        ``accelerate_base_trainer.py:228-253``).
-        """
-        set_global_mesh(self.mesh)
+    def _resolve_gen_config(
+        self, eval_mode: bool = False, **kwargs
+    ) -> Tuple[GenerationConfig, Tuple[Tuple[str, Any], ...]]:
+        """Resolve (gen_config, extra_kwargs) the way :meth:`generate` does —
+        the shared seam for the plain sampler and the continuous-batching
+        engine, so both see identical sampling semantics. ``extra_kwargs``
+        are the non-GenerationConfig kwargs (hashable, for the program
+        caches and the ``adjust_logits_fn`` hook)."""
         base = (
             self.generate_kwargs
             if eval_mode or self.generate_experience_kwargs is None
@@ -763,6 +757,68 @@ class TPUBaseTrainer(BaseRLTrainer):
                 if k not in known
             )
         )
+        return gen_config, extra_kwargs
+
+    def _get_slot_refill_fns(
+        self,
+        gen_config: GenerationConfig,
+        extra_kwargs: Tuple[Tuple[str, Any], ...],
+        batch_size: int,
+        prompt_len: int,
+        segment_len: int,
+    ):
+        """Compiled slot-refill programs (refill prefill + segment decode)
+        for one shape bucket — the continuous-batching analogue of
+        :meth:`_get_generate_fn`, sharing its adjust-hook composition so the
+        engine samples exactly what plain ``generate`` would."""
+        if self.is_seq2seq:
+            raise NotImplementedError(
+                "train.continuous_batching supports causal LMs only: the "
+                "seq2seq decoder has no slot-refill path"
+            )
+        if self.draft_module is not None:
+            raise NotImplementedError(
+                "train.continuous_batching and speculative decoding "
+                "(model.draft_model_path) are mutually exclusive: the "
+                "accept/reject stream is not per-row-RNG invariant. Drop "
+                "one of the two."
+            )
+        import dataclasses as _dc
+
+        gen_config = _dc.replace(gen_config, per_row_rng=True)
+        key = ("slot_refill", gen_config, extra_kwargs, batch_size, prompt_len, segment_len)
+        if key not in self._generate_fns:
+            from trlx_tpu.ops.slot_refill import make_slot_refill_fns
+
+            adjust = self._compose_logit_mask(self.adjust_logits_fn(dict(extra_kwargs)))
+            tcfg = self.tcfg
+            self._generate_fns[key] = make_slot_refill_fns(
+                self._apply_fn(),
+                lambda B, S: make_kv_cache(tcfg, B, S),
+                batch_size,
+                prompt_len,
+                gen_config,
+                adjust_logits=adjust,
+                segment_len=segment_len,
+                params_example=self.state.params,
+            )
+        return self._generate_fns[key]
+
+    def generate(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: Optional[np.ndarray] = None,
+        eval_mode: bool = False,
+        **kwargs,
+    ) -> GenerationOutput:
+        """Sample continuations for a left-padded prompt batch.
+
+        Rollout generation uses ``gen_experience_kwargs`` when configured
+        (reference ``generate`` vs ``generate_eval``,
+        ``accelerate_base_trainer.py:228-253``).
+        """
+        set_global_mesh(self.mesh)
+        gen_config, extra_kwargs = self._resolve_gen_config(eval_mode, **kwargs)
         input_ids = np.asarray(input_ids, np.int32)
         if attention_mask is None:
             attention_mask = (input_ids != self.tokenizer.pad_token_id).astype(np.int32)
@@ -1052,6 +1108,9 @@ class TPUBaseTrainer(BaseRLTrainer):
                         tbar.close()
                         wait_for_saves()  # async saves must land before exit
                         self._export_observability()
+                        # flush/close the tracker (W&B runs must finalize;
+                        # JSONL transparently reopens if logged again)
+                        self.tracker.finish()
                         return results
 
                     self.tracker.log(stats, step=self.iter_count)
@@ -1063,6 +1122,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         tbar.close()
         wait_for_saves()  # async saves must land before exit
         self._export_observability()
+        self.tracker.finish()
         return results
 
     # ------------------------------------------------------------------

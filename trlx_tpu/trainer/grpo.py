@@ -22,7 +22,6 @@ import numpy as np
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.grpo_types import GRPORLElement
 from trlx_tpu.models.grpo import GRPOConfig, group_advantages_np
-from trlx_tpu.parallel import shard_batch
 from trlx_tpu.pipeline import BasePipeline
 from trlx_tpu.pipeline.grpo_pipeline import GRPORolloutStorage
 from trlx_tpu.trainer import register_trainer
@@ -93,21 +92,87 @@ class GRPOTrainer(PPOTrainer):
         extra.pop("kl_ctl_value", None)
         return extra
 
-    def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:
-        """Collect grouped rollouts with group-relative advantages."""
-        logger.info("Collecting GRPO rollouts")
-        if self.prompt_iterator is None:
-            raise RuntimeError("add_prompt_pipeline must be called before make_experience")
+    # the scoring-forward dispatch (async copies, recompile watchdog) is
+    # PPOTrainer._dispatch_score — shared with the chunked PPO device stage
+    # and the continuous-batching group flush
+
+    def _grpo_score_batch(
+        self,
+        prompt_ids: np.ndarray,  # [B, P] left-padded, group-contiguous rows
+        prompt_mask: np.ndarray,
+        response_tokens: np.ndarray,  # [B, N]
+        response_mask: np.ndarray,
+        elements: list,
+        agg: Dict[str, Any],
+        score_out=None,  # pre-dispatched scoring outputs (serial path)
+    ) -> None:
+        """Score + store one group-contiguous batch: scoring forward (policy
+        + hydra ref, async copies), host reward, clipping, group-relative
+        advantages, KL logging, element construction — the shared tail of
+        the serial chunk loop and the continuous-batching group flush."""
         method: GRPOConfig = self.config.method
         G = method.group_size
+        B, P = prompt_ids.shape
+        N = int(response_tokens.shape[1])
+        if score_out is None:
+            score_out = self._dispatch_score(
+                (B, P, N),
+                np.concatenate([prompt_ids, response_tokens], axis=1),
+                prompt_mask,
+                response_tokens,
+                response_mask,
+            )
 
-        stats: Dict[str, float] = {}
-        elements = []
-        kl_sum, kl_batches = 0.0, 0
-        gen_time_sum, score_time_sum = 0.0, 0.0
-        all_scores: list = []
-        exp_time = time()
+        samples, prompts, outputs = self.decode(
+            prompt_ids, response_tokens, append_eos_token=True
+        )
+        score_time = time()
+        scores = np.asarray(
+            self.reward_fn(samples=samples, prompts=prompts, outputs=outputs),
+            dtype=np.float32,
+        )
+        agg["score_time_sum"] += time() - score_time
+        host = to_host(score_out)
 
+        clip = method.cliprange_reward
+        if clip:
+            scores = np.clip(scores, -clip, clip)
+        self.running_moments.update(scores)  # logging only: the group
+        # normalization below IS the reward scaling in GRPO
+        agg["all_scores"].append(scores)
+        advantages = group_advantages_np(
+            scores, G, method.scale_advantage, baseline=method.baseline
+        )
+
+        # reference KL for logging (the loss recomputes it on device)
+        lp, rlp = np.asarray(host["logprobs"]), np.asarray(host["ref_logprobs"])
+        delta = (rlp - lp) * response_mask
+        n_tok = max(response_mask.sum(), 1)
+        mean_kl = float(((np.exp(delta) - delta - 1.0) * response_mask).sum() / n_tok)
+        agg["kl_sum"] += mean_kl
+        agg["kl_batches"] += 1
+
+        for i in range(B):
+            n_i = int(response_mask[i].sum())
+            if n_i == 0:
+                continue
+            elements.append(
+                GRPORLElement(
+                    query_tensor=prompt_ids[i][prompt_mask[i] > 0],
+                    response_tensor=response_tokens[i, :n_i],
+                    logprobs=lp[i, :n_i],
+                    ref_logprobs=rlp[i, :n_i],
+                    advantage=float(advantages[i]),
+                )
+            )
+
+    def _grpo_collect_serial(
+        self, num_rollouts: int, elements: list, agg: Dict[str, Any]
+    ) -> None:
+        """Chunked reference path: each prompt batch fans out into
+        ``group_size`` rows, generates to the slowest row, then scores."""
+        method: GRPOConfig = self.config.method
+        G = method.group_size
         while len(elements) < num_rollouts:
             batch = next(self.prompt_iterator)
             prompt_ids = np.repeat(np.asarray(batch["input_ids"], np.int32), G, axis=0)
@@ -117,20 +182,18 @@ class GRPOTrainer(PPOTrainer):
 
             gen_time = time()
             gen_out = self.generate(prompt_ids, prompt_mask)
+            # dispatch the scoring forward on the generation's device arrays
+            # FIRST: it needs nothing from the host, so it runs while the
+            # generation outputs land and reward_fn scores them
             B, P = prompt_ids.shape
             N = int(gen_out.response_tokens.shape[1])
-            score_fn = self._get_score_fn((B, P, N))
-            score_out = score_fn(
-                self.state.params,
-                self.ref_params,
+            score_out = self._dispatch_score(
+                (B, P, N),
                 gen_out.sequences,
-                shard_batch({"prompt_mask": prompt_mask}, self.mesh)["prompt_mask"],
+                prompt_mask,
                 gen_out.response_tokens,
                 gen_out.response_mask,
             )
-            for leaf in jax.tree_util.tree_leaves(score_out):
-                if hasattr(leaf, "copy_to_host_async"):
-                    leaf.copy_to_host_async()
             host_gen = to_host(
                 {
                     "response_tokens": gen_out.response_tokens,
@@ -139,59 +202,140 @@ class GRPOTrainer(PPOTrainer):
             )
             response_tokens = np.asarray(host_gen["response_tokens"])
             response_mask = np.asarray(host_gen["response_mask"])
-            gen_time_sum += time() - gen_time
-
-            samples, prompts, outputs = self.decode(
-                prompt_ids, response_tokens, append_eos_token=True
+            agg["gen_time_sum"] += time() - gen_time
+            # slot accounting (docs/PERFORMANCE.md): this chunk's decode ran
+            # max(n_i) steps over B slots — same mask-derived gauges as
+            # PPO's chunked paths, so a serial-vs-CB A/B compares them
+            n_per_row = response_mask.sum(axis=1)
+            agg["slot_steps"] += int(response_mask.shape[0]) * (
+                int(n_per_row.max()) if n_per_row.size else 0
             )
-            score_time = time()
-            scores = np.asarray(
-                self.reward_fn(samples=samples, prompts=prompts, outputs=outputs),
-                dtype=np.float32,
-            )
-            score_time_sum += time() - score_time
-            host = to_host(score_out)
+            agg["live_slot_steps"] += int(n_per_row.sum())
 
-            clip = method.cliprange_reward
-            if clip:
-                scores = np.clip(scores, -clip, clip)
-            self.running_moments.update(scores)  # logging only: the group
-            # normalization below IS the reward scaling in GRPO
-            all_scores.append(scores)
-            advantages = group_advantages_np(
-                scores, G, method.scale_advantage, baseline=method.baseline
+            self._grpo_score_batch(
+                prompt_ids, prompt_mask, response_tokens, response_mask,
+                elements, agg, score_out=score_out,
             )
 
-            # reference KL for logging (the loss recomputes it on device)
-            lp, rlp = np.asarray(host["logprobs"]), np.asarray(host["ref_logprobs"])
-            delta = (rlp - lp) * response_mask
-            n_tok = max(response_mask.sum(), 1)
-            mean_kl = float(((np.exp(delta) - delta - 1.0) * response_mask).sum() / n_tok)
-            kl_sum += mean_kl
-            kl_batches += 1
+    def _grpo_collect_continuous(
+        self, num_rollouts: int, elements: list, agg: Dict[str, Any]
+    ) -> None:
+        """Continuous-batching collection with *group-aware* harvest: slots
+        refill from the prompt queue as individual rollouts finish; a group
+        becomes ready when its last member completes, and ready groups flush
+        into group-contiguous score batches in completion order — the chunk
+        barrier (every group waiting for the whole chunk's slowest row) is
+        gone, while the group-relative advantage math is untouched."""
+        from collections import deque
 
-            for i in range(B):
-                n_i = int(response_mask[i].sum())
-                if n_i == 0:
-                    continue
-                elements.append(
-                    GRPORLElement(
-                        query_tensor=prompt_ids[i][prompt_mask[i] > 0],
-                        response_tensor=response_tokens[i, :n_i],
-                        logprobs=lp[i, :n_i],
-                        ref_logprobs=rlp[i, :n_i],
-                        advantage=float(advantages[i]),
-                    )
+        if num_rollouts <= 0:
+            return
+        method: GRPOConfig = self.config.method
+        G = method.group_size
+        gen_config, extra_kwargs = self._resolve_gen_config(eval_mode=False)
+        groups_per_batch = max(method.chunk_size // G, 1)
+        state: Dict[str, Any] = {
+            "engine": None, "supplied": 0, "processed": 0, "next_group": 0,
+        }
+        partial: Dict[int, list] = {}  # group id → completed members
+        ready: deque = deque()  # fully-completed groups, completion order
+
+        def fetch_chunk() -> None:
+            batch = next(self.prompt_iterator)
+            ids = np.repeat(np.asarray(batch["input_ids"], np.int32), G, axis=0)
+            mask = np.repeat(np.asarray(batch["attention_mask"], np.int32), G, axis=0)
+            keys = self._cb_chunk_keys(ids.shape[0])
+            metas = [
+                (state["next_group"] + r // G, r % G) for r in range(ids.shape[0])
+            ]
+            state["next_group"] += ids.shape[0] // G
+            if state["engine"] is None:
+                state["engine"] = self._cb_make_engine(
+                    gen_config, extra_kwargs, ids.shape[0], ids.shape[1]
                 )
+            state["engine"].enqueue_prompts(ids, mask, keys, metas=metas)
+            state["supplied"] += ids.shape[0]
 
-        self.mean_kl = kl_sum / max(kl_batches, 1)
+        def flush(n_groups: int) -> None:
+            rows = [
+                member
+                for _ in range(n_groups)
+                for member in sorted(ready.popleft(), key=lambda c: c.meta[1])
+            ]
+            state["processed"] += len(rows)
+            self._grpo_score_batch(
+                np.stack([c.prompt_ids for c in rows]).astype(np.int32),
+                np.stack([c.prompt_mask for c in rows]).astype(np.int32),
+                np.stack([c.tokens for c in rows]).astype(np.int32),
+                np.stack([c.mask for c in rows]).astype(np.int32),
+                elements,
+                agg,
+            )
+
+        while True:
+            while (
+                len(elements) + state["supplied"] - state["processed"] < num_rollouts
+            ):
+                fetch_chunk()
+            engine = state["engine"]
+            if not engine.busy:
+                if ready:
+                    flush(len(ready))
+                if len(elements) >= num_rollouts:
+                    break
+                continue
+            for c in engine.step():
+                members = partial.setdefault(c.meta[0], [])
+                members.append(c)
+                if len(members) == G:
+                    ready.append(partial.pop(c.meta[0]))
+            while len(ready) >= groups_per_batch:
+                flush(groups_per_batch)
+
+        agg["gen_time_sum"] += engine.stats.decode_s + engine.stats.refill_s
+        agg["engine_stats"] = engine.stats
+
+    def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:
+        """Collect grouped rollouts with group-relative advantages."""
+        logger.info("Collecting GRPO rollouts")
+        if self.prompt_iterator is None:
+            raise RuntimeError("add_prompt_pipeline must be called before make_experience")
+
+        stats: Dict[str, float] = {}
+        elements: list = []
+        agg: Dict[str, Any] = {
+            "kl_sum": 0.0, "kl_batches": 0, "all_scores": [],
+            "gen_time_sum": 0.0, "score_time_sum": 0.0,
+            "slot_steps": 0, "live_slot_steps": 0,
+        }
+        exp_time = time()
+
+        if bool(getattr(self.config.train, "continuous_batching", False)):
+            self._grpo_collect_continuous(num_rollouts, elements, agg)
+        else:
+            self._grpo_collect_serial(num_rollouts, elements, agg)
+
+        self.mean_kl = agg["kl_sum"] / max(agg["kl_batches"], 1)
         stats["policy/sqrt_ref_kl"] = float(np.sqrt(max(self.mean_kl, 0.0)))
-        stats["time/exp_generate"] = gen_time_sum
+        stats["time/exp_generate"] = agg["gen_time_sum"]
         stats.update(self.last_spec_stats)
-        stats["time/exp_score"] = score_time_sum
+        stats["time/exp_score"] = agg["score_time_sum"]
+        all_scores = agg["all_scores"]
         pooled = np.concatenate(all_scores) if all_scores else np.zeros((0,), np.float32)
         stats["exp_scores/mean"] = float(pooled.mean()) if pooled.size else 0.0
         stats["exp_scores/std"] = float(pooled.std()) if pooled.size else 0.0
+        engine_stats = agg.get("engine_stats")
+        if engine_stats is not None:
+            stats.update(engine_stats.metrics())
+        elif agg["slot_steps"]:
+            # mask-derived slot gauges on the serial path (the CB branch
+            # reports the engine's exact counters above)
+            stats["throughput/slot_utilization"] = (
+                agg["live_slot_steps"] / agg["slot_steps"]
+            )
+            stats["rollout/padded_decode_frac"] = (
+                1.0 - agg["live_slot_steps"] / agg["slot_steps"]
+            )
         stats["time/exp"] = time() - exp_time
         self.make_experience_stats = stats
         self.tracker.log(stats, step=iter_count)

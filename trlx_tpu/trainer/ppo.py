@@ -33,6 +33,7 @@ from trlx_tpu.data.ppo_types import PPORLElement
 from trlx_tpu.models.builder import hydra_ref_params
 from trlx_tpu.models.ppo import PPOConfig, kl_penalty_rewards_np
 from trlx_tpu.models.transformer import CausalTransformer
+from trlx_tpu.ops.sampling import GenerationOutput
 from trlx_tpu.parallel import shard_batch
 from trlx_tpu.pipeline import BasePipeline
 from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
@@ -295,6 +296,47 @@ class PPOTrainer(TPUBaseTrainer):
     # equivalent to the serial schedule: the store is bit-identical under a
     # fixed seed (tests/test_rollout_pipeline.py pins this).
 
+    def _dispatch_score(
+        self,
+        shape: Tuple[int, int, int],  # (B, P, N)
+        sequences,  # [B, P+N] device rows (chunked paths) or host rows (CB)
+        prompt_mask,
+        response_tokens,
+        response_mask,
+    ):
+        """Dispatch the scoring forward and start its async device→host
+        copies — the single home of the dispatch tail (recompile watchdog,
+        async copies) shared by the chunked device stage, the continuous-
+        batching group flush, and GRPO. ``shard_batch`` is a no-copy
+        ``device_put`` for already-placed device arrays, so feeding the
+        generation's outputs straight through costs nothing."""
+        score_fn = self._get_score_fn(shape)
+        batch = shard_batch(
+            {
+                "sequences": sequences,
+                "prompt_mask": prompt_mask,
+                "response_tokens": response_tokens,
+                "response_mask": response_mask,
+            },
+            self.mesh,
+        )
+        score_out = score_fn(
+            self.state.params,
+            self.ref_params,
+            batch["sequences"],
+            batch["prompt_mask"],
+            batch["response_tokens"],
+            batch["response_mask"],
+        )
+        self.obs.recompile.observe("score", score_fn)
+        # start the device→host copies of the scoring outputs without
+        # blocking: by the time the host stage asks for these arrays they
+        # have usually landed
+        for leaf in jax.tree_util.tree_leaves(score_out):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        return score_out
+
     def _rollout_chunk_device(self, stats: Dict[str, float]) -> Dict[str, Any]:
         """Main-thread device side of one chunk: prompt fetch, generation,
         and the scoring-forward dispatch with async device→host copies."""
@@ -315,22 +357,13 @@ class PPOTrainer(TPUBaseTrainer):
         # the host stage decodes strings and calls reward_fn
         B, P = prompt_ids.shape
         N = int(gen_out.response_tokens.shape[1])
-        score_fn = self._get_score_fn((B, P, N))
-        score_out = score_fn(
-            self.state.params,
-            self.ref_params,
+        score_out = self._dispatch_score(
+            (B, P, N),
             gen_out.sequences,
-            shard_batch({"prompt_mask": prompt_mask}, self.mesh)["prompt_mask"],
+            prompt_mask,
             gen_out.response_tokens,
             gen_out.response_mask,
         )
-        self.obs.recompile.observe("score", score_fn)
-        # start the device→host copies of the scoring outputs without
-        # blocking: by the time the host stage asks for these arrays they
-        # have usually landed
-        for leaf in jax.tree_util.tree_leaves(score_out):
-            if hasattr(leaf, "copy_to_host_async"):
-                leaf.copy_to_host_async()
         return {
             "prompt_ids": prompt_ids,
             "prompt_mask": prompt_mask,
@@ -428,6 +461,17 @@ class PPOTrainer(TPUBaseTrainer):
         acc["chunks"] += 1
         stats["policy/sqrt_kl"] = float(np.sqrt(max(mean_kl, 0.0)))
 
+        # slot accounting (docs/PERFORMANCE.md): a chunk's decode ran
+        # max(n_i) steps over B slots (per-sample eos early-exit ends the
+        # while_loop at the longest row) — rows past their own eos burned
+        # padded slot-steps. The continuous-batching path replaces these
+        # numbers with the engine's exact counters.
+        n_per_row = response_mask.sum(axis=1)
+        acc["slot_steps"] += int(response_mask.shape[0]) * (
+            int(n_per_row.max()) if n_per_row.size else 0
+        )
+        acc["live_slot_steps"] += int(n_per_row.sum())
+
         prompt_ids, prompt_mask = chunk["prompt_ids"], chunk["prompt_mask"]
         for i in range(prompt_ids.shape[0]):
             n_i = int(response_mask[i].sum())
@@ -515,6 +559,179 @@ class PPOTrainer(TPUBaseTrainer):
             time() - t0
         )
 
+    # ------------------------------------------------------------------
+    # continuous batching (train.continuous_batching)
+    # ------------------------------------------------------------------
+
+    def _cb_group_device(self, group: list) -> Dict[str, Any]:
+        """Device side of one harvested group: assemble the score batch from
+        individually completed sequences and dispatch the scoring forward
+        with async device→host copies — the same ``dev`` contract as
+        :meth:`_rollout_chunk_device`, so the host/finalize stages are
+        shared verbatim with the chunked paths."""
+        prompt_ids = np.stack([c.prompt_ids for c in group]).astype(np.int32)
+        prompt_mask = np.stack([c.prompt_mask for c in group]).astype(np.int32)
+        response_tokens = np.stack([c.tokens for c in group]).astype(np.int32)
+        response_mask = np.stack([c.mask for c in group]).astype(np.int32)
+        gen_out = GenerationOutput(
+            sequences=np.concatenate([prompt_ids, response_tokens], axis=1),
+            response_tokens=response_tokens,
+            response_mask=response_mask,
+            response_logprobs=np.stack([c.logprobs for c in group]),
+            response_values=np.stack([c.values for c in group]),
+            prompt_mask=prompt_mask,
+        )
+        B, P = prompt_ids.shape
+        N = int(response_tokens.shape[1])
+        score_out = self._dispatch_score(
+            (B, P, N),
+            np.asarray(gen_out.sequences),
+            prompt_mask,
+            response_tokens,
+            response_mask,
+        )
+        return {
+            "prompt_ids": prompt_ids,
+            "prompt_mask": prompt_mask,
+            "gen_out": gen_out,
+            "score_out": score_out,
+        }
+
+    def _cb_make_engine(self, gen_config, extra_kwargs, rows: int, chunk_width: int):
+        """Build the slot-refill engine for this trainer — the single home of
+        the engine-width invariant (PPO and GRPO must agree): the trainer-
+        level prompt budget ``seq_length − max_new_tokens``, bumped to the
+        first chunk's collation width if a loader pads wider. Prompt loaders
+        pad to the longest row per batch, and the engine's one compiled
+        shape must fit every chunk; narrower chunks left-pad
+        (attention-masked, so harvested sequences stay bit-identical to
+        plain generate at THIS width)."""
+        from trlx_tpu.pipeline.continuous_batching import ContinuousBatchingEngine
+
+        seg = max(
+            1, int(getattr(self.config.train, "continuous_batching_segment", 8) or 8)
+        )
+        engine_p = max(
+            int(self.config.train.seq_length) - gen_config.max_new_tokens,
+            chunk_width,
+        )
+        fns = self._get_slot_refill_fns(gen_config, extra_kwargs, rows, engine_p, seg)
+        return ContinuousBatchingEngine(
+            fns, self.state.params, self.tokenizer.pad_token_id, span=self.obs.span
+        )
+
+    def _cb_chunk_keys(self, rows: int) -> np.ndarray:
+        """Per-row RNG chain starts for one prompt chunk: one rng split per
+        chunk, then ``fold_in(row)`` — the exact chain plain generate
+        derives in per_row_rng mode, so every prompt's sample stream is
+        reproducible by the serial sampler."""
+        from trlx_tpu.ops.sampling import per_row_keys
+
+        self._rollout_rng, call_rng = jax.random.split(self._rollout_rng)
+        return np.asarray(per_row_keys(call_rng, rows))
+
+    def _collect_continuous(
+        self, num_rollouts: int, depth: int, elements: list,
+        stats: Dict[str, float], acc: Dict[str, float],
+    ) -> None:
+        """Continuous-batching collection: slot-refill segment decode keeps
+        the device batch full while finished sequences stream — harvested
+        individually at segment boundaries, grouped into score batches in
+        completion order — through the scoring forward and (when
+        ``rollout_pipeline_depth`` > 0) the PR-2 host pipeline. Per-sequence
+        sampling is bit-identical to plain ``generate`` under per-row RNG;
+        the chunk barrier of the serial path is gone, so the store matches
+        the serial-with-per-row-RNG store up to sequence order
+        (tests/test_continuous_batching.py)."""
+        from contextlib import ExitStack
+
+        from trlx_tpu.pipeline.rollout_pipeline import RolloutPipeline
+
+        if num_rollouts <= 0:
+            stats["throughput/rollout_overlap_frac"] = 0.0
+            return
+        gen_config, extra_kwargs = self._resolve_gen_config(eval_mode=False)
+        state = {"engine": None, "supplied": 0, "finalized_rows": 0}
+        harvest_buf: list = []
+
+        def fetch_chunk() -> None:
+            batch = next(self.prompt_iterator)
+            ids = np.asarray(batch["input_ids"], np.int32)
+            mask = np.asarray(batch["attention_mask"], np.int32)
+            keys = self._cb_chunk_keys(ids.shape[0])
+            if state["engine"] is None:
+                state["engine"] = self._cb_make_engine(
+                    gen_config, extra_kwargs, ids.shape[0], ids.shape[1]
+                )
+            state["engine"].enqueue_prompts(ids, mask, keys)
+            state["supplied"] += ids.shape[0]
+
+        def finalize(chunk: Dict[str, Any]) -> None:
+            state["finalized_rows"] += int(chunk["prompt_ids"].shape[0])
+            self._rollout_chunk_finalize(chunk, elements, stats, acc)
+
+        t0 = time()
+        with ExitStack() as ctx:
+            pipe = None
+            if depth > 0:
+                pipe = ctx.enter_context(
+                    RolloutPipeline(
+                        depth=depth, finalize=finalize, name="rollout",
+                        tracer=self.obs.tracer,
+                    )
+                )
+
+            def submit_group(group: list) -> None:
+                dev = self._cb_group_device(group)
+                if pipe is None:
+                    finalize(self._rollout_chunk_host(dev))
+                    return
+
+                def work(dev=dev):
+                    with self.obs.span("rollout/overlap") as sp:
+                        sp.fence(dev["score_out"])
+                        return self._rollout_chunk_host(dev)
+
+                pipe.submit(work)
+
+            while True:
+                # supply so the queue can (expected-case) cover the target;
+                # every supplied row yields an element unless its response
+                # is empty, in which case the drain below tops up
+                while (
+                    len(elements) + state["supplied"] - state["finalized_rows"]
+                    < num_rollouts
+                ):
+                    fetch_chunk()
+                engine = state["engine"]
+                B = engine.B
+                if not engine.busy:
+                    while harvest_buf:  # flush the (possibly partial) tail
+                        group, harvest_buf = harvest_buf[:B], harvest_buf[B:]
+                        submit_group(group)
+                    if pipe is not None:
+                        pipe.drain()
+                    if len(elements) >= num_rollouts:
+                        break
+                    continue
+                harvest_buf.extend(engine.step())
+                while len(harvest_buf) >= B:
+                    group, harvest_buf = harvest_buf[:B], harvest_buf[B:]
+                    submit_group(group)
+            if pipe is not None:
+                stats["throughput/rollout_overlap_frac"] = pipe.stats.overlap_frac(
+                    time() - t0
+                )
+            else:
+                stats["throughput/rollout_overlap_frac"] = 0.0
+
+        engine = state["engine"]
+        if engine is not None:
+            # exact on-device counters replace the mask-derived estimates
+            stats.update(engine.stats.metrics())
+            stats["time/exp_generate"] = engine.stats.decode_s + engine.stats.refill_s
+            stats["time/generate"] = engine.stats.decode_s
+
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:
         """Collect ``num_rollouts`` experiences into the store (reference
         ``accelerate_ppo_trainer.py:251-489``), overlapping device generation
@@ -524,15 +741,19 @@ class PPOTrainer(TPUBaseTrainer):
             raise RuntimeError("add_prompt_pipeline must be called before make_experience")
 
         depth = int(getattr(self.config.train, "rollout_pipeline_depth", 0) or 0)
+        continuous = bool(getattr(self.config.train, "continuous_batching", False))
         stats: Dict[str, float] = {}
         elements: list = []
         acc: Dict[str, float] = {
             "kl_sum": 0.0, "kl_batches": 0, "host_s": 0.0,
             "gen_tokens": 0, "chunks": 0,
+            "slot_steps": 0, "live_slot_steps": 0,
         }
         exp_time = time()
 
-        if depth > 0:
+        if continuous:
+            self._collect_continuous(num_rollouts, depth, elements, stats, acc)
+        elif depth > 0:
             self._collect_pipelined(num_rollouts, depth, elements, stats, acc)
         else:
             self._collect_serial(num_rollouts, elements, stats, acc)
@@ -549,6 +770,18 @@ class PPOTrainer(TPUBaseTrainer):
         stats["time/rollout"] = total / max(acc["chunks"], 1)
         if total > 0 and acc["gen_tokens"]:
             stats["throughput/rollout_tokens_per_sec"] = acc["gen_tokens"] / total
+        # slot accounting, uniform across modes (continuous batching already
+        # set these from the engine's exact counters; the chunked paths
+        # derive them from response masks — see docs/PERFORMANCE.md)
+        if acc["slot_steps"]:
+            stats.setdefault(
+                "throughput/slot_utilization",
+                acc["live_slot_steps"] / acc["slot_steps"],
+            )
+            stats.setdefault(
+                "rollout/padded_decode_frac",
+                1.0 - acc["live_slot_steps"] / acc["slot_steps"],
+            )
         self.make_experience_stats = stats
         self.tracker.log(stats, step=iter_count)
 
