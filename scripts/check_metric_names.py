@@ -4,9 +4,12 @@
 
 A grep-shaped check, deliberately dumb: it scans source text for string
 subscripts on variables named ``stats`` (``stats["time/step"]``,
-``stats[f"reward/mean{suffix}"]``) and asserts each literal key contains a
-``/`` separating a lowercase namespace from a name. Keys that predate the
-convention live in ``LEGACY_KEYS`` — shrink that set, never grow it.
+``stats[f"reward/mean{suffix}"]``) — plus metric-registry call sites
+(``metrics.inc("resilience/reward_retries")``, ``metrics.set_gauge(...)``),
+which is how the resilience counters reach the tracker stream — and asserts
+each literal key contains a ``/`` separating a lowercase namespace from a
+name. Keys that predate the convention live in ``LEGACY_KEYS`` — shrink
+that set, never grow it.
 
 Exit code 0 when clean; 1 with a per-site listing otherwise. Wired into the
 fast test tier as ``tests/test_metric_names.py``.
@@ -21,7 +24,14 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCAN_DIR = os.path.join(REPO_ROOT, "trlx_tpu")
 
 # \bstats\[ : the dict must be *named* stats (not spec_stats, device_stats…)
-_KEY_RE = re.compile(r'\bstats\[\s*f?"([^"]+)"')
+# Second alternative: MetricsRegistry writes — receivers named/suffixed
+# "metrics" calling inc()/set_gauge() with a literal first argument (the
+# registry's observe() is excluded: RecompileWatchdog.observe's first arg is
+# a program name, not a metric key).
+_KEY_RE = re.compile(
+    r'\bstats\[\s*f?"([^"]+)"'
+    r'|\bmetrics\.(?:inc|set_gauge)\(\s*f?"([^"]+)"'
+)
 
 # namespace/name: lowercase_snake namespace, then anything non-empty (names
 # may carry f-string fields, sweep suffixes, dots, @-qualifiers)
@@ -32,6 +42,27 @@ _CONVENTION_RE = re.compile(r"^[a-z][a-z0-9_]*/\S+$")
 LEGACY_KEYS = frozenset({
     "learning_rate",
     "kl_ctl_value",
+})
+
+# Canonical resilience/* metric keys (docs/RESILIENCE.md). The retry
+# counters are emitted through a parameterized helper
+# (HostCallGuard._inc(f"resilience/{name}_retries")) the static scan can't
+# see, so the full set is registered here; tests/test_metric_names.py
+# asserts every entry follows the convention and that the statically
+# visible ones reach the scanner.
+RESILIENCE_KEYS = frozenset({
+    "resilience/update_ok",
+    "resilience/nonfinite_updates",
+    "resilience/skipped_updates",
+    "resilience/rollbacks",
+    "resilience/goodput_frac",
+    "resilience/preemptions",
+    "resilience/reward_retries",
+    "resilience/reward_failures",
+    "resilience/reward_fallbacks",
+    "resilience/publish_retries",
+    "resilience/publish_failures",
+    "resilience/publish_fallbacks",
 })
 
 
@@ -45,7 +76,8 @@ def find_violations(scan_dir: str = SCAN_DIR) -> List[Tuple[str, int, str]]:
             path = os.path.join(dirpath, filename)
             with open(path) as f:
                 for lineno, line in enumerate(f, start=1):
-                    for key in _KEY_RE.findall(line):
+                    for groups in _KEY_RE.findall(line):
+                        key = groups[0] or groups[1]
                         if key in LEGACY_KEYS or _CONVENTION_RE.match(key):
                             continue
                         violations.append(
@@ -64,7 +96,8 @@ def scanned_keys(scan_dir: str = SCAN_DIR) -> Dict[str, int]:
                 continue
             with open(os.path.join(dirpath, filename)) as f:
                 for line in f:
-                    for key in _KEY_RE.findall(line):
+                    for groups in _KEY_RE.findall(line):
+                        key = groups[0] or groups[1]
                         counts[key] = counts.get(key, 0) + 1
     return counts
 

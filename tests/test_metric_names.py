@@ -47,6 +47,25 @@ def test_scanner_sees_the_codebase():
     assert "rollout/refill_prefills" in keys
     assert "rollout/refilled_rows" in keys
     assert "rollout/segments" in keys
+    # resilience keys (docs/RESILIENCE.md): the statically visible sites —
+    # the on-device guard flag and the registry writes for preemption/goodput
+    assert "resilience/update_ok" in keys
+    assert "resilience/preemptions" in keys
+    assert "resilience/goodput_frac" in keys
+
+
+def test_resilience_keys_registered_and_namespaced():
+    """Every canonical resilience/* key (docs/RESILIENCE.md) is registered
+    in the checker and follows the namespace/name convention — including
+    the retry counters the static scan can't see."""
+    checker = _load_checker()
+    assert checker.RESILIENCE_KEYS, "resilience key registry is empty"
+    for key in checker.RESILIENCE_KEYS:
+        assert checker._CONVENTION_RE.match(key), key
+    # the guard flag and registry writes must also be visible to the scanner
+    keys = checker.scanned_keys()
+    visible = {k for k in checker.RESILIENCE_KEYS if k in keys}
+    assert {"resilience/update_ok", "resilience/preemptions"} <= visible
 
 
 def test_lint_catches_a_bad_key(tmp_path):

@@ -1,0 +1,654 @@
+"""Fault-injection suite for ``trlx_tpu/resilience`` (docs/RESILIENCE.md).
+
+Everything here runs on the 8-device virtual CPU mesh in the fast tier: the
+FaultPlan makes preemption, NaN losses, flaky reward endpoints, and crashed
+checkpoint commits *deterministic*, so end-to-end recovery is provable
+without hardware or real signals from a scheduler.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import trlx_tpu.trlx as trlx
+from trlx_tpu.data.default_configs import default_ppo_config, default_sft_config
+from trlx_tpu.resilience import (
+    FaultPlan,
+    HostCallGuard,
+    InjectedFault,
+    NonFiniteUpdateError,
+    ResilientTracker,
+    TrainingPreempted,
+    UpdateGuard,
+    neutral_rewards,
+    set_active_plan,
+)
+from trlx_tpu.observability.metrics import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state(monkeypatch):
+    """Each test starts with no process-active plan and no MFU analysis
+    thread (its background AOT compile is noise for these runs)."""
+    monkeypatch.setenv("TRLX_TPU_MFU", "0")
+    monkeypatch.delenv("TRLX_TPU_FAULT_PLAN", raising=False)
+    set_active_plan(None)
+    yield
+    set_active_plan(None)
+
+
+def ppo_config(tmp_path, **overrides):
+    cfg = default_ppo_config().evolve(
+        train=dict(
+            seq_length=48,
+            batch_size=8,
+            total_steps=4,
+            eval_interval=2,
+            checkpoint_interval=2,
+            epochs=2,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+            logging_dir=str(tmp_path / "logs"),
+            tracker="jsonl",
+        ),
+        model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+        method=dict(
+            num_rollouts=8,
+            chunk_size=8,
+            ppo_epochs=2,
+            gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    return cfg.evolve(**overrides) if overrides else cfg
+
+
+PROMPTS = ["hello world", "the quick brown fox", "lorem ipsum", "foo bar"] * 4
+
+
+def letter_reward(samples, prompts, outputs, **kwargs):
+    return [float(sum(c in "aeiou" for c in o)) for o in outputs]
+
+
+def _records(config):
+    path = os.path.join(config.train.logging_dir, "stats.jsonl")
+    return [json.loads(l) for l in open(path)]
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(jax.device_get(tree))
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_parse_and_fire(self):
+        plan = FaultPlan.parse(
+            "reward_raise@call:3*2; sigterm@step:5; crash_save@save:2"
+        )
+        assert [s.kind for s in plan.specs] == [
+            "reward_raise", "sigterm", "crash_save",
+        ]
+        # call-triggered: attempts 3 and 4 fire
+        assert [plan.poll("reward_raise") for _ in range(5)] == [
+            False, False, True, True, False,
+        ]
+        # step-triggered: idempotent poll against the caller's counter
+        assert not plan.poll("sigterm", step=4)
+        assert plan.poll("sigterm", step=5)
+        assert plan.poll("sigterm", step=5)
+        # save-triggered rides the call counter of its own kind
+        assert [plan.poll("crash_save") for _ in range(3)] == [False, True, False]
+        assert plan.fired["reward_raise"] == 2
+
+    def test_empty_and_env_override(self, monkeypatch):
+        assert not FaultPlan.parse(None)
+        assert not FaultPlan.parse("  ")
+        monkeypatch.setenv("TRLX_TPU_FAULT_PLAN", "nan_loss@step:1")
+        plan = FaultPlan.from_config("sigterm@step:9")
+        assert [s.kind for s in plan.specs] == ["nan_loss"]
+
+    @pytest.mark.parametrize(
+        "bad", ["bogus@step:1", "nan_loss@tick:1", "nan_loss@step:x", "nan_loss"]
+    )
+    def test_parse_errors(self, bad):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+
+# ---------------------------------------------------------------------------
+# HostCallGuard / ResilientTracker
+# ---------------------------------------------------------------------------
+
+
+class TestHostCallGuard:
+    def test_retries_then_success(self):
+        calls, delays = [], []
+        metrics = MetricsRegistry()
+
+        def flaky(x):
+            calls.append(x)
+            if len(calls) < 3:
+                raise RuntimeError("transient")
+            return x * 2
+
+        guard = HostCallGuard(
+            flaky, name="reward", retries=3, backoff_s=0.25,
+            metrics=metrics, sleep=delays.append,
+        )
+        assert guard(21) == 42
+        assert len(calls) == 3
+        assert metrics.counter("resilience/reward_retries") == 2
+        assert metrics.counter("resilience/reward_failures") == 0
+        # exponential backoff with jitter in [0.5, 1.0) of the base
+        assert 0.125 <= delays[0] < 0.25
+        assert 0.25 <= delays[1] < 0.5
+
+    def test_backoff_deterministic_and_capped(self):
+        mk = lambda: HostCallGuard(  # noqa: E731
+            lambda: None, name="reward", backoff_s=1.0, backoff_max_s=4.0, seed=7
+        )
+        a, b = mk(), mk()
+        assert [a.backoff_delay(i) for i in range(6)] == [
+            b.backoff_delay(i) for i in range(6)
+        ]
+        assert a.backoff_delay(10) <= 4.0
+
+    def test_neutral_fallback_after_exhaustion(self):
+        metrics = MetricsRegistry()
+
+        def dead(samples, prompts, outputs):
+            raise RuntimeError("endpoint down")
+
+        guard = HostCallGuard(
+            dead, name="reward", retries=2, backoff_s=0.0,
+            fallback="neutral", neutral_fn=neutral_rewards,
+            metrics=metrics, sleep=lambda s: None,
+        )
+        out = guard(samples=["a", "b", "c"], prompts=[], outputs=[])
+        assert out == [0.0, 0.0, 0.0]
+        assert metrics.counter("resilience/reward_retries") == 2
+        assert metrics.counter("resilience/reward_failures") == 1
+        assert metrics.counter("resilience/reward_fallbacks") == 1
+
+    def test_raise_fallback_reraises(self):
+        guard = HostCallGuard(
+            lambda: (_ for _ in ()).throw(ValueError("boom")),
+            name="reward", retries=1, backoff_s=0.0, sleep=lambda s: None,
+        )
+        with pytest.raises(ValueError, match="boom"):
+            guard()
+
+    def test_timeout_counts_as_failure(self):
+        import time as _time
+
+        guard = HostCallGuard(
+            lambda: _time.sleep(5.0), name="reward", retries=0,
+            timeout_s=0.05, fallback="neutral",
+            neutral_fn=lambda *a, **k: "fallback", sleep=lambda s: None,
+        )
+        assert guard() == "fallback"
+
+    def test_consecutive_fallback_escalation(self):
+        """A reward_fn that fails EVERY call is a bug, not an outage: after
+        max_consecutive_fallbacks neutral substitutions the guard re-raises
+        instead of silently training on zeros forever."""
+
+        def dead(samples):
+            raise RuntimeError("deterministic bug")
+
+        guard = HostCallGuard(
+            dead, name="reward", retries=0, backoff_s=0.0,
+            fallback="neutral", neutral_fn=neutral_rewards,
+            max_consecutive_fallbacks=3, sleep=lambda s: None,
+        )
+        assert guard(samples=["a"]) == [0.0]
+        assert guard(samples=["a"]) == [0.0]
+        with pytest.raises(RuntimeError, match="deterministic bug"):
+            guard(samples=["a"])
+        assert guard.consecutive_fallbacks == 3
+
+    def test_success_resets_fallback_streak(self):
+        state = {"fail": True}
+
+        def flaky(samples):
+            if state["fail"]:
+                raise RuntimeError("down")
+            return [1.0] * len(samples)
+
+        guard = HostCallGuard(
+            flaky, name="reward", retries=0, backoff_s=0.0,
+            fallback="neutral", neutral_fn=neutral_rewards,
+            max_consecutive_fallbacks=2, sleep=lambda s: None,
+        )
+        assert guard(samples=["a"]) == [0.0]
+        state["fail"] = False
+        assert guard(samples=["a"]) == [1.0]
+        assert guard.consecutive_fallbacks == 0
+        state["fail"] = True
+        assert guard(samples=["a"]) == [0.0]  # streak restarted, cap not hit
+
+    def test_fault_plan_drives_attempts(self):
+        plan = FaultPlan.parse("reward_raise@call:1*2")
+        guard = HostCallGuard(
+            lambda: "ok", name="reward", retries=3, backoff_s=0.0,
+            plan=plan, sleep=lambda s: None,
+        )
+        assert guard() == "ok"  # attempts 1,2 injected, attempt 3 succeeds
+        assert plan.fired["reward_raise"] == 2
+
+
+class TestResilientTracker:
+    class _Flaky:
+        def __init__(self, fail_first_n):
+            self.fail = fail_first_n
+            self.logged = []
+
+        def log(self, stats, step):
+            if self.fail > 0:
+                self.fail -= 1
+                raise OSError("disk hiccup")
+            self.logged.append((step, stats))
+
+        def finish(self):
+            pass
+
+    def test_retries_then_logs(self):
+        metrics = MetricsRegistry()
+        inner = self._Flaky(fail_first_n=2)
+        tracker = ResilientTracker(
+            inner, retries=2, backoff_s=0.0, metrics=metrics, sleep=lambda s: None
+        )
+        tracker.log({"a/b": 1.0}, step=3)
+        assert inner.logged == [(3, {"a/b": 1.0})]
+        assert metrics.counter("resilience/publish_retries") == 2
+
+    def test_drops_after_exhaustion_without_raising(self):
+        metrics = MetricsRegistry()
+        inner = self._Flaky(fail_first_n=99)
+        tracker = ResilientTracker(
+            inner, retries=1, backoff_s=0.0, metrics=metrics, sleep=lambda s: None
+        )
+        tracker.log({"a/b": 1.0}, step=0)  # must not raise
+        assert inner.logged == []
+        assert metrics.counter("resilience/publish_failures") == 1
+
+
+# ---------------------------------------------------------------------------
+# UpdateGuard policy unit
+# ---------------------------------------------------------------------------
+
+
+class TestUpdateGuardPolicy:
+    def test_skip_counts_and_goodput(self):
+        metrics = MetricsRegistry()
+        guard = UpdateGuard(policy="skip", metrics=metrics)
+        assert guard.after_step({"resilience/update_ok": 1.0}) is None
+        assert guard.after_step({"resilience/update_ok": 0.0}) is None
+        snap = metrics.snapshot()
+        assert snap["resilience/nonfinite_updates"] == 1
+        assert snap["resilience/skipped_updates"] == 1
+        assert snap["resilience/goodput_frac"] == 0.5
+
+    def test_rollback_action_and_halt(self):
+        guard = UpdateGuard(policy="rollback")
+        assert guard.after_step({"resilience/update_ok": 0.0}) == "rollback"
+        with pytest.raises(NonFiniteUpdateError):
+            UpdateGuard(policy="halt").after_step({"resilience/update_ok": 0.0})
+
+    def test_escalation_after_max_consecutive(self):
+        guard = UpdateGuard(policy="skip", max_consecutive=3)
+        bad = {"resilience/update_ok": 0.0}
+        guard.after_step(bad)
+        guard.after_step(bad)
+        with pytest.raises(NonFiniteUpdateError, match="diverged"):
+            guard.after_step(bad)
+
+    def test_off_is_inert(self):
+        guard = UpdateGuard(policy="off")
+        assert guard.after_step({"resilience/update_ok": 0.0}) is None
+
+
+# ---------------------------------------------------------------------------
+# Atomic checkpoint commit
+# ---------------------------------------------------------------------------
+
+
+class TestAtomicCheckpoint:
+    def test_commit_marker_and_roundtrip(self, tmp_path):
+        from trlx_tpu.utils.checkpoint import (
+            is_committed, restore_state, save_state,
+        )
+
+        state = {"w": np.arange(8, dtype=np.float32)}
+        d = str(tmp_path / "ck")
+        save_state(d, state, extra={"iter_count": 1}, async_save=False)
+        assert is_committed(d)
+        assert os.path.exists(os.path.join(d, "COMMITTED"))
+        out = restore_state(d, {"w": np.zeros(8, np.float32)})
+        np.testing.assert_array_equal(out["w"], state["w"])
+
+    def test_crash_mid_save_leaves_previous_restorable(self, tmp_path):
+        """The acceptance scenario: a crash injected mid-``save_state``
+        (before the commit) must leave the previous checkpoint committed and
+        restorable — the old rmtree-before-write flow left zero."""
+        from trlx_tpu.utils.checkpoint import (
+            is_committed, newest_committed_checkpoint, restore_state, save_state,
+        )
+
+        root = tmp_path / "ckpts"
+        d = str(root / "checkpoint_1")
+        v1 = {"w": np.full(4, 1.0, np.float32)}
+        v2 = {"w": np.full(4, 2.0, np.float32)}
+        save_state(d, v1, extra={"iter_count": 1}, async_save=False)
+
+        set_active_plan(FaultPlan.parse("crash_save@save:1"))
+        with pytest.raises(InjectedFault):
+            save_state(d, v2, extra={"iter_count": 2}, async_save=False)
+        set_active_plan(None)
+
+        assert is_committed(d)
+        assert newest_committed_checkpoint(str(root)) == os.path.abspath(d)
+        out = restore_state(d, {"w": np.zeros(4, np.float32)})
+        np.testing.assert_array_equal(out["w"], v1["w"])
+        # the staged extra must not have replaced the committed one
+        from trlx_tpu.utils.checkpoint import read_extra
+
+        assert read_extra(d)["iter_count"] == 1
+
+    def test_crash_mid_async_save(self, tmp_path):
+        from trlx_tpu.utils.checkpoint import (
+            is_committed, restore_state, save_state, wait_for_saves,
+        )
+
+        d = str(tmp_path / "ck")
+        v1 = {"w": np.full(4, 1.0, np.float32)}
+        save_state(d, v1, async_save=True)
+        wait_for_saves()
+        set_active_plan(FaultPlan.parse("crash_save@save:1"))
+        save_state(d, {"w": np.full(4, 9.0, np.float32)}, async_save=True)
+        with pytest.raises(InjectedFault):
+            wait_for_saves()  # the deferred commit carries the crash
+        set_active_plan(None)
+        assert is_committed(d)
+        out = restore_state(d, {"w": np.zeros(4, np.float32)})
+        np.testing.assert_array_equal(out["w"], v1["w"])
+
+    def test_interrupted_overwrite_swap_recovers(self, tmp_path):
+        """A crash between the commit's two renames leaves the previous
+        tree in state.old: the dir still reads committed, and the next
+        save/restore heals it back to ``state``."""
+        from trlx_tpu.utils.checkpoint import (
+            is_committed, restore_state, save_state,
+        )
+
+        d = str(tmp_path / "ck")
+        save_state(d, {"w": np.full(4, 1.0, np.float32)}, async_save=False)
+        # simulate the crash window: state moved aside, replacement missing
+        os.rename(os.path.join(d, "state"), os.path.join(d, "state.old"))
+        assert is_committed(d)
+        out = restore_state(d, {"w": np.zeros(4, np.float32)})
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.full(4, 1.0))
+        assert os.path.isdir(os.path.join(d, "state"))  # healed in place
+
+    def test_guard_defaults_off(self):
+        """The default config must keep the pre-guard train step (the skip
+        select costs ~2x temp memory — strictly opt-in)."""
+        assert default_ppo_config().resilience.update_guard == "off"
+
+    def test_prune_keeps_newest_and_partials(self, tmp_path):
+        from trlx_tpu.utils.checkpoint import prune_checkpoints, save_state
+
+        root = str(tmp_path)
+        for i in (1, 2, 3):
+            save_state(
+                os.path.join(root, f"checkpoint_{i}"),
+                {"w": np.full(2, float(i), np.float32)},
+                async_save=False,
+            )
+        # a partial (uncommitted) dir and best_checkpoint are never touched
+        os.makedirs(os.path.join(root, "checkpoint_0", "state.staging"))
+        os.makedirs(os.path.join(root, "best_checkpoint"))
+        pruned = prune_checkpoints(root, keep_last_n=2)
+        assert [os.path.basename(p) for p in pruned] == ["checkpoint_1"]
+        left = sorted(os.listdir(root))
+        assert "checkpoint_2" in left and "checkpoint_3" in left
+        assert "checkpoint_0" in left and "best_checkpoint" in left
+        assert prune_checkpoints(root, keep_last_n=0) == []
+
+    def test_maybe_resume_skips_partial_dirs(self, tmp_path, trlx_log_records):
+        """A partial checkpoint dir (crash mid-save) must be skipped with a
+        warning; the newest *committed* checkpoint wins instead of Orbax
+        dying on the partial restore."""
+        from trlx_tpu.trainer import get_trainer
+        import trlx_tpu.trainer.ppo  # noqa: F401 (registration)
+
+        config = ppo_config(tmp_path).evolve(
+            train=dict(resume_from_checkpoint=True)
+        )
+        t1 = get_trainer(config.train.trainer)(
+            config=config, reward_fn=letter_reward, metric_fn=None,
+            stop_sequences=[],
+        )
+        t1.iter_count = 2
+        t1.save(str(tmp_path / "ckpts" / "checkpoint_2"))
+        # fabricate a newer, partial checkpoint (as a crash would leave it)
+        partial = tmp_path / "ckpts" / "checkpoint_3"
+        os.makedirs(partial / "state.staging")
+
+        t2 = get_trainer(config.train.trainer)(
+            config=config, reward_fn=letter_reward, metric_fn=None,
+            stop_sequences=[],
+        )
+        t2.maybe_resume()
+        assert t2.iter_count == 2  # restored from checkpoint_2, not _3
+        assert any(
+            "uncommitted/partial" in r.getMessage() for r in trlx_log_records
+        )
+
+
+# ---------------------------------------------------------------------------
+# End-to-end fault injection (PPO / SFT, tiny models, virtual mesh)
+# ---------------------------------------------------------------------------
+
+
+class TestNaNRecovery:
+    def test_nan_skip_policy_run_completes(self, tmp_path):
+        """nan_loss@step:1 poisons the second update; the guard skips it on
+        device and the run finishes with finite weights."""
+        config = ppo_config(tmp_path).evolve(
+            resilience=dict(update_guard="skip", fault_plan="nan_loss@step:1"),
+        )
+        trainer = trlx.train(
+            reward_fn=letter_reward, prompts=PROMPTS, config=config
+        )
+        assert trainer.iter_count == 4
+        for leaf in _leaves(trainer.state.params):
+            assert np.isfinite(np.asarray(leaf)).all()
+        records = _records(config)
+        assert any(r.get("resilience/update_ok") == 0.0 for r in records)
+        assert any(r.get("resilience/nonfinite_updates", 0) >= 1 for r in records)
+        assert any(0.0 < r.get("resilience/goodput_frac", 0) < 1.0 for r in records)
+
+    def test_nan_rollback_policy_run_completes(self, tmp_path):
+        """nan_loss after a committed interval checkpoint: the guard
+        restores it (params AND controller state) and training finishes."""
+        config = ppo_config(tmp_path).evolve(
+            resilience=dict(update_guard="rollback", fault_plan="nan_loss@step:2"),
+        )
+        trainer = trlx.train(
+            reward_fn=letter_reward, prompts=PROMPTS, config=config
+        )
+        assert trainer.iter_count == 4
+        for leaf in _leaves(trainer.state.params):
+            assert np.isfinite(np.asarray(leaf)).all()
+        records = _records(config)
+        assert any(r.get("resilience/rollbacks", 0) >= 1 for r in records)
+
+    def test_nan_halt_policy_raises(self, tmp_path):
+        config = ppo_config(tmp_path).evolve(
+            resilience=dict(update_guard="halt", fault_plan="nan_loss@step:0"),
+        )
+        with pytest.raises(NonFiniteUpdateError):
+            trlx.train(reward_fn=letter_reward, prompts=PROMPTS, config=config)
+        # crash-safe shutdown: the buffered stats and the span trace landed
+        assert os.path.exists(
+            os.path.join(config.train.logging_dir, "stats.jsonl")
+        )
+        assert os.path.exists(
+            os.path.join(config.train.logging_dir, "trace.json")
+        )
+
+
+class TestRewardRetry:
+    def test_transient_reward_failures_are_retried(self, tmp_path):
+        """reward_raise@call:2*2 fails two attempts of one scoring call;
+        backoff retries absorb it, the run completes, and the retries are
+        accounted in the stats stream."""
+        config = ppo_config(tmp_path).evolve(
+            resilience=dict(
+                reward_retries=3,
+                reward_backoff_s=0.01,
+                fault_plan="reward_raise@call:2*2",
+            ),
+        )
+        trainer = trlx.train(
+            reward_fn=letter_reward, prompts=PROMPTS, config=config
+        )
+        assert trainer.iter_count == 4
+        records = _records(config)
+        assert any(r.get("resilience/reward_retries", 0) >= 2 for r in records)
+        assert all(r.get("resilience/reward_failures", 0) == 0 for r in records)
+
+    def test_exhausted_reward_neutral_fallback(self, tmp_path):
+        """A reward endpoint that stays down past the retry budget: the
+        neutral fallback keeps the run alive with zero rewards."""
+        config = ppo_config(tmp_path).evolve(
+            resilience=dict(
+                reward_retries=1,
+                reward_backoff_s=0.0,
+                reward_fallback="neutral",
+                fault_plan="reward_raise@call:1*8",
+            ),
+        )
+        trainer = trlx.train(
+            reward_fn=letter_reward, prompts=PROMPTS, config=config
+        )
+        assert trainer.iter_count == 4
+        records = _records(config)
+        assert any(r.get("resilience/reward_fallbacks", 0) >= 1 for r in records)
+
+
+class TestPreemptResume:
+    def test_sigterm_preempt_and_resume_bit_identical(self, tmp_path):
+        """The tentpole acceptance: SIGTERM mid-train produces a committed
+        emergency checkpoint, and the resumed run's final train state is
+        bit-identical to an uninterrupted run's."""
+        from trlx_tpu.utils.checkpoint import is_committed
+
+        # run A: uninterrupted reference
+        cfg_a = ppo_config(tmp_path / "a")
+        trainer_a = trlx.train(
+            reward_fn=letter_reward, prompts=PROMPTS, config=cfg_a
+        )
+        assert trainer_a.iter_count == 4
+
+        # run B: identical config/seed, SIGTERM delivered at the step-2
+        # boundary — learn() must commit an emergency checkpoint and raise
+        cfg_b = ppo_config(tmp_path / "b").evolve(
+            resilience=dict(fault_plan="sigterm@step:2"),
+        )
+        with pytest.raises(TrainingPreempted) as exc:
+            trlx.train(reward_fn=letter_reward, prompts=PROMPTS, config=cfg_b)
+        emergency = exc.value.checkpoint_dir
+        assert emergency and is_committed(emergency)
+
+        # run C: relaunch without the fault, resuming from the emergency
+        # checkpoint; the remaining updates replay exactly
+        cfg_c = ppo_config(tmp_path / "b").evolve(
+            train=dict(resume_from_checkpoint=True),
+        )
+        trainer_c = trlx.train(
+            reward_fn=letter_reward, prompts=PROMPTS, config=cfg_c
+        )
+        assert trainer_c.iter_count == 4
+
+        a_params = _leaves(trainer_a.state.params)
+        c_params = _leaves(trainer_c.state.params)
+        assert len(a_params) == len(c_params)
+        for a, c in zip(a_params, c_params):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+        # optimizer moments and the device step/rng must match too
+        for a, c in zip(_leaves(trainer_a.state), _leaves(trainer_c.state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+        # host-side controller state
+        assert trainer_a.kl_ctl.value == trainer_c.kl_ctl.value
+        assert trainer_a.running_moments.mean == trainer_c.running_moments.mean
+        assert trainer_a.running_moments.count == trainer_c.running_moments.count
+
+    def test_preemption_metric_counted(self, tmp_path):
+        cfg = ppo_config(tmp_path).evolve(
+            resilience=dict(fault_plan="sigterm@step:1"),
+        )
+        with pytest.raises(TrainingPreempted):
+            trlx.train(reward_fn=letter_reward, prompts=PROMPTS, config=cfg)
+        # the tracker stream survived the preemption (crash-safe shutdown)
+        records = _records(cfg)
+        assert records, "no stats survived the preemption"
+
+
+class TestCrashSafeShutdown:
+    def test_exception_flushes_tracker_and_trace(self, tmp_path):
+        """A mid-train crash (here: a metric_fn bug at the step-2 eval)
+        must still flush the JSONL tracker and export the span trace."""
+
+        def broken_metric(samples, prompts, outputs, **kwargs):
+            raise RuntimeError("metric bug")
+
+        config = ppo_config(tmp_path).evolve(train=dict(eval_interval=100))
+        config = config.evolve(train=dict(total_steps=2))
+        import trlx_tpu.trainer.ppo  # noqa: F401
+        from trlx_tpu.pipeline import get_pipeline
+        from trlx_tpu.trainer import get_trainer
+
+        trainer = get_trainer(config.train.trainer)(
+            config=config, reward_fn=letter_reward, metric_fn=broken_metric,
+            stop_sequences=[],
+        )
+        pipeline = get_pipeline(config.train.pipeline)(
+            PROMPTS, 40, trainer.tokenizer
+        )
+        trainer.add_prompt_pipeline(pipeline)
+        trainer.make_experience(8)
+        trainer.add_eval_pipeline(pipeline)
+        with pytest.raises(RuntimeError, match="metric bug"):
+            trainer.learn()  # the initial evaluate() calls broken_metric
+        stats_path = os.path.join(config.train.logging_dir, "stats.jsonl")
+        trace_path = os.path.join(config.train.logging_dir, "trace.json")
+        assert os.path.exists(trace_path), "span trace lost on crash"
+        # rollout-collection stats were already logged before the crash
+        assert os.path.exists(stats_path)
+        assert _records(config)
+
+
+class TestRetentionRing:
+    def test_keep_last_n_prunes_interval_checkpoints(self, tmp_path):
+        config = ppo_config(tmp_path).evolve(
+            train=dict(checkpoint_interval=1),
+            resilience=dict(keep_last_n=2),
+        )
+        trainer = trlx.train(
+            reward_fn=letter_reward, prompts=PROMPTS, config=config
+        )
+        assert trainer.iter_count == 4
+        dirs = sorted(
+            d for d in os.listdir(config.train.checkpoint_dir)
+            if d.startswith("checkpoint_")
+        )
+        assert len(dirs) <= 3  # ring of 2 + the just-written final save
+        assert "checkpoint_4" in dirs

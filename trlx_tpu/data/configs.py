@@ -184,6 +184,77 @@ class ParallelConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Fault-tolerance knobs (``trlx_tpu/resilience/``, docs/RESILIENCE.md).
+
+    Preemption, non-finite updates, and flaky host calls are routine at
+    fleet scale; this section decides how the run survives each.
+
+    :param handle_preemption: install SIGTERM/SIGINT handlers for the
+        duration of ``learn()``: the signal requests an emergency checkpoint
+        at the next step boundary, the run commits it and exits cleanly, and
+        a relaunch with ``train.resume_from_checkpoint`` continues
+        bit-identically to an uninterrupted run.
+    :param preemption_signals: which signals request preemption.
+    :param update_guard: non-finite (NaN/inf) update policy — ``"off"``
+        (default: the pre-guard train step, byte-for-byte), ``"skip"``
+        (on-device: keep the old params/opt-state, drop the poison batch —
+        NOTE the keep-old select holds both state versions live, defeating
+        donation's in-place update: ≈2× train-step temp memory), or
+        ``"rollback"`` / ``"halt"`` (restore the newest committed
+        checkpoint / raise — flag-only on device, no memory cost). The
+        finiteness check is fused into the train step (no extra host sync).
+    :param max_consecutive_nonfinite: escalate skip/rollback to halt after
+        this many consecutive non-finite updates (true divergence).
+    :param keep_last_n: interval-checkpoint retention ring: after each
+        interval save, prune committed ``checkpoint_*`` dirs beyond the
+        newest N (0 = keep everything; ``best_checkpoint`` is never pruned).
+    :param reward_retries: retry a failing ``reward_fn`` call this many
+        times (exponential backoff with deterministic jitter) before the
+        ``reward_fallback`` policy applies.
+    :param reward_backoff_s: base backoff; attempt k waits
+        ``min(max, base * 2**k) * U[0.5, 1)``.
+    :param reward_backoff_max_s: backoff cap.
+    :param reward_timeout_s: per-attempt timeout (worker thread); a hung
+        endpoint counts as a failed attempt. None = no timeout.
+    :param reward_fallback: ``"raise"`` (re-raise after retries — the old
+        behavior) or ``"neutral"`` (zero rewards for the batch; the run
+        continues and ``resilience/reward_fallbacks`` counts it).
+    :param reward_max_consecutive_fallbacks: escalate ``"neutral"`` back to
+        raising after this many consecutive fallbacks — a reward_fn that
+        fails EVERY call is a deterministic bug, not a transient outage,
+        and must not silently train on zero rewards to ``total_steps``.
+        0 disables the cap.
+    :param publish_retries: tracker/hub publish retries; after exhaustion
+        the record is *dropped* (logging never kills training).
+    :param publish_backoff_s: base backoff for publish retries.
+    :param fault_plan: deterministic fault-injection plan string
+        (``"sigterm@step:5; reward_raise@call:3*2"`` — syntax in
+        docs/RESILIENCE.md). ``TRLX_TPU_FAULT_PLAN`` overrides. None = no
+        injected faults.
+    """
+
+    handle_preemption: bool = True
+    preemption_signals: List[str] = field(
+        default_factory=lambda: ["SIGTERM", "SIGINT"]
+    )
+    update_guard: str = "off"
+    max_consecutive_nonfinite: int = 25
+    keep_last_n: int = 0
+    reward_retries: int = 3
+    reward_backoff_s: float = 0.5
+    reward_backoff_max_s: float = 30.0
+    reward_timeout_s: Optional[float] = None
+    reward_fallback: str = "raise"
+    reward_max_consecutive_fallbacks: int = 20
+    publish_retries: int = 2
+    publish_backoff_s: float = 0.2
+    fault_plan: Optional[str] = None
+
+    from_dict = classmethod(_strict_from_dict)
+
+
+@dataclass
 class TrainConfig:
     """Run-level knobs for the shared learn loop
     (reference: ``trlx/data/configs.py:142-230``)."""
@@ -288,6 +359,7 @@ class TRLConfig:
     tokenizer: TokenizerConfig
     train: TrainConfig
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     @classmethod
     def load_yaml(cls, yml_fp: str) -> "TRLConfig":
@@ -312,6 +384,7 @@ class TRLConfig:
             "tokenizer": asdict(self.tokenizer),
             "train": asdict(self.train),
             "parallel": asdict(self.parallel),
+            "resilience": asdict(self.resilience),
         })
 
     @classmethod
@@ -324,6 +397,7 @@ class TRLConfig:
             scheduler=SchedulerConfig.from_dict(config["scheduler"]),
             train=TrainConfig.from_dict(config["train"]),
             parallel=ParallelConfig.from_dict(config.get("parallel", {})),
+            resilience=ResilienceConfig.from_dict(config.get("resilience", {})),
         )
 
     def evolve(self, **kwargs) -> "TRLConfig":

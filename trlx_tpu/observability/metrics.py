@@ -174,15 +174,16 @@ class ThroughputMeter:
         return out
 
 
-def train_step_flops(jitted_fn, state: Any, batch: Any) -> Optional[float]:
+def train_step_flops(jitted_fn, *args: Any) -> Optional[float]:
     """Per-device FLOPs of the exact compiled train step, via the same XLA
     ``cost_analysis`` path as ``trlx_tpu/perf.py``.
 
     Lowers ``jitted_fn`` with abstract (shape/dtype/sharding) twins of the
-    live arguments — no arrays are touched, and with the persistent compile
-    cache on, the AOT compile dedupes against the call-path executable.
-    Returns ``None`` (never raises) when the backend has no cost model or
-    lowering fails; disable entirely with ``TRLX_TPU_MFU=0``.
+    live arguments (state, batch, and any trailing scalars) — no arrays are
+    touched, and with the persistent compile cache on, the AOT compile
+    dedupes against the call-path executable. Returns ``None`` (never
+    raises) when the backend has no cost model or lowering fails; disable
+    entirely with ``TRLX_TPU_MFU=0``.
     """
     if os.environ.get("TRLX_TPU_MFU", "1") == "0":
         return None
@@ -199,7 +200,7 @@ def train_step_flops(jitted_fn, state: Any, batch: Any) -> Optional[float]:
                 tree,
             )
 
-        costs = lowered_costs(jitted_fn.lower(abstract(state), abstract(batch)))
+        costs = lowered_costs(jitted_fn.lower(*(abstract(a) for a in args)))
         flops = costs.get("flops", -1.0)
         return flops if flops > 0 else None
     except Exception:

@@ -317,7 +317,9 @@ def hot_program_costs(
                 opt = attach(trainer.state.opt_state, opt_sh)
                 state = dataclasses.replace(state, params=params, opt_state=opt)
             fn = trainer._build_train_step()
-            results["train_step"] = _costs_of(fn.lower(state, batch))
+            results["train_step"] = _costs_of(
+                fn.lower(state, batch, SDS((), np.float32))
+            )
 
     return results
 

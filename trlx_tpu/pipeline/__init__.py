@@ -72,6 +72,14 @@ class BatchLoader:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
+    def advance_epoch(self) -> None:
+        """Consume one epoch's worth of shuffle randomness without iterating
+        — the emergency-resume fast-forward (docs/RESILIENCE.md) skips whole
+        epochs but must leave later epochs' shuffle orders exactly where an
+        uninterrupted run would have them."""
+        if self.shuffle:
+            self._rng.shuffle(list(range(len(self.dataset))))
+
     def __iter__(self) -> Iterator[Any]:
         order = list(range(len(self.dataset)))
         if self.shuffle:

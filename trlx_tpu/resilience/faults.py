@@ -1,0 +1,173 @@
+"""Deterministic fault injection: a parsed :class:`FaultPlan` that trainer,
+checkpoint, and host-call sites consult at well-defined points.
+
+Production RL runs die in ways unit tests never exercise: a reward endpoint
+times out on call 3, the scheduler SIGTERMs the pod at step 5, one batch
+produces a NaN loss at step 7, the process is OOM-killed halfway through a
+checkpoint write. The fault plan makes each of those a *reproducible* event:
+the same plan string always fires the same faults at the same points, so the
+recovery machinery (``trlx_tpu/resilience/``) is testable end-to-end on CPU.
+
+Plan syntax (``;``-separated entries, whitespace ignored)::
+
+    kind@trigger:N[*count]
+
+    kind     one of: reward_raise | publish_raise | sigterm | sigint |
+             nan_loss | crash_save
+    trigger  call  — the Nth invocation of the consulting site (1-based;
+                     for reward_raise/publish_raise every *attempt* counts,
+                     so retries advance the counter)
+             step  — fires when the trainer's completed-update count == N
+             save  — the Nth ``save_state`` call (1-based)
+    count    consecutive firings (default 1)
+
+Examples::
+
+    reward_raise@call:3*2        # reward_fn attempts 3 and 4 raise
+    sigterm@step:5               # SIGTERM delivered before update 6 starts
+    nan_loss@step:7              # the loss of update 8 is poisoned to NaN
+    crash_save@save:2            # the 2nd save_state dies before committing
+
+Plans come from ``config.resilience.fault_plan`` or the
+``TRLX_TPU_FAULT_PLAN`` env var (env wins — a relaunched run can drop the
+fault by clearing the variable without editing configs). Sites reach the
+plan through the module-level *active plan* (:func:`set_active_plan` /
+:func:`poll_fault`) so low-level code (``utils/checkpoint.py``) needs no
+trainer handle.
+"""
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_KINDS = frozenset(
+    {"reward_raise", "publish_raise", "sigterm", "sigint", "nan_loss", "crash_save"}
+)
+_TRIGGERS = frozenset({"call", "step", "save"})
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a fault-plan site standing in for a real failure."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One parsed plan entry: fire ``kind`` for ``count`` consecutive
+    trigger values starting at ``n``."""
+
+    kind: str
+    trigger: str  # "call" | "step" | "save"
+    n: int
+    count: int = 1
+
+    def matches(self, value: int) -> bool:
+        return self.n <= value < self.n + self.count
+
+
+@dataclass
+class FaultPlan:
+    """A set of :class:`FaultSpec` plus per-site call counters.
+
+    ``poll(kind)`` advances the counter for call/save-triggered entries and
+    reports whether this invocation should fault; ``poll(kind, step=s)``
+    checks step-triggered entries against the caller's step counter without
+    advancing anything. Thread-safe: host-call sites poll from pipeline
+    worker threads.
+    """
+
+    specs: List[FaultSpec] = field(default_factory=list)
+    _counters: Dict[str, int] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    fired: Dict[str, int] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, plan: Optional[str]) -> "FaultPlan":
+        specs: List[FaultSpec] = []
+        for raw in (plan or "").split(";"):
+            entry = raw.strip()
+            if not entry:
+                continue
+            try:
+                kind, rest = entry.split("@", 1)
+                count = 1
+                if "*" in rest:
+                    rest, count_s = rest.rsplit("*", 1)
+                    count = int(count_s)
+                trigger, n_s = rest.split(":", 1)
+                spec = FaultSpec(kind.strip(), trigger.strip(), int(n_s), count)
+            except (ValueError, TypeError) as e:
+                raise ValueError(
+                    f"unparseable fault-plan entry {entry!r} (syntax: "
+                    f"kind@trigger:N[*count], docs/RESILIENCE.md): {e}"
+                ) from e
+            if spec.kind not in _KINDS:
+                raise ValueError(
+                    f"unknown fault kind {spec.kind!r} (known: {sorted(_KINDS)})"
+                )
+            if spec.trigger not in _TRIGGERS:
+                raise ValueError(
+                    f"unknown fault trigger {spec.trigger!r} "
+                    f"(known: {sorted(_TRIGGERS)})"
+                )
+            if spec.count < 1 or spec.n < 0:
+                raise ValueError(f"fault-plan entry {entry!r}: n/count out of range")
+            specs.append(spec)
+        return cls(specs=specs)
+
+    @classmethod
+    def from_config(cls, plan: Optional[str]) -> "FaultPlan":
+        """Parse ``plan``, letting ``TRLX_TPU_FAULT_PLAN`` override it."""
+        return cls.parse(os.environ.get("TRLX_TPU_FAULT_PLAN") or plan)
+
+    def __bool__(self) -> bool:
+        return bool(self.specs)
+
+    def poll(self, kind: str, step: Optional[int] = None) -> bool:
+        """Should the consulting site fault now?
+
+        With ``step=None`` this is an *invocation* poll: the per-kind call
+        counter advances by one and call/save-triggered entries match
+        against it. With ``step=s`` only step-triggered entries are checked
+        (idempotent — the trainer polls once per update)."""
+        if not self.specs:
+            return False
+        with self._lock:
+            if step is None:
+                value = self._counters.get(kind, 0) + 1
+                self._counters[kind] = value
+                triggers = ("call", "save")
+            else:
+                value = step
+                triggers = ("step",)
+            hit = any(
+                s.kind == kind and s.trigger in triggers and s.matches(value)
+                for s in self.specs
+            )
+            if hit:
+                self.fired[kind] = self.fired.get(kind, 0) + 1
+            return hit
+
+
+# ---------------------------------------------------------------------------
+# process-wide active plan: low-level sites (checkpoint commit) consult this
+# without a trainer handle. One training run per process is the norm; the
+# last-constructed Resilience bundle owns the slot.
+# ---------------------------------------------------------------------------
+
+_ACTIVE_PLAN: Optional[FaultPlan] = None
+
+
+def set_active_plan(plan: Optional[FaultPlan]) -> None:
+    global _ACTIVE_PLAN
+    _ACTIVE_PLAN = plan if plan else None
+
+
+def get_active_plan() -> Optional[FaultPlan]:
+    return _ACTIVE_PLAN
+
+
+def poll_fault(kind: str, step: Optional[int] = None) -> bool:
+    """Convenience for sites without a plan handle; False when no plan."""
+    plan = _ACTIVE_PLAN
+    return bool(plan) and plan.poll(kind, step=step)

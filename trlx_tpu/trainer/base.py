@@ -51,6 +51,9 @@ from trlx_tpu.utils import (
 )
 from trlx_tpu.utils import logging
 from trlx_tpu.utils.checkpoint import (
+    is_committed,
+    newest_committed_checkpoint,
+    prune_checkpoints,
     read_extra,
     restore_state,
     save_pretrained,
@@ -59,6 +62,7 @@ from trlx_tpu.utils.checkpoint import (
 )
 from trlx_tpu.observability import Observability, train_step_flops
 from trlx_tpu.observability import mfu as obs_mfu
+from trlx_tpu.resilience import Resilience, TrainingPreempted
 from trlx_tpu.utils.trackers import make_tracker
 
 logger = logging.get_logger(__name__)
@@ -262,15 +266,27 @@ class TPUBaseTrainer(BaseRLTrainer):
         self._last_batch_host: Any = None
         self._last_batch_sharded: Any = None
 
-        self.tracker = make_tracker(config)
         # runtime observability: span tracer, metrics registry, recompile/
         # memory watchdogs, profiler window (docs/OBSERVABILITY.md)
         self.obs = Observability(config)
+        # resilience: preemption handler, update guard, host-call hardening,
+        # fault plan (docs/RESILIENCE.md). Shares the metrics registry so
+        # every resilience/* counter rides the tracker stream. reward_fn is
+        # wrapped ONCE here, hardening every call site (rollouts, eval).
+        self.resilience = Resilience(config, metrics=self.obs.metrics)
+        self.reward_fn = self.resilience.harden_reward_fn(
+            self.reward_fn, seed=config.train.seed
+        )
+        self.tracker = self.resilience.harden_tracker(
+            make_tracker(config), seed=config.train.seed
+        )
         self._train_step_flops: Optional[float] = None
         self._flops_thread = None
         self.eval_pipeline: Optional[BasePipeline] = None
         self.iter_count = 0
         self.nth_evaluation = 0
+        self.best_reward = -float("inf")
+        self._emergency_resume = False
 
     # ------------------------------------------------------------------
     # subclass contract
@@ -395,10 +411,34 @@ class TPUBaseTrainer(BaseRLTrainer):
         else:  # abstract_init analysis trainers carry no real shardings
             state_shardings = None
 
-        def grads_of(params, batch, rng):
-            return jax.value_and_grad(self.loss_fn, has_aux=True)(params, batch, rng)
+        # Update guard (docs/RESILIENCE.md): with a policy other than "off",
+        # the step checks isfinite(global_norm) ON DEVICE — any NaN/inf in
+        # loss, grads, or activations propagates into the norm, which is
+        # already computed for gradients/global_norm. The flag rides back in
+        # the stats dict the learn loop fetches anyway: zero extra host
+        # syncs. Only the "skip" policy also SELECTS the old params/opt
+        # state on device — the select keeps both state versions live, which
+        # defeats donation's in-place update (≈2× train-step temp memory;
+        # visible in benchmarks/perf_budgets.json). "rollback"/"halt" need
+        # only the flag: the host restores a committed checkpoint / raises,
+        # so their train step keeps the donated, guard-free memory profile.
+        guard_policy = self.resilience.guard.policy
+        guard_flag = guard_policy != "off"
+        guard_select = guard_policy == "skip"
 
-        def accumulated_grads(params, batch, step_rng):
+        def scaled_loss(params, batch, rng, loss_scale):
+            # loss_scale is 1.0 outside fault injection — an exact identity
+            # multiply (IEEE x*1.0 == x bitwise) — and NaN when the plan
+            # poisons this step, making loss AND grads non-finite
+            loss, stats = self.loss_fn(params, batch, rng)
+            return loss * loss_scale, stats
+
+        def grads_of(params, batch, rng, loss_scale):
+            return jax.value_and_grad(scaled_loss, has_aux=True)(
+                params, batch, rng, loss_scale
+            )
+
+        def accumulated_grads(params, batch, step_rng, loss_scale):
             """lax.scan over ``accum`` microbatches; grads and stats averaged.
 
             Whitening/running statistics inside ``loss_fn`` see one
@@ -414,7 +454,9 @@ class TPUBaseTrainer(BaseRLTrainer):
             # traced exactly once (inside the scan body) — peeling the first
             # microbatch would duplicate the whole HLO graph
             first = jax.tree_util.tree_map(lambda x: x[0], micro)
-            (_, stats_sh), grads_sh = jax.eval_shape(grads_of, params, first, rngs[0])
+            (_, stats_sh), grads_sh = jax.eval_shape(
+                grads_of, params, first, rngs[0], loss_scale
+            )
             zeros = lambda tree: jax.tree_util.tree_map(  # noqa: E731
                 lambda s: jnp.zeros(s.shape, s.dtype), tree
             )
@@ -422,7 +464,7 @@ class TPUBaseTrainer(BaseRLTrainer):
             def body(carry, xs):
                 grads_acc, stats_acc = carry
                 mb, r = xs
-                (_, stats_i), grads_i = grads_of(params, mb, r)
+                (_, stats_i), grads_i = grads_of(params, mb, r, loss_scale)
                 grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads_i)
                 stats_acc = jax.tree_util.tree_map(jnp.add, stats_acc, stats_i)
                 return (grads_acc, stats_acc), None
@@ -435,23 +477,45 @@ class TPUBaseTrainer(BaseRLTrainer):
             # per-trainer loss key varies; callers only consume stats
             return (jnp.zeros(()), stats), grads
 
-        def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
+        def step_fn(state: TrainState, batch: Dict[str, jax.Array], loss_scale):
             rng, step_rng = jax.random.split(state.rng)
             if accum == 1:
-                (loss, stats), grads = grads_of(state.params, batch, step_rng)
+                (loss, stats), grads = grads_of(
+                    state.params, batch, step_rng, loss_scale
+                )
             else:
-                (loss, stats), grads = accumulated_grads(state.params, batch, step_rng)
+                (loss, stats), grads = accumulated_grads(
+                    state.params, batch, step_rng, loss_scale
+                )
             updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             stats = dict(stats)
             stats["learning_rate"] = (
                 schedule(state.step) if callable(schedule) else schedule
             )
-            stats["gradients/global_norm"] = optax.global_norm(grads)
+            gnorm = optax.global_norm(grads)
+            stats["gradients/global_norm"] = gnorm
+            step_inc = 1
+            if guard_flag:
+                ok = jnp.isfinite(gnorm)
+                if accum == 1:
+                    ok = ok & jnp.isfinite(loss)
+                stats["resilience/update_ok"] = ok.astype(jnp.float32)
+            if guard_select:
+                # scalar select per leaf: when the check fails, the update
+                # (and the step counter driving the LR schedule) is dropped
+                # on device — the poison batch never touches the weights
+                params = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o), params, state.params
+                )
+                opt_state = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o), opt_state, state.opt_state
+                )
+                step_inc = ok.astype(jnp.int32)
             new_state = TrainState(
                 params=params,
                 opt_state=opt_state,
-                step=state.step + 1,
+                step=state.step + step_inc,
                 rng=rng,
             )
             return new_state, stats
@@ -537,11 +601,24 @@ class TPUBaseTrainer(BaseRLTrainer):
             )
             self._last_batch_host = batch
             self._last_batch_sharded = arrays
-        self.state, stats = self._train_step_fn(self.state, arrays)
+        self.state, stats = self._train_step_fn(self.state, arrays, self._loss_scale())
         # recompile watchdog: a warm train step retracing (shape/dtype
         # drift) is invisible otherwise — it just gets slow
         self.obs.recompile.observe("train_step", self._train_step_fn)
         return stats
+
+    def _loss_scale(self) -> np.float32:
+        """1.0, or NaN when the fault plan poisons this step's loss
+        (``nan_loss@step:N`` — deterministic update-guard exercise). Traced
+        as a scalar array argument, so both values share one compiled
+        program and the clean-path multiply is an exact identity."""
+        plan = self.resilience.plan
+        if plan and plan.poll("nan_loss", step=self.iter_count):
+            logger.warning(
+                f"fault plan: poisoning the loss of update {self.iter_count} to NaN"
+            )
+            return np.float32(np.nan)
+        return np.float32(1.0)
 
     def _ensure_train_step_flops(
         self, arrays: Optional[Dict[str, jax.Array]], wait: bool = False
@@ -568,7 +645,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                 lambda x: jax.ShapeDtypeStruct(
                     x.shape, x.dtype, sharding=getattr(x, "sharding", None)
                 ),
-                (self.state, arrays),
+                (self.state, arrays, np.float32(1.0)),
             )
 
             def work(fn=self._train_step_fn, args=abstract):
@@ -997,19 +1074,95 @@ class TPUBaseTrainer(BaseRLTrainer):
     # the learn loop
     # ------------------------------------------------------------------
 
-    def learn(self) -> Dict[str, Any]:  # noqa: C901
+    def learn(self) -> Dict[str, Any]:
         """Epochs → batches → n updates per batch, with interval checkpoints,
         interval eval, and best-reward checkpointing (reference
-        ``accelerate_base_trainer.py:433-553``)."""
+        ``accelerate_base_trainer.py:433-553``).
+
+        Resilience wiring (docs/RESILIENCE.md): SIGTERM/SIGINT handlers are
+        installed for the duration of the loop (emergency checkpoint at the
+        next step boundary, then :class:`TrainingPreempted`); any exception
+        — including a crash — flushes the tracker and exports the span
+        trace before propagating, so a dying run keeps its metrics."""
         set_global_mesh(self.mesh)
         logger.info("Starting training")
         self.prepare_learning()
         self.maybe_resume()
+        try:
+            with self.resilience.preemption:
+                return self._learn_loop()
+        except BaseException:
+            # crash-safe shutdown: without this, an exception loses every
+            # buffered tracker record and the whole Perfetto trace
+            self._shutdown_observability()
+            raise
 
-        results = self.evaluate()
-        self.tracker.log(results, step=self.iter_count)
-        self._report_sweep(results)
-        best_reward = -float("inf")
+    def _shutdown_observability(self) -> None:
+        """Best-effort flush of profiler, span trace, and tracker — callable
+        from exception paths, never raising."""
+        try:
+            self.obs.profile.stop()
+        except Exception:  # pragma: no cover - defensive
+            pass
+        self._export_observability()
+        try:
+            self.tracker.finish()
+        except Exception:  # pragma: no cover - defensive
+            pass
+
+    def _check_faults_and_preemption(self) -> None:
+        """Step-boundary seam, called before every update: deliver any
+        fault-plan signals for this step, then honor a pending preemption
+        request with a committed emergency checkpoint."""
+        import signal as _signal
+
+        plan = self.resilience.plan
+        if plan:
+            # raise_signal runs the installed handler synchronously, so the
+            # request is honored at THIS boundary — fully deterministic
+            if plan.poll("sigterm", step=self.iter_count):
+                _signal.raise_signal(_signal.SIGTERM)
+            if plan.poll("sigint", step=self.iter_count):
+                _signal.raise_signal(_signal.SIGINT)
+        preemption = self.resilience.preemption
+        if not preemption.requested:
+            return
+        subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
+        path = os.path.join(self.config.train.checkpoint_dir, subfolder)
+        logger.warning(
+            f"preemption ({preemption.signal_received}): writing emergency "
+            f"checkpoint to {path}"
+        )
+        self.save(path, emergency=True)
+        wait_for_saves()  # the commit marker must land before we exit
+        raise TrainingPreempted(
+            f"preempted by {preemption.signal_received}; emergency checkpoint "
+            f"committed at {path} — relaunch with "
+            "train.resume_from_checkpoint to continue",
+            checkpoint_dir=path,
+        )
+
+    def _learn_loop(self) -> Dict[str, Any]:  # noqa: C901
+        # Emergency resume: the checkpoint froze the run between two
+        # updates. Fast-forward the loop to that exact boundary — skipped
+        # slots run no device work, no eval, no callbacks (all of that
+        # happened before the checkpoint; the rollout RNG and controller
+        # state were restored with it), so the resumed run's stream of
+        # device calls is identical to an uninterrupted run's.
+        emergency_resume = self._emergency_resume
+        self._emergency_resume = False
+        skip_target = self.iter_count if emergency_resume else 0
+        done = 0
+
+        if emergency_resume:
+            results: Dict[str, Any] = {}
+            logger.info(
+                f"emergency resume: fast-forwarding to update {skip_target}"
+            )
+        else:
+            results = self.evaluate()
+            self.tracker.log(results, step=self.iter_count)
+            self._report_sweep(results)
         clock = Clock()
 
         tbar = logging.tqdm(
@@ -1022,8 +1175,35 @@ class TPUBaseTrainer(BaseRLTrainer):
 
         profile = self.obs.profile
         for _ in range(self.config.train.epochs):
+            if done < skip_target:
+                # fully-skipped epochs cost nothing (not even collation)
+                try:
+                    per_epoch = len(self.train_dataloader) * self.n_updates_per_batch
+                except TypeError:
+                    per_epoch = None
+                if per_epoch and done + per_epoch <= skip_target:
+                    done += per_epoch
+                    # trainers that reuse one loader across epochs (SFT/
+                    # ILQL) draw a fresh shuffle per epoch from a stateful
+                    # RNG: burn the skipped epoch's draw so the resume
+                    # epoch's order matches the uninterrupted run. Trainers
+                    # that rebuild the loader every epoch (PPO's post-epoch
+                    # refill) must NOT burn — their resumed loader is
+                    # already the fresh one.
+                    if not getattr(self, "_fresh_loader_per_epoch", False) and hasattr(
+                        self.train_dataloader, "advance_epoch"
+                    ):
+                        self.train_dataloader.advance_epoch()
+                    continue
+            epoch_ran = False
             for batch in self._maybe_prefetch(self.train_dataloader):
+                batch_ran = False
                 for _ in range(self.n_updates_per_batch):
+                    if done < skip_target:
+                        done += 1
+                        continue
+                    batch_ran = epoch_ran = True
+                    self._check_faults_and_preemption()
                     profile.on_step_start(self.iter_count)
                     with profile.step_annotation("train", self.iter_count):
                         with self.obs.span("train_step") as sp:
@@ -1034,6 +1214,11 @@ class TPUBaseTrainer(BaseRLTrainer):
                             # fence the timer reads async dispatch latency
                             sp.fence((self.state, device_stats))
                     stats = filter_non_scalars(to_host(device_stats))
+                    # update guard: the on-device finiteness flag landed
+                    # with the stats; skip was already applied on device,
+                    # rollback/halt are host decisions (docs/RESILIENCE.md)
+                    if self.resilience.guard.after_step(stats) == "rollback":
+                        self._rollback_to_committed()
                     step_time = sp.duration
                     stats["time/step"] = step_time
                     stats["time/train_step"] = step_time
@@ -1058,6 +1243,12 @@ class TPUBaseTrainer(BaseRLTrainer):
                     self.iter_count += 1
 
                     if self.iter_count % self.config.train.checkpoint_interval == 0:
+                        # retention ring: prune BEFORE saving so the join
+                        # inside prune waits on the long-finished previous
+                        # save, not the one about to dispatch
+                        keep = self.resilience.config.keep_last_n
+                        if keep > 0:
+                            prune_checkpoints(self.config.train.checkpoint_dir, keep)
                         subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
                         self.save(os.path.join(self.config.train.checkpoint_dir, subfolder))
 
@@ -1069,8 +1260,8 @@ class TPUBaseTrainer(BaseRLTrainer):
                             reward = stats.get(
                                 "reward/mean", stats.get("metrics/reward", -float("inf"))
                             )
-                            if reward > best_reward:
-                                best_reward = reward
+                            if reward > self.best_reward:
+                                self.best_reward = reward
                                 best_path = os.path.join(
                                     self.config.train.checkpoint_dir, "best_checkpoint"
                                 )
@@ -1115,9 +1306,11 @@ class TPUBaseTrainer(BaseRLTrainer):
 
                     self.tracker.log(stats, step=self.iter_count)
 
-                self.post_backward_callback()
-            self._drop_batch_memo()  # free the batch's HBM before rollouts
-            self.post_epoch_callback()
+                if batch_ran:  # fully fast-forwarded batches already had
+                    self.post_backward_callback()  # their callback pre-checkpoint
+            if epoch_ran:
+                self._drop_batch_memo()  # free the batch's HBM before rollouts
+                self.post_epoch_callback()
         profile.stop()
         tbar.close()
         wait_for_saves()  # async saves must land before exit
@@ -1148,27 +1341,27 @@ class TPUBaseTrainer(BaseRLTrainer):
         root = self.config.train.checkpoint_dir
         if not os.path.isdir(root):
             return
-        def step_of(name: str) -> int:
-            try:
-                return int(name.rsplit("_", 1)[1])
-            except ValueError:
-                return -1
+        wait_for_saves()  # a same-process save may still be pending its commit
+        # Only COMMITTED checkpoints are candidates: a crash mid-save leaves
+        # a partial dir that Orbax would die restoring — skip it with a
+        # warning and take the newest committed one instead. The scan
+        # (numeric step sort, commit test) is the same helper the update
+        # guard's rollback uses, so resume and rollback can never disagree
+        # about which checkpoint is newest.
+        from trlx_tpu.utils.checkpoint import _checkpoint_step_dirs
 
-        # numeric sort: zero-padding width follows total_steps, so a resumed
-        # run with a different total_steps would break a lexicographic sort
-        candidates = sorted(
-            (
-                d
-                for d in os.listdir(root)
-                if d.startswith("checkpoint_")
-                and step_of(d) >= 0
-                and os.path.isdir(os.path.join(root, d, "state"))
-            ),
-            key=step_of,
-        )
+        candidates = []
+        for _step, path in _checkpoint_step_dirs(root):
+            if is_committed(path):
+                candidates.append(path)
+            else:
+                logger.warning(
+                    f"skipping uncommitted/partial checkpoint {path} "
+                    "(crash mid-save?); the newest committed checkpoint wins"
+                )
         if not candidates:
             return
-        path = os.path.join(root, candidates[-1])
+        path = candidates[-1]
         logger.info(f"Resuming training state from {path}")
         self.load(path)
 
@@ -1181,19 +1374,100 @@ class TPUBaseTrainer(BaseRLTrainer):
     def _restore_extra_checkpoint_state(self, extra: Dict[str, Any]) -> None:
         pass
 
-    def save(self, directory: Optional[str] = None, **kwargs) -> None:
-        """Checkpoint full training state (params, opt state, step, RNG)."""
+    def _save_emergency_payload(self, directory: str) -> None:
+        """Trainer hook: persist host-side data an exact mid-run resume
+        needs beyond the TrainState (PPO: the rollout store)."""
+
+    def _restore_emergency_payload(self, directory: str) -> None:
+        pass
+
+    @staticmethod
+    def _rng_to_list(key) -> list:
+        """A PRNG key as a JSON-serializable uint32 list (old-style and
+        typed keys both)."""
+        try:
+            data = jax.random.key_data(key)
+        except (TypeError, ValueError):
+            data = key
+        return np.asarray(jax.device_get(data), np.uint32).tolist()
+
+    def _rng_from_list(self, data: list, template):
+        arr = np.asarray(data, np.uint32)
+        try:
+            if jnp.issubdtype(template.dtype, jax.dtypes.prng_key):
+                return jax.random.wrap_key_data(arr)
+        except (AttributeError, TypeError):
+            pass
+        return jnp.asarray(arr)
+
+    def save(
+        self, directory: Optional[str] = None, emergency: bool = False, **kwargs
+    ) -> None:
+        """Checkpoint full training state (params, opt state, step, RNG).
+
+        ``emergency=True`` (preemption path) additionally freezes the
+        host-side run position — rollout RNG, eval counter, best reward,
+        and the trainer's emergency payload (PPO: the rollout store) — so a
+        resumed run continues bit-identically from this step boundary."""
         directory = directory or self.config.train.checkpoint_dir
-        extra = {"iter_count": self.iter_count}
+        extra = {"iter_count": self.iter_count, "best_reward": self.best_reward}
         extra.update(self._extra_checkpoint_state())
+        if emergency:
+            extra["emergency"] = True
+            extra["rollout_rng"] = self._rng_to_list(self._rollout_rng)
+            extra["nth_evaluation"] = self.nth_evaluation
+            os.makedirs(directory, exist_ok=True)
+            self._save_emergency_payload(directory)
         save_state(directory, self.state, extra=extra)
 
-    def load(self, directory: Optional[str] = None, **kwargs) -> None:
+    def load(
+        self,
+        directory: Optional[str] = None,
+        restore_payload: bool = True,
+        **kwargs,
+    ) -> None:
         directory = directory or self.config.train.checkpoint_dir
         self.state = restore_state(directory, self.state)
         extra = read_extra(directory)
         self.iter_count = int(extra.get("iter_count", 0))
+        if "best_reward" in extra:
+            self.best_reward = float(extra["best_reward"])
         self._restore_extra_checkpoint_state(extra)
+        if restore_payload and extra.get("emergency"):
+            # an emergency checkpoint froze the run mid-learn: restore the
+            # host-side position so learn() fast-forwards to the boundary
+            self._emergency_resume = True
+            if "rollout_rng" in extra:
+                self._rollout_rng = self._rng_from_list(
+                    extra["rollout_rng"], self._rollout_rng
+                )
+            self.nth_evaluation = int(
+                extra.get("nth_evaluation", self.nth_evaluation)
+            )
+            self._restore_emergency_payload(directory)
+
+    def _rollback_to_committed(self) -> None:
+        """Update-guard rollback: restore the newest committed checkpoint's
+        device + controller state, keep the loop bookkeeping marching
+        forward (the poison batch is skipped, not retried)."""
+        root = self.config.train.checkpoint_dir
+        path = newest_committed_checkpoint(root)
+        if path is None:
+            # rollback is flag-only on device (no keep-old select), so the
+            # poisoned update has already landed — without a committed
+            # checkpoint there is nothing sane to continue from
+            from trlx_tpu.resilience import NonFiniteUpdateError
+
+            raise NonFiniteUpdateError(
+                f"non-finite update with update_guard='rollback' but no "
+                f"committed checkpoint exists under {root} to restore — "
+                "halting (lower train.checkpoint_interval, or use 'skip')"
+            )
+        cur_iter, cur_best = self.iter_count, self.best_reward
+        self.load(path, restore_payload=False)
+        self.iter_count, self.best_reward = cur_iter, cur_best
+        self._drop_batch_memo()
+        logger.warning(f"rolled back train state to {path}")
 
     def save_pretrained(self, directory: Optional[str] = None, **kwargs) -> None:
         directory = directory or f"{self.config.train.checkpoint_dir}/hf_model"

@@ -295,8 +295,15 @@ class GRPOTrainer(PPOTrainer):
         agg["gen_time_sum"] += engine.stats.decode_s + engine.stats.refill_s
         agg["engine_stats"] = engine.stats
 
+    def _store_element_cls(self) -> type:
+        # emergency-checkpoint payload (PPOTrainer hooks): GRPO elements
+        # serialize through the same field-generic code path
+        return GRPORLElement
+
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:
         """Collect grouped rollouts with group-relative advantages."""
+        if self._consume_skip_initial_experience():
+            return
         logger.info("Collecting GRPO rollouts")
         if self.prompt_iterator is None:
             raise RuntimeError("add_prompt_pipeline must be called before make_experience")

@@ -20,6 +20,7 @@ math (KL penalty, masked stats) runs on device in one jitted program per
 shape bucket.
 """
 
+import os
 from contextlib import ExitStack
 from time import time
 from typing import Any, Dict, Optional, Tuple
@@ -48,6 +49,9 @@ logger = logging.get_logger(__name__)
 @register_trainer
 class PPOTrainer(TPUBaseTrainer):
     model_head = "value"
+    # post_epoch_callback rebuilds the dataloader from the refilled store:
+    # the emergency-resume fast-forward must not burn shuffle draws on it
+    _fresh_loader_per_epoch = True
 
     def __init__(self, config: TRLConfig, **kwargs):
         super().__init__(config, **kwargs)
@@ -129,6 +133,9 @@ class PPOTrainer(TPUBaseTrainer):
     def _extra_checkpoint_state(self) -> Dict[str, Any]:
         return {
             "kl_ctl_value": float(self.kl_ctl.value),
+            # the post-backward KL update reads mean_kl from the last
+            # collection; a resumed run must apply the same update
+            "mean_kl": float(self.mean_kl),
             "running_moments": {
                 "mean": self.running_moments.mean,
                 "std": self.running_moments.std,
@@ -140,12 +147,65 @@ class PPOTrainer(TPUBaseTrainer):
     def _restore_extra_checkpoint_state(self, extra: Dict[str, Any]) -> None:
         if "kl_ctl_value" in extra:
             self.kl_ctl.value = float(extra["kl_ctl_value"])
+        if "mean_kl" in extra:
+            self.mean_kl = float(extra["mean_kl"])
         rm = extra.get("running_moments")
         if rm:
             self.running_moments.mean = rm["mean"]
             self.running_moments.std = rm["std"]
             self.running_moments.var = rm["var"]
             self.running_moments.count = rm["count"]
+
+    # -- emergency-checkpoint payload (docs/RESILIENCE.md) --------------
+    #
+    # A preemption freezes the run BETWEEN two updates, usually mid-epoch:
+    # the store still holds rollouts the remaining updates must train on.
+    # The payload serializes them (field-generically — GRPO's element type
+    # rides the same code) so the resumed run replays the exact batches an
+    # uninterrupted run would, instead of re-collecting with the restored
+    # policy and diverging.
+
+    _STORE_PAYLOAD = "rollout_store.npz"
+
+    def _store_element_cls(self) -> type:
+        return PPORLElement
+
+    def _save_emergency_payload(self, directory: str) -> None:
+        import dataclasses as _dc
+
+        arrays: Dict[str, np.ndarray] = {"count": np.asarray(len(self.store.history))}
+        for i, elem in enumerate(self.store.history):
+            for f in _dc.fields(elem):
+                value = np.asarray(getattr(elem, f.name))
+                if value.dtype.kind == "V":
+                    # custom float dtypes (bfloat16) round-trip through npz
+                    # as raw void bytes; widen to f32 — exact, and collation
+                    # casts these fields to f32 for the train batch anyway
+                    value = value.astype(np.float32)
+                arrays[f"{i}.{f.name}"] = value
+        np.savez(os.path.join(directory, self._STORE_PAYLOAD), **arrays)
+
+    def _restore_emergency_payload(self, directory: str) -> None:
+        import dataclasses as _dc
+
+        path = os.path.join(directory, self._STORE_PAYLOAD)
+        if not os.path.exists(path):
+            return
+        cls = self._store_element_cls()
+        names = [f.name for f in _dc.fields(cls)]
+        with np.load(path) as data:
+            elements = []
+            for i in range(int(data["count"])):
+                fields = {}
+                for name in names:
+                    value = data[f"{i}.{name}"]
+                    fields[name] = value.item() if value.ndim == 0 else value
+                elements.append(cls(**fields))
+        self.store.clear_history()
+        self.store.push(elements)
+        # the initial trlx.train() collection must be skipped exactly once:
+        # the uninterrupted run would be training on THESE rollouts here
+        self._skip_initial_experience = True
 
     def setup_rollout_logging(self, config: TRLConfig) -> None:
         import os
@@ -732,10 +792,24 @@ class PPOTrainer(TPUBaseTrainer):
             stats["time/exp_generate"] = engine.stats.decode_s + engine.stats.refill_s
             stats["time/generate"] = engine.stats.decode_s
 
+    def _consume_skip_initial_experience(self) -> bool:
+        """True exactly once after an emergency-payload restore: the store
+        already holds the rollouts this collection would replace."""
+        if getattr(self, "_skip_initial_experience", False):
+            self._skip_initial_experience = False
+            logger.info(
+                "emergency resume: rollout store restored from the checkpoint; "
+                "skipping the initial collection"
+            )
+            return True
+        return False
+
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:
         """Collect ``num_rollouts`` experiences into the store (reference
         ``accelerate_ppo_trainer.py:251-489``), overlapping device generation
         with host reward scoring when ``train.rollout_pipeline_depth`` > 0."""
+        if self._consume_skip_initial_experience():
+            return
         logger.info("Collecting rollouts")
         if self.prompt_iterator is None:
             raise RuntimeError("add_prompt_pipeline must be called before make_experience")

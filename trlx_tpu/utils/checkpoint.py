@@ -11,7 +11,8 @@ host — and ``save_pretrained`` writes a flax msgpack + config JSON.
 import json
 import os
 import shutil
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -21,6 +22,18 @@ import numpy as np
 # train loop resumes immediately (the reference's accelerator.save_state
 # blocks; at multi-GB states that is seconds-to-minutes per interval).
 _ASYNC_CKPTR = None
+
+# Atomic-commit protocol (docs/RESILIENCE.md): a save stages into
+# ``state.staging`` and only *replaces* ``state`` — rename + commit-marker
+# write — after the (a)sync write has fully landed. The pre-existing tree is
+# therefore restorable at every instant of a save; the old rmtree-before-
+# write flow had a crash window with ZERO restorable checkpoints. For async
+# saves the commit closure is deferred until the write is joined
+# (``wait_for_saves`` — called by the next save, any restore, and end of
+# training), so the hot loop still returns immediately.
+COMMIT_MARKER = "COMMITTED"
+
+_PENDING_COMMIT: Optional[Callable[[], None]] = None
 
 
 def _async_checkpointer():
@@ -33,39 +46,164 @@ def _async_checkpointer():
 
 
 def wait_for_saves() -> None:
-    """Block until every in-flight async save has committed to disk. Called
-    before reads/overwrites of checkpoint directories and at end of
-    training — an unawaited final save could otherwise be lost with the
-    process."""
+    """Block until every in-flight async save has landed AND committed
+    (staging renamed over ``state``, marker written). Called before reads/
+    overwrites of checkpoint directories and at end of training — an
+    unawaited final save could otherwise be lost with the process."""
+    global _PENDING_COMMIT
     if _ASYNC_CKPTR is not None:
         _ASYNC_CKPTR.wait_until_finished()
+    commit, _PENDING_COMMIT = _PENDING_COMMIT, None
+    if commit is not None:
+        commit()
+
+
+def _recover_interrupted_swap(directory: str) -> None:
+    """Heal a directory whose overwrite-commit crashed between the two
+    renames: the previous tree sits complete in ``state.old`` with no
+    ``state`` beside it — move it back so the checkpoint is restorable
+    again. Called before any save into / restore from ``directory``."""
+    tree_dir = os.path.join(os.path.abspath(directory), "state")
+    old_dir = tree_dir + ".old"
+    if os.path.isdir(old_dir) and not os.path.isdir(tree_dir):
+        os.rename(old_dir, tree_dir)
+
+
+def is_committed(directory: str) -> bool:
+    """Does ``directory`` hold a complete, committed checkpoint?
+
+    True when the commit marker is present alongside a complete tree —
+    either ``state``, or ``state.old`` left by a crash mid-swap (healed by
+    :func:`_recover_interrupted_swap` at the next save/restore) — or, for
+    checkpoints written before the marker protocol, when the ``state`` tree
+    exists with no staging/swap remnants beside it. Partial dirs (a crash
+    mid-save) fail every test and must be skipped by resume/rollback."""
+    directory = os.path.abspath(directory)
+    tree_dir = os.path.join(directory, "state")
+    has_tree = os.path.isdir(tree_dir) or os.path.isdir(tree_dir + ".old")
+    if not has_tree:
+        return False
+    if os.path.exists(os.path.join(directory, COMMIT_MARKER)):
+        return True
+    # legacy (pre-marker) layout: the tree was written in place, so its
+    # existence is the only signal — but staging/old remnants mean a newer
+    # save died mid-swap and the tree's vintage is ambiguous
+    return (
+        os.path.isdir(tree_dir)
+        and not os.path.exists(tree_dir + ".staging")
+        and not os.path.exists(tree_dir + ".old")
+    )
+
+
+def _checkpoint_step_dirs(root: str) -> List[Tuple[int, str]]:
+    """``(step, path)`` for every ``checkpoint_<int>`` dir under ``root``,
+    numerically sorted (zero-padding width varies with total_steps)."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        if not name.startswith("checkpoint_"):
+            continue
+        try:
+            step = int(name.rsplit("_", 1)[1])
+        except ValueError:
+            continue
+        path = os.path.join(root, name)
+        if os.path.isdir(path):
+            out.append((step, path))
+    return sorted(out)
+
+
+def newest_committed_checkpoint(root: str) -> Optional[str]:
+    """The highest-step committed ``checkpoint_<int>`` dir under ``root``,
+    or None. The update guard's rollback and ``maybe_resume`` both restore
+    only from here — never from a partial save."""
+    wait_for_saves()  # a same-process save may still be pending its commit
+    for _step, path in reversed(_checkpoint_step_dirs(root)):
+        if is_committed(path):
+            return path
+    return None
+
+
+def prune_checkpoints(root: str, keep_last_n: int) -> List[str]:
+    """Retention ring: delete committed ``checkpoint_<int>`` dirs beyond the
+    newest ``keep_last_n``. Uncommitted/partial dirs and ``best_checkpoint``
+    are never touched; 0 disables. Returns the pruned paths."""
+    if keep_last_n <= 0:
+        return []
+    wait_for_saves()  # never prune under an in-flight save
+    committed = [p for _s, p in _checkpoint_step_dirs(root) if is_committed(p)]
+    pruned = committed[:-keep_last_n] if keep_last_n else []
+    for path in pruned:
+        shutil.rmtree(path, ignore_errors=True)
+    return pruned
 
 
 def save_state(
     directory: str, state: Any, extra: Optional[Dict] = None, async_save: bool = True
 ) -> None:
-    """Save a train-state pytree (+ small JSON ``extra``) to ``directory``.
+    """Save a train-state pytree (+ small JSON ``extra``) to ``directory``
+    with an atomic commit: the previous checkpoint stays restorable until
+    the replacement has fully landed.
 
-    ``async_save`` returns as soon as the device arrays are snapshotted;
-    IO completes in the background (``wait_for_saves`` joins it).
+    ``async_save`` returns as soon as the device arrays are snapshotted; IO
+    completes in the background and the commit (staging → ``state`` rename,
+    marker write) runs when the save is next joined (``wait_for_saves``).
     """
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
     tree_dir = os.path.join(directory, "state")
-    # never rmtree under an in-flight write to the same tree
+    staging_dir = tree_dir + ".staging"
+    # join + commit any in-flight save before touching shared paths
     wait_for_saves()
-    if os.path.exists(tree_dir):
-        shutil.rmtree(tree_dir)
     os.makedirs(directory, exist_ok=True)
+    _recover_interrupted_swap(directory)
+    if os.path.exists(staging_dir):  # leftover from a crashed save: garbage
+        shutil.rmtree(staging_dir)
+    # extra JSON stages alongside the tree: a crash pre-commit must not mix
+    # a new iter_count with the old params
+    extra_path = os.path.join(directory, "trainer_state.json")
+    if extra is not None:
+        with open(extra_path + ".staging", "w") as f:
+            json.dump(extra, f)
+
+    def commit() -> None:
+        from trlx_tpu.resilience.faults import InjectedFault, poll_fault
+
+        if poll_fault("crash_save"):
+            raise InjectedFault(
+                f"fault plan: crash before checkpoint commit ({directory})"
+            )
+        # Swap order keeps SOME complete tree recoverable at every instant:
+        # the marker is never deleted (it vouches for whichever complete
+        # tree is present), the old tree moves aside intact, and a crash
+        # between the renames is healed by _recover_interrupted_swap (old
+        # tree moved back) on the next save/restore of this directory.
+        marker = os.path.join(directory, COMMIT_MARKER)
+        old_dir = tree_dir + ".old"
+        if os.path.exists(old_dir):
+            shutil.rmtree(old_dir)
+        if os.path.exists(tree_dir):
+            os.rename(tree_dir, old_dir)
+        else:
+            old_dir = None
+        os.rename(staging_dir, tree_dir)
+        if extra is not None:
+            os.replace(extra_path + ".staging", extra_path)
+        with open(marker, "w") as f:
+            json.dump({"time": time.time()}, f)
+        if old_dir is not None:
+            shutil.rmtree(old_dir)
+
     if async_save:
-        _async_checkpointer().save(tree_dir, state)
+        global _PENDING_COMMIT
+        _async_checkpointer().save(staging_dir, state)
+        _PENDING_COMMIT = commit
     else:
         with ocp.PyTreeCheckpointer() as ckptr:
-            ckptr.save(tree_dir, state)
-    if extra is not None:
-        with open(os.path.join(directory, "trainer_state.json"), "w") as f:
-            json.dump(extra, f)
+            ckptr.save(staging_dir, state)
+        commit()
 
 
 def restore_state(directory: str, template: Any) -> Any:
@@ -78,6 +216,7 @@ def restore_state(directory: str, template: Any) -> Any:
 
     wait_for_saves()  # the checkpoint being restored may still be in flight
     directory = os.path.abspath(directory)
+    _recover_interrupted_swap(directory)
     tree_dir = os.path.join(directory, "state")
 
     def as_restore_type(x):
@@ -89,7 +228,26 @@ def restore_state(directory: str, template: Any) -> Any:
 
     restore_args = jax.tree_util.tree_map(as_restore_type, template)
     with ocp.PyTreeCheckpointer() as ckptr:
-        return ckptr.restore(tree_dir, item=template, restore_args=restore_args)
+        restored = ckptr.restore(tree_dir, item=template, restore_args=restore_args)
+    # Donation hazard: buffers handed out by the Orbax restore, when donated
+    # into a train-step executable DESERIALIZED from the persistent compile
+    # cache, corrupt the process heap (observed as a segfault/glibc abort in
+    # the first post-restore step — the long-standing crash under
+    # tests/test_trainers.py::test_auto_resume_from_checkpoint). Re-land
+    # them as fresh standard device buffers, freeing each Orbax buffer as
+    # soon as its copy lands so peak memory stays one-leaf-above the state
+    # size (a whole-tree copy would transiently need 2× state HBM).
+    import jax.numpy as jnp
+
+    def reland(x):
+        if not isinstance(x, jax.Array):
+            return x
+        y = jnp.copy(x)
+        y.block_until_ready()
+        x.delete()
+        return y
+
+    return jax.tree_util.tree_map(reland, restored)
 
 
 def read_extra(directory: str) -> Dict:
