@@ -1,105 +1,42 @@
 #!/usr/bin/env python
-"""Static lint: every ``stats["..."]`` key in ``trlx_tpu/`` follows the
-``namespace/name`` metric convention (docs/OBSERVABILITY.md).
+"""Thin shim: the metric-name lint now lives in the graftlint framework as
+the ``metric-names`` pass (``trlx_tpu/analysis/conventions.py``,
+docs/STATIC_ANALYSIS.md).
 
-A grep-shaped check, deliberately dumb: it scans source text for string
-subscripts on variables named ``stats`` (``stats["time/step"]``,
-``stats[f"reward/mean{suffix}"]``) — plus metric-registry call sites
-(``metrics.inc("resilience/reward_retries")``, ``metrics.set_gauge(...)``),
-which is how the resilience counters reach the tracker stream — and asserts
-each literal key contains a ``/`` separating a lowercase namespace from a
-name. Keys that predate the convention live in ``LEGACY_KEYS`` — shrink
-that set, never grow it.
-
-Exit code 0 when clean; 1 with a per-site listing otherwise. Wired into the
-fast test tier as ``tests/test_metric_names.py``.
+Kept so existing invocations (``python scripts/check_metric_names.py``) and
+``tests/test_metric_names.py`` keep working unchanged — the public helpers
+(``find_violations``/``scanned_keys``/``LEGACY_KEYS``/``RESILIENCE_KEYS``)
+re-export the framework implementations with identical semantics. Prefer
+``scripts/lint.py`` (all passes) going forward.
 """
 
 import os
-import re
 import sys
-from typing import Dict, List, Tuple
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SCAN_DIR = os.path.join(REPO_ROOT, "trlx_tpu")
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
 
-# \bstats\[ : the dict must be *named* stats (not spec_stats, device_stats…)
-# Second alternative: MetricsRegistry writes — receivers named/suffixed
-# "metrics" calling inc()/set_gauge() with a literal first argument (the
-# registry's observe() is excluded: RecompileWatchdog.observe's first arg is
-# a program name, not a metric key).
-_KEY_RE = re.compile(
-    r'\bstats\[\s*f?"([^"]+)"'
-    r'|\bmetrics\.(?:inc|set_gauge)\(\s*f?"([^"]+)"'
+from trlx_tpu.analysis.conventions import (  # noqa: E402,F401
+    LEGACY_KEYS,
+    RESILIENCE_KEYS,
+    _CONVENTION_RE,
+    _KEY_RE,
+    find_violations as _find_violations,
+    scanned_keys as _scanned_keys,
 )
 
-# namespace/name: lowercase_snake namespace, then anything non-empty (names
-# may carry f-string fields, sweep suffixes, dots, @-qualifiers)
-_CONVENTION_RE = re.compile(r"^[a-z][a-z0-9_]*/\S+$")
-
-# Pre-convention keys, kept for dashboard/log continuity. Do not add to this
-# list — new metrics must be namespaced.
-LEGACY_KEYS = frozenset({
-    "learning_rate",
-    "kl_ctl_value",
-})
-
-# Canonical resilience/* metric keys (docs/RESILIENCE.md). The retry
-# counters are emitted through a parameterized helper
-# (HostCallGuard._inc(f"resilience/{name}_retries")) the static scan can't
-# see, so the full set is registered here; tests/test_metric_names.py
-# asserts every entry follows the convention and that the statically
-# visible ones reach the scanner.
-RESILIENCE_KEYS = frozenset({
-    "resilience/update_ok",
-    "resilience/nonfinite_updates",
-    "resilience/skipped_updates",
-    "resilience/rollbacks",
-    "resilience/goodput_frac",
-    "resilience/preemptions",
-    "resilience/reward_retries",
-    "resilience/reward_failures",
-    "resilience/reward_fallbacks",
-    "resilience/publish_retries",
-    "resilience/publish_failures",
-    "resilience/publish_fallbacks",
-})
+SCAN_DIR = os.path.join(REPO_ROOT, "trlx_tpu")
 
 
-def find_violations(scan_dir: str = SCAN_DIR) -> List[Tuple[str, int, str]]:
+def find_violations(scan_dir: str = SCAN_DIR):
     """All (relpath, lineno, key) whose key breaks the convention."""
-    violations = []
-    for dirpath, _dirnames, filenames in os.walk(scan_dir):
-        for filename in sorted(filenames):
-            if not filename.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, filename)
-            with open(path) as f:
-                for lineno, line in enumerate(f, start=1):
-                    for groups in _KEY_RE.findall(line):
-                        key = groups[0] or groups[1]
-                        if key in LEGACY_KEYS or _CONVENTION_RE.match(key):
-                            continue
-                        violations.append(
-                            (os.path.relpath(path, REPO_ROOT), lineno, key)
-                        )
-    return violations
+    return _find_violations(scan_dir)
 
 
-def scanned_keys(scan_dir: str = SCAN_DIR) -> Dict[str, int]:
-    """key → occurrence count over the tree (for the test's sanity check
-    that the scanner actually sees the codebase's stats writes)."""
-    counts: Dict[str, int] = {}
-    for dirpath, _dirnames, filenames in os.walk(scan_dir):
-        for filename in filenames:
-            if not filename.endswith(".py"):
-                continue
-            with open(os.path.join(dirpath, filename)) as f:
-                for line in f:
-                    for groups in _KEY_RE.findall(line):
-                        key = groups[0] or groups[1]
-                        counts[key] = counts.get(key, 0) + 1
-    return counts
+def scanned_keys(scan_dir: str = SCAN_DIR):
+    """key → occurrence count over the tree."""
+    return _scanned_keys(scan_dir)
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""graftlint CLI — thin wrapper over ``python -m trlx_tpu.analysis``.
+
+Usage: ``python scripts/graftlint.py [trlx_tpu/] [--baseline FILE]
+[--select pass1,pass2] [--list-passes] [--update-baseline]`` — see
+docs/STATIC_ANALYSIS.md for the pass catalog and baseline workflow.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from trlx_tpu.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""CI lint entry point: run EVERY graftlint pass (metric-names included)
+over the real ``trlx_tpu/`` tree against the committed baseline
+(``GRAFTLINT_BASELINE.txt``). Non-zero exit on any non-baselined finding
+or stale baseline entry.
+
+Wired into the fast test tier as the self-run in ``tests/test_analysis.py``
+— ``pytest tests/`` fails when the tree regresses, making the linter a
+standing CI gate (docs/STATIC_ANALYSIS.md).
+"""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from trlx_tpu.analysis import main  # noqa: E402
+
+
+def run(argv=None) -> int:
+    argv = list(argv) if argv is not None else []
+    if not any(a for a in argv if not a.startswith("-")):
+        argv = [os.path.join(REPO_ROOT, "trlx_tpu")] + argv
+    if "--baseline" not in argv and "--no-baseline" not in argv:
+        argv += ["--baseline", os.path.join(REPO_ROOT, "GRAFTLINT_BASELINE.txt")]
+    return main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

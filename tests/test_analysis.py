@@ -1,0 +1,763 @@
+"""graftlint (trlx_tpu/analysis): per-pass fixtures, baseline semantics,
+and the tier-1 self-run over the real tree (docs/STATIC_ANALYSIS.md).
+
+The self-run is the CI gate: any non-baselined finding on ``trlx_tpu/``,
+or any stale baseline entry, fails ``pytest tests/``."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from trlx_tpu.analysis import (
+    AnalysisContext,
+    Baseline,
+    BaselineError,
+    all_passes,
+    main,
+    run_analysis,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TREE = os.path.join(REPO_ROOT, "trlx_tpu")
+BASELINE = os.path.join(REPO_ROOT, "GRAFTLINT_BASELINE.txt")
+
+
+def lint_pkg(tmp_path, files, passes=None, name="pkg"):
+    """Write a throwaway package and run passes over it."""
+    root = tmp_path / name
+    root.mkdir(exist_ok=True)
+    (root / "__init__.py").write_text("")
+    for relname, text in files.items():
+        path = root / relname
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text))
+    findings, _ctx = run_analysis(str(root), passes=passes)
+    return findings
+
+
+def codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# host-sync (GL1xx)
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_positive(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "bad.py": """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            def traced(x, tracker):
+                v = float(jnp.sum(x))
+                print("debug")
+                y = x.item()
+                z = np.asarray(x)
+                w = jax.device_get(x)
+                tracker.log({"a/b": 1.0}, step=0)
+                return v + y
+
+            jax.jit(traced)
+            """
+        },
+        passes=["host-sync"],
+    )
+    assert codes(findings) == [
+        "GL101", "GL102", "GL103", "GL104", "GL105", "GL106",
+    ]
+    assert all("traced via root `traced`" in f.message for f in findings)
+
+
+def test_host_sync_negative(tmp_path):
+    # the same constructs OUTSIDE jit-reachable code are host-side and fine;
+    # inside traced code, shape math and jnp conversions are fine too
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "good.py": """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            def host_only(x):
+                print("host")
+                return float(np.asarray(x).sum())
+
+            def traced(x):
+                B = int(x.shape[0])          # shape math: static, no sync
+                y = jnp.asarray(x) + B       # jnp conversion stays on device
+                n = float("inf")             # literal, not an array
+                return y * n
+
+            jax.jit(traced)
+            """
+        },
+        passes=["host-sync"],
+    )
+    assert findings == []
+
+
+def test_host_sync_reaches_through_calls_and_references(tmp_path):
+    # helper called from a jitted root — and a body passed by reference to
+    # lax.while_loop — are both traced
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "deep.py": """
+            import jax
+
+            def helper(x):
+                return x.item()
+
+            def root(x):
+                def body(c):
+                    return helper(c)
+                def cond(c):
+                    return c.any()
+                return jax.lax.while_loop(cond, body, x)
+
+            jax.jit(root)
+            """
+        },
+        passes=["host-sync"],
+    )
+    assert codes(findings) == ["GL101"]
+    assert findings[0].symbol == "helper"
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard (GL2xx)
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_positive(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "bad.py": """
+            import jax
+
+            def loopy(fs):
+                for f in fs:
+                    g = jax.jit(f)
+                h = jax.jit(lambda x: x + 1)
+                return h
+
+            def ranged(n, x):
+                acc = x
+                for _ in range(n):
+                    acc = acc + 1
+                return acc
+
+            jax.jit(ranged)
+
+            def closure_hazard(x):
+                B, T = x.shape
+                def inner(y):
+                    return y.reshape(B, T)
+                return jax.jit(inner)
+            """
+        },
+        passes=["recompile-hazard"],
+    )
+    assert codes(findings) == ["GL201", "GL202", "GL203", "GL204"]
+    gl201 = next(f for f in findings if f.code == "GL201")
+    assert gl201.detail == "B,T"
+
+
+def test_recompile_negative(tmp_path):
+    # module-level jit, static_argnums, and non-shape closures are all fine
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "good.py": """
+            import functools
+            import jax
+
+            def ranged(n, x):
+                acc = x
+                for _ in range(n):
+                    acc = acc + 1
+                return acc
+
+            jax.jit(ranged, static_argnums=(0,))
+
+            @functools.partial(jax.jit, static_argnums=0)
+            def decorated(n, x):
+                for _ in range(n):
+                    x = x + 1
+                return x
+
+            def build(scale):
+                def inner(y):
+                    return y * scale     # config constant, not shape-derived
+                return jax.jit(inner)
+            """
+        },
+        passes=["recompile-hazard"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# donation-safety (GL301)
+# ---------------------------------------------------------------------------
+
+
+def test_donation_read_after_donate(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "bad.py": """
+            import jax
+
+            def step_impl(s, b):
+                return s
+
+            step = jax.jit(step_impl, donate_argnums=(0,))
+
+            def train(state, batch):
+                new = step(state, batch)
+                stale = state.params      # read after donation
+                return new, stale
+            """
+        },
+        passes=["donation-safety"],
+    )
+    assert codes(findings) == ["GL301"]
+    assert findings[0].detail == "state"
+
+
+def test_donation_rebind_is_clean(tmp_path):
+    # `state = step(state, b)` rebinding — and reads before the donating
+    # call — are the intended pattern
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "good.py": """
+            import jax
+
+            def step_impl(s, b):
+                return s, {}
+
+            def train(state, batches):
+                step = jax.jit(step_impl, donate_argnums=(0,))
+                total = state.step
+                for b in batches:
+                    state, stats = step(state, b)
+                return state
+            """
+        },
+        passes=["donation-safety"],
+    )
+    assert findings == []
+
+
+def test_donation_found_despite_nested_def_in_statement(tmp_path):
+    # a nested def inside the same compound statement must not abort the
+    # donation scan (the walk skips the def's subtree, not the statement)
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "m.py": """
+            import jax
+
+            def step_impl(s, b):
+                return s
+
+            step = jax.jit(step_impl, donate_argnums=(0,))
+
+            def check(x):
+                return True
+
+            def bad(state, b):
+                if check(step(state, b)):
+                    def helper():
+                        return 1
+                return state.params
+            """
+        },
+        passes=["donation-safety"],
+    )
+    assert codes(findings) == ["GL301"]
+
+
+def test_donation_through_factory_and_attr(tmp_path):
+    # the trainer pattern: a factory method returns the donating callable,
+    # an attribute holds it, another method calls it
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "cls.py": """
+            import jax
+
+            class Trainer:
+                def _build(self):
+                    def step_fn(s, b):
+                        return s, {}
+                    return jax.jit(step_fn, donate_argnums=(0,))
+
+                def setup(self):
+                    self._step = self._build()
+
+                def bad_step(self, batch):
+                    new, stats = self._step(self.state, batch)
+                    leak = self.state.params    # donated buffer read
+                    return new, leak
+
+                def good_step(self, batch):
+                    self.state, stats = self._step(self.state, batch)
+                    return self.state
+            """
+        },
+        passes=["donation-safety"],
+    )
+    assert codes(findings) == ["GL301"]
+    assert findings[0].symbol == "Trainer.bad_step"
+    assert findings[0].detail == "self.state"
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline (GL4xx)
+# ---------------------------------------------------------------------------
+
+_LOCKED_CLS = """
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.stats = []  # guarded-by: _lock
+
+    def locked(self, x):
+        with self._lock:
+            self.stats.append(x)
+
+    def {method}
+"""
+
+
+def test_lock_discipline_positive(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "bad.py": _LOCKED_CLS.format(
+                method="unlocked(self, x):\n        self.stats.append(x)"
+            )
+        },
+        passes=["lock-discipline"],
+    )
+    assert codes(findings) == ["GL401"]
+    assert findings[0].symbol == "Engine.unlocked"
+
+
+def test_lock_discipline_negative_and_init_exempt(tmp_path):
+    # locked mutation + __init__-time construction are both fine
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "good.py": _LOCKED_CLS.format(
+                method="also_locked(self, x):\n"
+                "        with self._lock:\n"
+                "            self.stats.extend(x)"
+            )
+        },
+        passes=["lock-discipline"],
+    )
+    assert findings == []
+
+
+def test_lock_discipline_typoed_lock_name(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "typo.py": """
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.stats = []  # guarded-by: _lok
+            """
+        },
+        passes=["lock-discipline"],
+    )
+    assert codes(findings) == ["GL402"]
+
+
+def test_lock_discipline_deep_chain_and_augassign(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "deep.py": """
+            import threading
+
+            class Stats:
+                total = 0.0
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.stats = Stats()  # guarded-by: _lock
+
+                def bad(self, dt):
+                    self.stats.total += dt
+
+                def good(self, dt):
+                    with self._lock:
+                        self.stats.total += dt
+            """
+        },
+        passes=["lock-discipline"],
+    )
+    assert codes(findings) == ["GL401"]
+    assert findings[0].detail == "self.stats.total:augassign"
+
+
+# ---------------------------------------------------------------------------
+# metric-names (GL501) and config-keys (GL601)
+# ---------------------------------------------------------------------------
+
+
+def test_metric_names_pass(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "mod.py": """
+            def f(stats, metrics):
+                stats["no_namespace"] = 1.0
+                stats["ok/key"] = 2.0
+                stats["learning_rate"] = 3.0     # frozen legacy allowlist
+                metrics.inc("resilience/reward_retries")
+                metrics.set_gauge("bad_gauge", 1.0)
+            """
+        },
+        passes=["metric-names"],
+    )
+    assert [(f.code, f.detail) for f in findings] == [
+        ("GL501", "no_namespace"),
+        ("GL501", "bad_gauge"),
+    ]
+
+
+_CONFIG_FILES = {
+    "configs.py": """
+    from dataclasses import dataclass
+
+    @dataclass
+    class MethodConfig:
+        name: str = "m"
+
+    @dataclass
+    class PPOConfig(MethodConfig):
+        chunk_size: int = 16
+
+    @dataclass
+    class TrainConfig:
+        batch_size: int = 1
+        seq_length: int = 64
+
+    @dataclass
+    class TRLConfig:
+        method: MethodConfig
+        train: TrainConfig
+    """,
+}
+
+
+def test_config_keys_pass(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            **_CONFIG_FILES,
+            "uses.py": """
+            def f(config):
+                ok = config.train.batch_size + config.method.chunk_size
+                bad = config.train.batch_sizee
+                also_ok = self_unrelated.train.whatever  # receiver not a config
+                return ok, bad
+            """,
+        },
+        passes=["config-keys"],
+    )
+    assert [(f.code, f.detail) for f in findings] == [
+        ("GL601", "train.batch_sizee")
+    ]
+
+
+def test_config_keys_on_real_configs():
+    # the real dataclasses are collected (guards against the pass going
+    # vacuous after a configs.py refactor)
+    from trlx_tpu.analysis.conventions import ConfigKeysPass
+
+    ctx = AnalysisContext(TREE)
+    sections = ConfigKeysPass()._collect_sections(ctx)
+    assert "rollout_pipeline_depth" in sections["train"]
+    assert "update_guard" in sections["resilience"]
+    assert "chunk_size" in sections["method"]  # union over MethodConfigs
+
+
+# ---------------------------------------------------------------------------
+# baseline semantics
+# ---------------------------------------------------------------------------
+
+_VIOLATION_PKG = {
+    "bad.py": """
+    import jax
+
+    def traced(x):
+        return x.item()
+
+    jax.jit(traced)
+    """
+}
+
+
+def test_baseline_suppression_and_staleness(tmp_path):
+    findings = lint_pkg(tmp_path, _VIOLATION_PKG, passes=["host-sync"])
+    assert len(findings) == 1
+    baseline = Baseline()
+    baseline.update(findings)
+
+    new, stale = baseline.apply(findings)
+    assert new == [] and stale == []  # suppressed
+
+    new, stale = baseline.apply([])  # the finding stopped firing
+    assert new == []
+    assert [e.key for e in stale] == [findings[0].key]  # stale = error
+
+    new, stale = Baseline().apply(findings)  # entry removed
+    assert new == findings  # resurfaces
+
+
+def test_baseline_requires_justification(tmp_path):
+    path = tmp_path / "b.txt"
+    path.write_text("GL101 pkg/bad.py:traced:.item\n")  # no ' :: reason'
+    with pytest.raises(BaselineError):
+        Baseline.load(str(path))
+    path.write_text("GL101 pkg/bad.py:traced:.item ::   \n")
+    with pytest.raises(BaselineError):
+        Baseline.load(str(path))
+    path.write_text("GL101 pkg/bad.py:traced:.item :: fenced, once per step\n")
+    assert len(Baseline.load(str(path)).entries) == 1
+
+
+def test_cli_exit_codes(tmp_path):
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "__init__.py").write_text("")
+    (root / "bad.py").write_text(textwrap.dedent(_VIOLATION_PKG["bad.py"]))
+
+    assert main([str(root), "--no-baseline"]) == 1  # violation
+
+    findings, _ = run_analysis(str(root), passes=["host-sync"])
+    good = tmp_path / "good_baseline.txt"
+    b = Baseline()
+    b.update(findings)
+    for e in b.entries.values():
+        e.justification = "fixture: intentional"
+    b.save(str(good))
+    assert main([str(root), "--baseline", str(good)]) == 0  # suppressed
+
+    stale = tmp_path / "stale_baseline.txt"
+    stale.write_text(
+        "GL101 pkg/gone.py:nope:.item :: matches nothing anymore\n"
+    )
+    assert main([str(root), "--no-baseline", "--select", "host-sync"]) == 1
+    assert main([str(root), "--baseline", str(stale)]) == 1  # stale entry
+
+    bad = tmp_path / "bad_baseline.txt"
+    bad.write_text("GL101 missing-justification\n")
+    assert main([str(root), "--baseline", str(bad)]) == 2  # parse error
+
+
+def test_cli_select_scopes_baseline(tmp_path):
+    """A pass-filtered run must neither report other passes' baseline
+    entries as stale nor (with --update-baseline) delete them."""
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "__init__.py").write_text("")
+    (root / "bad.py").write_text(textwrap.dedent(_VIOLATION_PKG["bad.py"]))
+    findings, _ = run_analysis(str(root), passes=["host-sync"])
+    bl = tmp_path / "bl.txt"
+    bl.write_text(
+        f"{findings[0].key} :: fixture: intentional\n"
+        "GL501 pkg/other.py:-:oldkey :: covered by a pass not selected here\n"
+    )
+    # the GL501 entry is out of scope for a host-sync-only run: not stale
+    assert main([str(root), "--select", "host-sync", "--baseline", str(bl)]) == 0
+    # ...and a filtered --update-baseline keeps it (and the justification)
+    assert main(
+        [str(root), "--select", "host-sync", "--baseline", str(bl),
+         "--update-baseline"]
+    ) == 0
+    kept = Baseline.load(str(bl))
+    assert set(kept.entries) == {
+        findings[0].key,
+        "GL501 pkg/other.py:-:oldkey",
+    }
+    assert kept.entries[findings[0].key].justification == "fixture: intentional"
+
+
+def test_cli_select_on_real_tree_exits_zero():
+    """The committed GL201 entries belong to recompile-hazard: selecting a
+    different pass must not see them as stale."""
+    assert main([TREE, "--select", "host-sync", "--baseline", BASELINE]) == 0
+
+
+def test_cli_rejects_no_baseline_with_update_baseline(tmp_path):
+    # the combination would rewrite the baseline without loading it,
+    # destroying every committed justification
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "__init__.py").write_text("")
+    marker = tmp_path / "GRAFTLINT_BASELINE.txt"
+    marker.write_text("# untouched\n")
+    assert main([str(root), "--no-baseline", "--update-baseline"]) == 2
+    assert marker.read_text() == "# untouched\n"
+
+
+def test_analysis_imports_without_jax():
+    """Lint-only CI contract: importing (and running) trlx_tpu.analysis
+    must not pull in the training stack — the package root's `train` is a
+    lazy attribute."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import sys; import trlx_tpu.analysis; "
+            "assert 'jax' not in sys.modules, 'analysis import loaded jax'",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_cli_syntax_errors_fail_honestly(tmp_path, capsys):
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "__init__.py").write_text("")
+    (root / "broken.py").write_text("def f(:\n")
+    assert main([str(root), "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "FAILED" in out and "unparseable" in out
+    assert "graftlint: OK" not in out
+    # --update-baseline must refuse: the broken file's findings are unknown
+    assert main([str(root), "--no-baseline", "--update-baseline"]) == 2
+
+
+def test_default_baseline_is_scan_root_adjacent_not_cwd(tmp_path, monkeypatch):
+    """Linting a scratch package from the repo root must not pick up (or
+    ever rewrite) the repo's committed GRAFTLINT_BASELINE.txt."""
+    from trlx_tpu.analysis.core import _default_baseline
+
+    monkeypatch.chdir(REPO_ROOT)
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "__init__.py").write_text("")
+    assert _default_baseline(str(root)) is None
+    assert _default_baseline(TREE) == BASELINE
+    # clean scratch package from the repo root: no spurious stale entries
+    assert main([str(root)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 self-run: the real tree, the committed baseline
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tree_findings():
+    findings, ctx = run_analysis(TREE)
+    assert ctx.errors == [], f"unparseable sources: {ctx.errors}"
+    return findings
+
+
+def test_self_run_tree_is_clean(tree_findings):
+    """THE gate: every finding on the committed tree is baselined (with a
+    justification) and every baseline entry still fires."""
+    baseline = Baseline.load(BASELINE)
+    new, stale = baseline.apply(tree_findings)
+    assert new == [], "non-baselined findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert stale == [], "stale baseline entries (fix shipped? delete them):\n" + \
+        "\n".join(e.key for e in stale)
+    for entry in baseline.entries.values():
+        assert not entry.needs_justification, entry.key
+
+
+def test_self_run_every_baseline_entry_is_load_bearing(tree_findings):
+    """Removing ANY single baseline entry must fail the gate."""
+    baseline = Baseline.load(BASELINE)
+    assert baseline.entries, "baseline unexpectedly empty"
+    for key in list(baseline.entries):
+        pruned = Baseline(
+            {k: v for k, v in baseline.entries.items() if k != key}
+        )
+        new, _stale = pruned.apply(tree_findings)
+        assert [f.key for f in new] and all(f.key == key for f in new), key
+
+
+def test_self_run_detects_injected_violation(tree_findings, tmp_path):
+    """A fresh violation (not in the baseline) must fail the gate — the
+    committed baseline cannot mask new regressions."""
+    findings = lint_pkg(tmp_path, _VIOLATION_PKG, passes=["host-sync"])
+    baseline = Baseline.load(BASELINE)
+    new, _ = baseline.apply(list(tree_findings) + findings)
+    assert [f.key for f in new] == [findings[0].key]
+
+
+def test_lint_py_ci_entry():
+    """scripts/lint.py (the CI entry point) exits 0 on the committed tree."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "lint.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "graftlint: OK" in proc.stdout
+
+
+def test_pass_registry_and_codes():
+    passes = all_passes()
+    assert set(passes) == {
+        "host-sync", "recompile-hazard", "donation-safety",
+        "lock-discipline", "metric-names", "config-keys",
+    }
+    seen = set()
+    for cls in passes.values():
+        assert cls.codes, cls.name
+        overlap = seen & set(cls.codes)
+        assert not overlap, f"duplicate finding codes: {overlap}"
+        seen |= set(cls.codes)
+
+
+def test_tree_jit_surface_is_covered(tree_findings):
+    """Guard against the call graph going vacuous: the real tree must keep
+    rooting the known jit surface (train step, samplers, slot refill) and
+    tracing through it."""
+    ctx = AnalysisContext(TREE)
+    g = ctx.callgraph
+    root_names = {r.fn.qualname for r in g.jit_roots}
+    assert any("step_fn" in n for n in root_names)
+    assert any("_get_score_fn" in n for n in root_names)
+    assert any("decode_segment" in n for n in root_names)
+    traced_mods = {f.module.modname for f in g.traced_functions()}
+    assert "trlx_tpu.ops.sampling" in traced_mods
+    assert "trlx_tpu.ops.slot_refill" in traced_mods
+    assert "trlx_tpu.ops.speculative" in traced_mods
+    assert len(g.traced) >= 60

@@ -10,6 +10,16 @@ inside a pjit'd train step.
 
 __version__ = "0.4.0"
 
-from trlx_tpu.trlx import train  # noqa: F401
-
 __all__ = ["train", "__version__"]
+
+
+def __getattr__(name):
+    # Lazy (PEP 562): `trlx_tpu.train` pulls in the full jax/flax training
+    # stack, but jax-free subpackages — graftlint (`trlx_tpu.analysis`,
+    # which must run in lint-only CI with no ML deps), `trlx_tpu.native` —
+    # must be importable without it.
+    if name == "train":
+        from trlx_tpu.trlx import train
+
+        return train
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

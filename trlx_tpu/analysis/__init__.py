@@ -1,0 +1,37 @@
+"""graftlint: JAX-aware whole-program static analysis for trlx_tpu.
+
+CLI: ``python -m trlx_tpu.analysis [trlx_tpu/]`` (or ``scripts/graftlint.py``
+/ ``scripts/lint.py``). Passes: host-sync, recompile-hazard,
+donation-safety, lock-discipline, metric-names, config-keys — catalog and
+baseline workflow in docs/STATIC_ANALYSIS.md.
+
+Pure stdlib + AST: the linter parses source text and never *executes* the
+code it lints (no jax backend is initialized), so it runs in CI before any
+accelerator exists.
+"""
+
+from trlx_tpu.analysis.baseline import Baseline, BaselineEntry, BaselineError
+from trlx_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    LintPass,
+    all_passes,
+    get_pass,
+    main,
+    register_pass,
+    run_analysis,
+)
+
+__all__ = [
+    "AnalysisContext",
+    "Baseline",
+    "BaselineEntry",
+    "BaselineError",
+    "Finding",
+    "LintPass",
+    "all_passes",
+    "get_pass",
+    "main",
+    "register_pass",
+    "run_analysis",
+]

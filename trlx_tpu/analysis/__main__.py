@@ -1,0 +1,8 @@
+"""``python -m trlx_tpu.analysis`` — the graftlint CLI (core.main)."""
+
+import sys
+
+from trlx_tpu.analysis.core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

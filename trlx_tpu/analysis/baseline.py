@@ -1,0 +1,126 @@
+"""graftlint baseline: the committed allowlist of intentional findings.
+
+Format (one entry per line, ``#`` comments):
+
+    <code> <path>:<symbol>:<detail> :: <justification>
+
+e.g.::
+
+    GL201 trlx_tpu/trainer/ppo.py:PPOTrainer._get_score_fn.<locals>.score_fn:B :: per-shape program cache keyed on batch_shape
+
+Rules (enforced here and by ``tests/test_analysis.py``):
+
+- every entry MUST carry a non-empty justification after ``::`` — a
+  suppression without a written reason is a parse error;
+- every entry MUST still match a live finding — a stale entry (the
+  violation was fixed, or the key drifted) fails the run, so the baseline
+  can only ever shrink to match reality. This is also what makes each
+  entry load-bearing: deleting one resurfaces its finding.
+
+Keys deliberately omit line numbers (see ``core.Finding``): edits above a
+finding don't invalidate the baseline, while renaming/moving the function
+does — at which point the entry must be re-justified anyway.
+"""
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+from trlx_tpu.analysis.core import Finding
+
+__all__ = ["Baseline", "BaselineEntry", "BaselineError"]
+
+_FIXME = "FIXME: justify this suppression"
+
+
+class BaselineError(Exception):
+    """Malformed baseline file (bad syntax or missing justification)."""
+
+
+@dataclass
+class BaselineEntry:
+    key: str  # "<code> <path>:<symbol>:<detail>"
+    justification: str
+    line: int = 0
+
+    @property
+    def needs_justification(self) -> bool:
+        return self.justification.startswith("FIXME")
+
+
+class Baseline:
+    def __init__(self, entries: Dict[str, BaselineEntry] = None):
+        self.entries: Dict[str, BaselineEntry] = entries or {}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        entries: Dict[str, BaselineEntry] = {}
+        with open(path, encoding="utf-8") as f:
+            for lineno, raw in enumerate(f, start=1):
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if " :: " not in line:
+                    raise BaselineError(
+                        f"{path}:{lineno}: entry has no ' :: <justification>' "
+                        f"— every suppression needs a written reason: {line!r}"
+                    )
+                key, justification = line.split(" :: ", 1)
+                key = key.strip()
+                justification = justification.strip()
+                if not justification:
+                    raise BaselineError(
+                        f"{path}:{lineno}: empty justification for {key!r}"
+                    )
+                if len(key.split(" ", 1)) != 2 or ":" not in key:
+                    raise BaselineError(
+                        f"{path}:{lineno}: malformed key (want "
+                        f"'<code> <path>:<symbol>:<detail>'): {key!r}"
+                    )
+                if key in entries:
+                    raise BaselineError(f"{path}:{lineno}: duplicate entry {key!r}")
+                entries[key] = BaselineEntry(key, justification, lineno)
+        return cls(entries)
+
+    def apply(
+        self, findings: List[Finding]
+    ) -> Tuple[List[Finding], List[BaselineEntry]]:
+        """Split ``findings`` against the baseline: returns (new findings
+        not covered by any entry, stale entries matching no finding)."""
+        used = set()
+        new: List[Finding] = []
+        for f in findings:
+            if f.key in self.entries:
+                used.add(f.key)
+            else:
+                new.append(f)
+        stale = [e for k, e in self.entries.items() if k not in used]
+        stale.sort(key=lambda e: e.line)
+        return new, stale
+
+    def update(self, findings: List[Finding]) -> None:
+        """Rewrite the entry set to exactly the current findings, keeping
+        justifications of surviving entries (``--update-baseline``)."""
+        fresh: Dict[str, BaselineEntry] = {}
+        for f in findings:
+            if f.key in fresh:
+                continue
+            old = self.entries.get(f.key)
+            fresh[f.key] = old or BaselineEntry(f.key, _FIXME)
+        self.entries = fresh
+
+    def save(self, path: str) -> None:
+        lines = [
+            "# graftlint baseline — intentional findings, each with a written",
+            "# justification (docs/STATIC_ANALYSIS.md). Entries must match a",
+            "# live finding: fix a violation, then delete its entry here.",
+            "# Format: <code> <path>:<symbol>:<detail> :: <justification>",
+            "",
+        ]
+        for key in sorted(self.entries):
+            entry = self.entries[key]
+            lines.append(f"{key} :: {entry.justification}")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+        os.replace(tmp, path)

@@ -1,0 +1,703 @@
+"""Intra-package call graph with jit-root reachability.
+
+Static (AST-only) approximation of "which functions execute under a JAX
+trace": every ``jax.jit`` / ``pjit`` / ``shard_map`` call or decorator whose
+target resolves to a function defined in the package becomes a **root**,
+and reachability over resolved intra-package edges marks the **traced**
+set the jax-aware passes (``jax_passes.py``) inspect.
+
+Resolution is deliberately heuristic — sound enough for a linter, never for
+a compiler:
+
+- lexical scoping: a called name resolves to a nested ``def`` in an
+  enclosing function, then a module-level function, then an import;
+- imports follow re-export chains (``trlx_tpu.parallel.make_mesh`` →
+  ``trlx_tpu.parallel.mesh.make_mesh``) with a cycle guard;
+- ``self.m()`` resolves to ``m`` on the enclosing class, its package
+  superclasses, AND all package subclasses (over-approximation: the
+  abstract ``loss_fn`` pulls every trainer's implementation into the
+  traced set — exactly what the host-sync gate wants);
+- annotated locals/params (``method: PPOConfig = ...``) resolve one more
+  attribute hop (``method.loss`` → ``PPOConfig.loss``);
+- a bare *reference* to a package function inside a traced body counts as
+  an edge (functions passed to ``lax.while_loop``/``scan``/``vmap`` are
+  traced even though never "called" syntactically).
+
+Higher-order flow through parameters (``adjust_logits=...``) is not
+tracked; the traced set is an under-approximation there and an
+over-approximation for shared helpers — both documented in
+docs/STATIC_ANALYSIS.md.
+"""
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from trlx_tpu.analysis.core import AnalysisContext, SourceModule
+
+__all__ = ["CallGraph", "FunctionInfo", "ClassInfo", "JitRoot", "attr_chain"]
+
+# canonical dotted names that open a trace when called with a function
+JIT_WRAPPERS = {
+    "jax.jit",
+    "jax.pjit",
+    "pjit.pjit",
+    "jax.experimental.pjit.pjit",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+}
+PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """``a.b.c`` → ["a","b","c"]; None if any link isn't a plain Name/attr."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str  # module-relative, e.g. "Cls.m.<locals>.step_fn"
+    full: str  # modname + "." + qualname
+    module: SourceModule
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    class_full: Optional[str] = None  # innermost enclosing class
+    parent: Optional["FunctionInfo"] = None
+    # name → every nested def with that name (branches re-define `fn`)
+    nested: Dict[str, List["FunctionInfo"]] = field(default_factory=dict)
+    params: List[str] = field(default_factory=list)
+    bound: Set[str] = field(default_factory=set)  # names assigned in scope
+    var_types: Dict[str, str] = field(default_factory=dict)  # name -> class full
+
+    def body_nodes(self) -> Iterator[ast.AST]:
+        """Walk this function's own body, not descending into nested
+        functions/lambdas/classes (their bodies belong to their own infos)."""
+        stack: List[ast.AST] = list(ast.iter_child_nodes(self.node))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+            ):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def body_statements(self) -> List[ast.stmt]:
+        body = getattr(self.node, "body", None)
+        return body if isinstance(body, list) else []
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    full: str
+    module: SourceModule
+    node: ast.ClassDef
+    base_names: List[str] = field(default_factory=list)  # resolved dotted
+    methods: Dict[str, FunctionInfo] = field(default_factory=dict)
+    # names assigned at class scope (fields, `from_dict = classmethod(...)`)
+    class_attrs: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class JitRoot:
+    fn: FunctionInfo
+    wrapper: str  # the jit-family name used
+    module: SourceModule
+    line: int
+    static_argnums: Tuple[int, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+
+
+def _int_tuple(node: Optional[ast.AST]) -> Tuple[int, ...]:
+    """Literal int / tuple-of-ints keyword value (else empty)."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+class _ModuleIndexer(ast.NodeVisitor):
+    """One pass over a module: imports, functions (incl. nested + lambdas),
+    classes and their methods."""
+
+    def __init__(self, graph: "CallGraph", module: SourceModule):
+        self.graph = graph
+        self.module = module
+        self.scope: List[FunctionInfo] = []
+        self.classes: List[ClassInfo] = []
+
+    # -- imports --------------------------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            target = alias.name if alias.asname else alias.name.split(".")[0]
+            self.graph.imports[self.module.modname][name] = target
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        base = node.module or ""
+        if node.level:
+            parts = self.module.modname.split(".")
+            is_package = self.module.relpath.endswith("__init__.py")
+            # level 1 from a package = the package itself; from a module =
+            # its parent package; each further level pops one more
+            drop = node.level - 1 if is_package else node.level
+            parts = parts[: len(parts) - drop] if drop else parts
+            base = ".".join(parts + ([node.module] if node.module else []))
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            self.graph.imports[self.module.modname][name] = f"{base}.{alias.name}"
+        self.generic_visit(node)
+
+    # -- scopes ---------------------------------------------------------
+
+    def _qualname(self, name: str) -> str:
+        if self.scope:
+            return f"{self.scope[-1].qualname}.<locals>.{name}"
+        if self.classes:
+            return f"{self.classes[-1].name}.{name}"
+        return name
+
+    def _make_function(self, node, name: str) -> FunctionInfo:
+        qual = self._qualname(name)
+        info = FunctionInfo(
+            qualname=qual,
+            full=f"{self.module.modname}.{qual}",
+            module=self.module,
+            node=node,
+            class_full=(
+                self.classes[-1].full if self.classes and not self.scope else None
+            ),
+            parent=self.scope[-1] if self.scope else None,
+        )
+        args = node.args
+        for a in (
+            list(args.posonlyargs)
+            + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            info.params.append(a.arg)
+            info.bound.add(a.arg)
+            ann = getattr(a, "annotation", None)
+            cls_full = self.graph._annotation_class(ann, self.module)
+            if cls_full:
+                info.var_types[a.arg] = cls_full
+        return info
+
+    def _enter_function(self, node, name: str) -> None:
+        info = self._make_function(node, name)
+        if info.full in self.graph.function_index:
+            # same-named defs in sibling branches (`def fn` per sampler
+            # flavor): `full` must be unique for reachability bookkeeping;
+            # `qualname` (the baseline symbol) intentionally stays shared
+            k = 2
+            while f"{info.full}#{k}" in self.graph.function_index:
+                k += 1
+            info.full = f"{info.full}#{k}"
+        self.graph.functions.append(info)
+        self.graph.function_index[info.full] = info
+        if info.parent is not None:
+            info.parent.nested.setdefault(name, []).append(info)
+            info.parent.bound.add(name)
+        elif self.classes:
+            self.classes[-1].methods[name] = info
+            self.classes[-1].class_attrs.add(name)
+        else:
+            self.graph.module_functions[self.module.modname][name] = info
+        self.scope.append(info)
+        # bind/type locals of the new scope
+        for child in ast.walk(node):
+            if isinstance(child, ast.Assign):
+                for tgt in child.targets:
+                    for sub in ast.walk(tgt):
+                        if isinstance(sub, ast.Name):
+                            info.bound.add(sub.id)
+            elif isinstance(child, ast.AnnAssign) and isinstance(
+                child.target, ast.Name
+            ):
+                info.bound.add(child.target.id)
+                cls_full = self.graph._annotation_class(
+                    child.annotation, self.module
+                )
+                if cls_full:
+                    info.var_types[child.target.id] = cls_full
+            elif isinstance(child, (ast.For, ast.AsyncFor)):
+                for sub in ast.walk(child.target):
+                    if isinstance(sub, ast.Name):
+                        info.bound.add(sub.id)
+            elif isinstance(child, ast.withitem) and child.optional_vars:
+                for sub in ast.walk(child.optional_vars):
+                    if isinstance(sub, ast.Name):
+                        info.bound.add(sub.id)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_function(node, node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._enter_function(node, f"<lambda:L{node.lineno}>")
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if self.scope:  # classes inside functions: skip (rare, test-only)
+            self.generic_visit(node)
+            return
+        qual = f"{self.classes[-1].name}.{node.name}" if self.classes else node.name
+        info = ClassInfo(
+            name=node.name,
+            full=f"{self.module.modname}.{qual}",
+            module=self.module,
+            node=node,
+        )
+        for base in node.bases:
+            chain = attr_chain(base)
+            if chain:
+                info.base_names.append(".".join(chain))
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        info.class_attrs.add(tgt.id)
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                info.class_attrs.add(stmt.target.id)
+        self.graph.classes[info.full] = info
+        self.graph.classes_by_name.setdefault(info.name, []).append(info)
+        self.classes.append(info)
+        self.generic_visit(node)
+        self.classes.pop()
+
+
+class CallGraph:
+    def __init__(self, ctx: AnalysisContext):
+        self.ctx = ctx
+        self.functions: List[FunctionInfo] = []
+        self.function_index: Dict[str, FunctionInfo] = {}
+        self.module_functions: Dict[str, Dict[str, FunctionInfo]] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        self.imports: Dict[str, Dict[str, str]] = {}
+        self.modules_by_name: Dict[str, SourceModule] = {}
+        self.jit_roots: List[JitRoot] = []
+        self.traced: Set[str] = set()  # FunctionInfo.full
+        self.traced_via: Dict[str, str] = {}  # full -> root qualname
+        self._build()
+
+    # -- construction ----------------------------------------------------
+
+    def _build(self) -> None:
+        for mod in self.ctx.modules:
+            self.imports[mod.modname] = {}
+            self.module_functions[mod.modname] = {}
+            self.modules_by_name[mod.modname] = mod
+        for mod in self.ctx.modules:
+            _ModuleIndexer(self, mod).visit(mod.tree)
+        self._link_classes()
+        self._collect_jit_roots()
+        self._mark_traced()
+
+    def _link_classes(self) -> None:
+        self._supers: Dict[str, Set[str]] = {}
+        self._subs: Dict[str, Set[str]] = {}
+        for full, info in self.classes.items():
+            for base in info.base_names:
+                resolved = self._resolve_dotted_class(base, info.module)
+                if resolved:
+                    self._supers.setdefault(full, set()).add(resolved.full)
+                    self._subs.setdefault(resolved.full, set()).add(full)
+
+    def _closure(self, start: str, edges: Dict[str, Set[str]]) -> Set[str]:
+        seen = {start}
+        work = [start]
+        while work:
+            for nxt in edges.get(work.pop(), ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    work.append(nxt)
+        return seen
+
+    def related_classes(self, full: str) -> Set[str]:
+        """The class plus its package super- and subclass closure — the
+        candidate set for ``self.m()`` resolution."""
+        return self._closure(full, self._supers) | self._closure(full, self._subs)
+
+    # -- name resolution -------------------------------------------------
+
+    def _resolve_import_target(
+        self, target: str, _seen: Optional[Set[str]] = None
+    ) -> Optional[FunctionInfo]:
+        """A dotted import target → package function, following re-exports."""
+        if target in self.function_index:
+            return self.function_index[target]
+        _seen = _seen or set()
+        if target in _seen or "." not in target:
+            return None
+        _seen.add(target)
+        modpath, name = target.rsplit(".", 1)
+        fn = self.module_functions.get(modpath, {}).get(name)
+        if fn is not None:
+            return fn
+        re_export = self.imports.get(modpath, {}).get(name)
+        if re_export:
+            return self._resolve_import_target(re_export, _seen)
+        return None
+
+    def _resolve_dotted_class(
+        self, dotted: str, module: SourceModule, _seen: Optional[Set[str]] = None
+    ) -> Optional[ClassInfo]:
+        _seen = _seen or set()
+        if dotted in _seen:
+            return None
+        _seen.add(dotted)
+        if dotted in self.classes:
+            return self.classes[dotted]
+        head, _, rest = dotted.partition(".")
+        target = self.imports.get(module.modname, {}).get(head)
+        if target:
+            full = f"{target}.{rest}" if rest else target
+            if full in self.classes:
+                return self.classes[full]
+            if "." in full:
+                modpath, name = full.rsplit(".", 1)
+                re_export = self.imports.get(modpath, {}).get(name)
+                if re_export:
+                    mod = self.modules_by_name.get(modpath)
+                    if mod is not None:
+                        return self._resolve_dotted_class(re_export, mod, _seen)
+                    if re_export in self.classes:
+                        return self.classes[re_export]
+        # same-module class
+        local = f"{module.modname}.{dotted}"
+        return self.classes.get(local)
+
+    def _annotation_class(
+        self, ann: Optional[ast.AST], module: SourceModule
+    ) -> Optional[str]:
+        if ann is None:
+            return None
+        chain = attr_chain(ann)
+        if not chain:
+            if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                cls = self._resolve_dotted_class(ann.value, module)
+                return cls.full if cls else None
+            return None
+        cls = self._resolve_dotted_class(".".join(chain), module)
+        return cls.full if cls else None
+
+    def external_name(
+        self, expr: ast.AST, scope: Optional[FunctionInfo], module: SourceModule
+    ) -> Optional[str]:
+        """Canonical dotted name of ``expr`` when its root is an imported
+        module/name (``jnp.asarray`` → "jax.numpy.asarray"); None when the
+        root is a local variable or unknown."""
+        chain = attr_chain(expr)
+        if not chain:
+            return None
+        root = chain[0]
+        fn = scope
+        while fn is not None:
+            if root in fn.bound:
+                return None  # a local variable, not an import
+            fn = fn.parent
+        target = self.imports.get(module.modname, {}).get(root)
+        if target is None:
+            # builtins (print/float/...) and module-level names
+            return ".".join(chain) if len(chain) >= 1 else None
+        return ".".join([target] + chain[1:])
+
+    def resolve_name(
+        self, name: str, scope: Optional[FunctionInfo], module: SourceModule
+    ) -> List[FunctionInfo]:
+        fn = scope
+        while fn is not None:
+            if name in fn.nested:
+                return list(fn.nested[name])
+            if name in fn.bound:
+                return []  # shadowed by a non-function local
+            fn = fn.parent
+        mod_fn = self.module_functions.get(module.modname, {}).get(name)
+        if mod_fn is not None:
+            return [mod_fn]
+        target = self.imports.get(module.modname, {}).get(name)
+        if target:
+            resolved = self._resolve_import_target(target)
+            return [resolved] if resolved else []
+        return []
+
+    def returned_functions(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        """Nested defs a factory function returns (``def ring(...): ...;
+        return ring``) — one extra hop for ``f = factory(); jax.jit(f)``."""
+        out: List[FunctionInfo] = []
+        for node in fn.body_nodes():
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            if isinstance(node.value, ast.Name):
+                out.extend(fn.nested.get(node.value.id, []))
+            elif isinstance(node.value, ast.Lambda):
+                for cand in self.functions:
+                    if cand.module is fn.module and cand.node is node.value:
+                        out.append(cand)
+        return out
+
+    def resolve_callable_deep(
+        self, expr: ast.AST, scope: Optional[FunctionInfo], module: SourceModule
+    ) -> List[FunctionInfo]:
+        """`resolve_callable` plus two jit-site-only hops: unwrap
+        ``partial(f, ...)`` and follow ``name = factory(...)`` to the
+        factory's returned nested defs."""
+        if (
+            isinstance(expr, ast.Call)
+            and self.external_name(expr.func, scope, module) in PARTIAL_NAMES
+            and expr.args
+        ):
+            return self.resolve_callable_deep(expr.args[0], scope, module)
+        direct = self.resolve_callable(expr, scope, module)
+        if direct:
+            return direct
+        if isinstance(expr, ast.Name) and scope is not None:
+            out: List[FunctionInfo] = []
+            look = scope
+            while look is not None:
+                for node in look.body_nodes():
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    if not any(
+                        isinstance(t, ast.Name) and t.id == expr.id
+                        for t in node.targets
+                    ):
+                        continue
+                    value = node.value
+                    if (
+                        isinstance(value, ast.Call)
+                        and self.external_name(value.func, look, module)
+                        in PARTIAL_NAMES
+                        and value.args
+                    ):
+                        out.extend(
+                            self.resolve_callable_deep(value.args[0], look, module)
+                        )
+                    elif isinstance(value, ast.Call):
+                        for factory in self.resolve_callable(
+                            value.func, look, module
+                        ):
+                            out.extend(self.returned_functions(factory))
+                if out:
+                    return out
+                look = look.parent
+        return []
+
+    def resolve_method(self, class_full: str, method: str) -> List[FunctionInfo]:
+        out = []
+        for full in sorted(self.related_classes(class_full)):
+            info = self.classes.get(full)
+            if info and method in info.methods:
+                out.append(info.methods[method])
+        return out
+
+    def resolve_callable(
+        self, expr: ast.AST, scope: Optional[FunctionInfo], module: SourceModule
+    ) -> List[FunctionInfo]:
+        """Package-internal candidates for a call/reference expression."""
+        if isinstance(expr, ast.Name):
+            return self.resolve_name(expr.id, scope, module)
+        chain = attr_chain(expr)
+        if not chain:
+            return []
+        if chain[0] == "self" and scope is not None and len(chain) == 2:
+            cls = self._enclosing_class(scope)
+            if cls:
+                return self.resolve_method(cls, chain[1])
+            return []
+        if len(chain) == 2 and scope is not None:
+            # annotated local: method.loss with method: PPOConfig
+            fn = scope
+            while fn is not None:
+                cls_full = fn.var_types.get(chain[0])
+                if cls_full:
+                    return self.resolve_method(cls_full, chain[1])
+                if chain[0] in fn.bound:
+                    break
+                fn = fn.parent
+        # module-alias chain: stats.whiten with `import ... as stats`
+        root_target = None
+        fn = scope
+        shadowed = False
+        while fn is not None:
+            if chain[0] in fn.bound:
+                shadowed = True
+                break
+            fn = fn.parent
+        if not shadowed:
+            root_target = self.imports.get(module.modname, {}).get(chain[0])
+        if root_target:
+            resolved = self._resolve_import_target(
+                ".".join([root_target] + chain[1:])
+            )
+            return [resolved] if resolved else []
+        return []
+
+    def _enclosing_class(self, scope: FunctionInfo) -> Optional[str]:
+        fn = scope
+        while fn is not None:
+            if fn.class_full:
+                return fn.class_full
+            fn = fn.parent
+        return None
+
+    # -- jit roots & reachability ----------------------------------------
+
+    def is_jit_name(self, dotted: Optional[str]) -> bool:
+        return dotted in JIT_WRAPPERS
+
+    def _jit_kwargs(self, call: ast.Call) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+        static = donate = ()
+        for kw in call.keywords:
+            if kw.arg in ("static_argnums", "static_argnames"):
+                static = _int_tuple(kw.value) or (-1,)
+            if kw.arg == "donate_argnums":
+                donate = _int_tuple(kw.value)
+        return static, donate
+
+    def enclosing_function(
+        self, module: SourceModule, node: ast.AST
+    ) -> Optional[FunctionInfo]:
+        """Innermost FunctionInfo whose own body contains ``node``."""
+        module.build_parents()
+        cur = module.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                for fn in self.functions:
+                    if fn.module is module and fn.node is cur:
+                        return fn
+                return None
+            cur = module.parents.get(cur)
+        return None
+
+    def _add_root(
+        self,
+        fn: FunctionInfo,
+        wrapper: str,
+        module: SourceModule,
+        line: int,
+        static: Tuple[int, ...],
+        donate: Tuple[int, ...],
+    ) -> None:
+        self.jit_roots.append(
+            JitRoot(fn, wrapper, module, line, static, donate)
+        )
+
+    def _collect_jit_roots(self) -> None:
+        for mod in self.ctx.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._roots_from_decorators(mod, node)
+                if not isinstance(node, ast.Call):
+                    continue
+                scope = self.enclosing_function(mod, node)
+                name = self.external_name(node.func, scope, mod)
+                if not self.is_jit_name(name):
+                    continue
+                if not node.args:
+                    continue
+                target = node.args[0]
+                static, donate = self._jit_kwargs(node)
+                if isinstance(target, ast.Lambda):
+                    for fn in self.functions:
+                        if fn.module is mod and fn.node is target:
+                            self._add_root(fn, name, mod, node.lineno, static, donate)
+                    continue
+                for fn in self.resolve_callable_deep(target, scope, mod):
+                    self._add_root(fn, name, mod, node.lineno, static, donate)
+
+    def _roots_from_decorators(self, mod: SourceModule, node) -> None:
+        for dec in node.decorator_list:
+            scope = self.enclosing_function(mod, node)
+            target = dec
+            static = donate = ()
+            if isinstance(dec, ast.Call):
+                fname = self.external_name(dec.func, scope, mod)
+                if fname in PARTIAL_NAMES and dec.args:
+                    inner = dec.args[0]
+                    if self.is_jit_name(self.external_name(inner, scope, mod)):
+                        static, donate = self._jit_kwargs(dec)
+                        target = inner
+                    else:
+                        continue
+                elif self.is_jit_name(fname):
+                    static, donate = self._jit_kwargs(dec)
+                    target = dec.func
+                else:
+                    continue
+            name = self.external_name(target, scope, mod)
+            if not self.is_jit_name(name):
+                continue
+            for fn in self.functions:
+                if fn.module is mod and fn.node is node:
+                    self._add_root(fn, name, mod, node.lineno, static, donate)
+
+    def edges(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        """Resolved intra-package callees + referenced package functions."""
+        out: List[FunctionInfo] = []
+        seen: Set[str] = set()
+        for node in fn.body_nodes():
+            exprs: List[ast.AST] = []
+            if isinstance(node, ast.Call):
+                exprs.append(node.func)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                exprs.append(node)
+            for expr in exprs:
+                for callee in self.resolve_callable(expr, fn, fn.module):
+                    if callee.full not in seen:
+                        seen.add(callee.full)
+                        out.append(callee)
+        # nested defs referenced by name count via the Name rule above;
+        # decorator-jitted nested defs are roots on their own
+        return out
+
+    def _mark_traced(self) -> None:
+        work: List[FunctionInfo] = []
+        for root in self.jit_roots:
+            if root.fn.full not in self.traced:
+                self.traced.add(root.fn.full)
+                self.traced_via[root.fn.full] = root.fn.qualname
+                work.append(root.fn)
+        while work:
+            fn = work.pop()
+            via = self.traced_via[fn.full]
+            callees = list(self.edges(fn))
+            # nested defs/lambdas of traced code are part of the trace even
+            # when only ever passed by reference (while_loop/scan/vmap args)
+            for group in fn.nested.values():
+                callees.extend(group)
+            for callee in callees:
+                if callee.full not in self.traced:
+                    self.traced.add(callee.full)
+                    self.traced_via[callee.full] = via
+                    work.append(callee)
+
+    def traced_functions(self) -> List[FunctionInfo]:
+        return [fn for fn in self.functions if fn.full in self.traced]

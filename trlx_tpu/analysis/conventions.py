@@ -1,0 +1,290 @@
+"""Convention passes: metric-name namespace (GL501) and config-key
+resolution (GL601).
+
+``metric-names`` is the framework home of the former standalone
+``scripts/check_metric_names.py`` (that script is now a thin shim over
+this module — same public helpers, same semantics): every literal
+string-keyed ``stats[...]`` subscript and ``metrics.inc/set_gauge(...)``
+call site must use a ``namespace/name`` key. ``LEGACY_KEYS`` is frozen;
+``RESILIENCE_KEYS`` registers the canonical resilience counters the
+static scan can't see (parameterized helper emissions).
+
+``config-keys`` resolves ``config.<section>.<field>`` attribute chains
+against the dataclasses in ``data/configs.py`` (sections) and every
+``MethodConfig`` subclass in the package (the ``method`` section's field
+union). A typo'd knob (``config.train.rollout_pipeline_dept``) otherwise
+reads nothing and silently trains with the default.
+"""
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from trlx_tpu.analysis.callgraph import attr_chain
+from trlx_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    LintPass,
+    register_pass,
+)
+
+# ---------------------------------------------------------------------------
+# metric names (the former scripts/check_metric_names.py, verbatim rules)
+# ---------------------------------------------------------------------------
+
+# \bstats\[ : the dict must be *named* stats (not spec_stats, device_stats…)
+# Second alternative: MetricsRegistry writes — receivers named/suffixed
+# "metrics" calling inc()/set_gauge() with a literal first argument (the
+# registry's observe() is excluded: RecompileWatchdog.observe's first arg is
+# a program name, not a metric key).
+_KEY_RE = re.compile(
+    r'\bstats\[\s*f?"([^"]+)"'
+    r'|\bmetrics\.(?:inc|set_gauge)\(\s*f?"([^"]+)"'
+)
+
+# namespace/name: lowercase_snake namespace, then anything non-empty (names
+# may carry f-string fields, sweep suffixes, dots, @-qualifiers)
+_CONVENTION_RE = re.compile(r"^[a-z][a-z0-9_]*/\S+$")
+
+# Pre-convention keys, kept for dashboard/log continuity. Do not add to this
+# list — new metrics must be namespaced.
+LEGACY_KEYS = frozenset({
+    "learning_rate",
+    "kl_ctl_value",
+})
+
+# Canonical resilience/* metric keys (docs/RESILIENCE.md). The retry
+# counters are emitted through a parameterized helper
+# (HostCallGuard._inc(f"resilience/{name}_retries")) the static scan can't
+# see, so the full set is registered here; tests/test_metric_names.py
+# asserts every entry follows the convention and that the statically
+# visible ones reach the scanner.
+RESILIENCE_KEYS = frozenset({
+    "resilience/update_ok",
+    "resilience/nonfinite_updates",
+    "resilience/skipped_updates",
+    "resilience/rollbacks",
+    "resilience/goodput_frac",
+    "resilience/preemptions",
+    "resilience/reward_retries",
+    "resilience/reward_failures",
+    "resilience/reward_fallbacks",
+    "resilience/publish_retries",
+    "resilience/publish_failures",
+    "resilience/publish_fallbacks",
+})
+
+
+def _iter_line_keys(lines) -> "List[Tuple[int, str]]":
+    """(lineno, key) for every literal metric-key site in ``lines`` — the
+    single scanning loop behind the shim helpers and the GL501 pass."""
+    out: List[Tuple[int, str]] = []
+    for lineno, line in enumerate(lines, start=1):
+        for groups in _KEY_RE.findall(line):
+            out.append((lineno, groups[0] or groups[1]))
+    return out
+
+
+def _iter_dir_keys(scan_dir: str):
+    """(relpath, lineno, key) over every .py under ``scan_dir``; relpaths
+    relative to the scan dir's parent (the shim's historical repo-root-
+    relative output)."""
+    base = os.path.dirname(os.path.abspath(scan_dir))
+    for dirpath, _dirnames, filenames in os.walk(scan_dir):
+        if "__pycache__" in dirpath:
+            continue
+        for filename in sorted(filenames):
+            if not filename.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, filename)
+            with open(path) as f:
+                for lineno, key in _iter_line_keys(f):
+                    yield os.path.relpath(path, base), lineno, key
+
+
+def _breaks_convention(key: str) -> bool:
+    return key not in LEGACY_KEYS and not _CONVENTION_RE.match(key)
+
+
+def find_violations(scan_dir: str) -> List[Tuple[str, int, str]]:
+    """All (relpath, lineno, key) whose key breaks the convention."""
+    return [
+        (relpath, lineno, key)
+        for relpath, lineno, key in _iter_dir_keys(scan_dir)
+        if _breaks_convention(key)
+    ]
+
+
+def scanned_keys(scan_dir: str) -> Dict[str, int]:
+    """key → occurrence count over the tree (for the test's sanity check
+    that the scanner actually sees the codebase's stats writes)."""
+    counts: Dict[str, int] = {}
+    for _relpath, _lineno, key in _iter_dir_keys(scan_dir):
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+@register_pass
+class MetricNamesPass(LintPass):
+    name = "metric-names"
+    codes = ("GL501",)
+    description = "metric keys must follow the namespace/name convention"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in ctx.modules:
+            for lineno, key in _iter_line_keys(mod.lines):
+                if not _breaks_convention(key):
+                    continue
+                findings.append(
+                    Finding(
+                        code="GL501",
+                        path=mod.relpath,
+                        line=lineno,
+                        symbol="-",
+                        detail=key,
+                        message=f'metric key "{key}" violates the '
+                        "namespace/name convention "
+                        "(docs/OBSERVABILITY.md; LEGACY_KEYS is frozen)",
+                    )
+                )
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# config keys
+# ---------------------------------------------------------------------------
+
+# receivers we trust to be a TRLConfig: `config.train.x`, `self.config.train.x`
+_CONFIG_RECEIVERS = {"config", "cfg", "baseconfig"}
+
+
+def _dataclass_members(node: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for stmt in node.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            out.add(stmt.target.id)
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(stmt.name)
+    return out
+
+
+@register_pass
+class ConfigKeysPass(LintPass):
+    name = "config-keys"
+    codes = ("GL601",)
+    description = "config.<section>.<field> must resolve to a declared field"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        sections = self._collect_sections(ctx)
+        if not sections:
+            return []
+        graph = ctx.callgraph
+        findings: List[Finding] = []
+        for mod in ctx.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Attribute):
+                    continue
+                chain = attr_chain(node)
+                if not chain or len(chain) < 3:
+                    continue
+                hit = self._match_section(chain, sections)
+                if hit is None:
+                    continue
+                section, fieldname = hit
+                if fieldname in sections[section]:
+                    continue
+                scope = graph.enclosing_function(mod, node)
+                symbol = scope.qualname if scope else "-"
+                findings.append(
+                    Finding(
+                        code="GL601",
+                        path=mod.relpath,
+                        line=node.lineno,
+                        symbol=symbol,
+                        detail=f"{section}.{fieldname}",
+                        message=f"`config.{section}.{fieldname}` does not "
+                        f"resolve to a declared field of the `{section}` "
+                        "config dataclass (data/configs.py) — typo'd knobs "
+                        "silently read defaults",
+                    )
+                )
+        # one finding per (file, detail): repeated uses of the same bad key
+        # in one file are one decision
+        seen: Set[str] = set()
+        unique: List[Finding] = []
+        for f in sorted(findings, key=lambda f: (f.path, f.line)):
+            k = f"{f.path}:{f.detail}"
+            if k not in seen:
+                seen.add(k)
+                unique.append(f)
+        return unique
+
+    def _collect_sections(self, ctx: AnalysisContext) -> Dict[str, Set[str]]:
+        """section name → allowed member names. Sections come from
+        TRLConfig's fields; `method` is the union over MethodConfig and
+        every class in the package inheriting (transitively, by name) from
+        it."""
+        classes: Dict[str, ast.ClassDef] = {}
+        bases: Dict[str, List[str]] = {}
+        trl: Optional[ast.ClassDef] = None
+        for mod in ctx.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    classes[node.name] = node
+                    bases[node.name] = [
+                        ".".join(attr_chain(b) or ["?"]) for b in node.bases
+                    ]
+                    if node.name == "TRLConfig":
+                        trl = node
+        if trl is None:
+            return {}
+
+        def inherits_method_config(name: str, seen: Set[str]) -> bool:
+            if name == "MethodConfig":
+                return True
+            if name in seen:
+                return False
+            seen.add(name)
+            return any(
+                inherits_method_config(b.rsplit(".", 1)[-1], seen)
+                for b in bases.get(name, [])
+            )
+
+        method_members: Set[str] = set()
+        for name, node in classes.items():
+            if inherits_method_config(name, set()):
+                method_members |= _dataclass_members(node)
+
+        sections: Dict[str, Set[str]] = {}
+        for stmt in trl.body:
+            if not (
+                isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ):
+                continue
+            section = stmt.target.id
+            ann = stmt.annotation
+            ann_name = (attr_chain(ann) or ["?"])[-1]
+            if ann_name == "MethodConfig" or section == "method":
+                sections[section] = set(method_members)
+            elif ann_name in classes:
+                sections[section] = _dataclass_members(classes[ann_name])
+        return sections
+
+    def _match_section(
+        self, chain: List[str], sections: Dict[str, Set[str]]
+    ) -> Optional[Tuple[str, str]]:
+        """Match ``[..., <config-receiver>, <section>, <field>, ...]``."""
+        for i in range(len(chain) - 2):
+            recv, section, fieldname = chain[i], chain[i + 1], chain[i + 2]
+            if section not in sections:
+                continue
+            if recv in _CONFIG_RECEIVERS or recv.endswith("config"):
+                return section, fieldname
+        return None

@@ -1,0 +1,397 @@
+"""graftlint core: findings, the pass registry, the analysis context, and
+the CLI driver.
+
+The linter is a whole-program static analysis over the ``trlx_tpu`` source
+tree (AST-based — nothing is imported, so linting never initializes jax).
+Each :class:`LintPass` inspects the parsed tree (plus the shared
+intra-package call graph, ``callgraph.py``) and emits :class:`Finding`
+records with a per-finding code (``GL1xx`` host-sync, ``GL2xx`` recompile,
+``GL3xx`` donation, ``GL4xx`` locks, ``GL5xx`` metrics, ``GL6xx`` config
+keys — catalog in docs/STATIC_ANALYSIS.md).
+
+Findings are keyed by ``(code, path, symbol, detail)`` — deliberately **not**
+by line number, so the committed baseline (``GRAFTLINT_BASELINE.txt``)
+survives unrelated edits. The baseline is a strict allowlist: every entry
+must carry a justification and must still match a live finding
+(``baseline.py``; stale entries fail the run), which is what makes the
+tier-1 self-run (``tests/test_analysis.py``) a standing CI gate.
+"""
+
+import argparse
+import ast
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple, Type
+
+__all__ = [
+    "Finding",
+    "SourceModule",
+    "AnalysisContext",
+    "LintPass",
+    "register_pass",
+    "all_passes",
+    "get_pass",
+    "run_analysis",
+    "main",
+]
+
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint violation.
+
+    ``key`` intentionally omits the line number: baselines must survive
+    unrelated edits above the finding. ``detail`` is the stable
+    discriminator within a function (the offending call/attribute text) —
+    two identical violations in one function share a key, and one baseline
+    entry suppresses both (they are the same decision).
+    """
+
+    code: str  # e.g. "GL101"
+    path: str  # posix relpath, e.g. "trlx_tpu/trainer/base.py"
+    line: int  # 1-indexed, for humans; not part of the key
+    symbol: str  # enclosing function qualname, or "-" (module level)
+    detail: str  # stable discriminator (offending expression text)
+    message: str  # human explanation
+
+    @property
+    def key(self) -> str:
+        return f"{self.code} {self.path}:{self.symbol}:{self.detail}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} [{self.symbol}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# source loading
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SourceModule:
+    """One parsed source file."""
+
+    path: str  # absolute
+    relpath: str  # posix, relative to the scan root's parent
+    modname: str  # dotted module name, e.g. "trlx_tpu.trainer.base"
+    text: str
+    lines: List[str]
+    tree: ast.Module
+    # parent links for "is this statement inside that with-block" queries
+    parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+
+    def build_parents(self) -> None:
+        if self.parents:
+            return
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        self.build_parents()
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+
+class AnalysisContext:
+    """Parsed view of one scan root (a package directory).
+
+    ``root`` is the package dir (e.g. ``trlx_tpu/``); relpaths are computed
+    against its parent so findings read ``trlx_tpu/trainer/base.py``. The
+    intra-package call graph is built lazily (only the jax-aware passes
+    need it).
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.base = os.path.dirname(self.root)
+        self.package = os.path.basename(self.root)
+        self.modules: List[SourceModule] = []
+        self.errors: List[Tuple[str, str]] = []  # (relpath, parse error)
+        self._callgraph = None
+        self._load()
+
+    def _load(self) -> None:
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+            )
+            for filename in sorted(filenames):
+                if not filename.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, filename)
+                relpath = os.path.relpath(path, self.base).replace(os.sep, "/")
+                text = open(path, encoding="utf-8").read()
+                try:
+                    tree = ast.parse(text, filename=relpath)
+                except SyntaxError as e:
+                    self.errors.append((relpath, str(e)))
+                    continue
+                mod = relpath[: -len(".py")].replace("/", ".")
+                if mod.endswith(".__init__"):
+                    mod = mod[: -len(".__init__")]
+                self.modules.append(
+                    SourceModule(
+                        path=path,
+                        relpath=relpath,
+                        modname=mod,
+                        text=text,
+                        lines=text.splitlines(),
+                        tree=tree,
+                    )
+                )
+
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from trlx_tpu.analysis.callgraph import CallGraph
+
+            self._callgraph = CallGraph(self)
+        return self._callgraph
+
+
+# ---------------------------------------------------------------------------
+# pass registry
+# ---------------------------------------------------------------------------
+
+
+class LintPass:
+    """Base class for one analysis pass. Subclasses set ``name`` (the CLI
+    selector), ``codes`` (the finding codes they may emit), and implement
+    :meth:`run`."""
+
+    name: str = ""
+    codes: Tuple[str, ...] = ()
+    description: str = ""
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Type[LintPass]] = {}
+
+
+def register_pass(cls: Type[LintPass]) -> Type[LintPass]:
+    if not cls.name:
+        raise ValueError(f"{cls.__name__} must set a pass name")
+    if cls.name in _REGISTRY:
+        raise ValueError(f"duplicate pass name {cls.name!r}")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def _ensure_builtin_passes() -> None:
+    # importing the pass modules populates the registry
+    from trlx_tpu.analysis import conventions, jax_passes, locks  # noqa: F401
+
+
+def all_passes() -> Dict[str, Type[LintPass]]:
+    _ensure_builtin_passes()
+    return dict(_REGISTRY)
+
+
+def get_pass(name: str) -> Type[LintPass]:
+    _ensure_builtin_passes()
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown pass {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def run_analysis(
+    root: str,
+    passes: Optional[Iterable[str]] = None,
+    ctx: Optional[AnalysisContext] = None,
+) -> Tuple[List[Finding], AnalysisContext]:
+    """Run ``passes`` (default: all registered) over ``root``; findings are
+    sorted by (path, line, code) for stable output."""
+    ctx = ctx or AnalysisContext(root)
+    names = list(passes) if passes is not None else sorted(all_passes())
+    findings: List[Finding] = []
+    for name in names:
+        findings.extend(get_pass(name)().run(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.detail))
+    return findings, ctx
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _default_root() -> str:
+    # the installed package itself (scripts/graftlint.py and -m invocations
+    # from anywhere lint the real tree by default)
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _default_baseline(root: str) -> Optional[str]:
+    """``GRAFTLINT_BASELINE.txt`` next to the scan root (the repo root when
+    scanning ``trlx_tpu/``) — deliberately NOT $CWD, so linting a scratch
+    package from the repo root never applies (or, with
+    ``--update-baseline``, clobbers) the repo's committed baseline."""
+    cand = os.path.join(
+        os.path.dirname(os.path.abspath(root)), "GRAFTLINT_BASELINE.txt"
+    )
+    return cand if os.path.exists(cand) else None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from trlx_tpu.analysis.baseline import Baseline, BaselineError
+
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="JAX-aware whole-program static analysis for trlx_tpu "
+        "(docs/STATIC_ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "root",
+        nargs="?",
+        default=None,
+        help="package directory to lint (default: the installed trlx_tpu)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline/allowlist file (default: GRAFTLINT_BASELINE.txt next "
+        "to the scan root; see docs/STATIC_ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore any baseline file: report every finding",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline to exactly the current findings, keeping "
+        "existing justifications; new entries get a FIXME justification "
+        "that must be written before committing",
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated pass names to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-passes", action="store_true", help="list passes and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        for name, cls in sorted(all_passes().items()):
+            codes = ",".join(cls.codes)
+            print(f"{name:18s} {codes:22s} {cls.description}")
+        return 0
+
+    root = args.root or _default_root()
+    if not os.path.isdir(root):
+        print(f"graftlint: not a directory: {root}", file=sys.stderr)
+        return 2
+    if args.no_baseline and args.update_baseline:
+        print(
+            "graftlint: --no-baseline with --update-baseline would rewrite "
+            "the baseline without loading it, destroying every committed "
+            "justification — pick one",
+            file=sys.stderr,
+        )
+        return 2
+    passes = args.select.split(",") if args.select else None
+    try:
+        findings, ctx = run_analysis(root, passes=passes)
+        selected_codes = set()
+        for name in passes if passes is not None else sorted(all_passes()):
+            selected_codes.update(get_pass(name).codes)
+    except KeyError as e:
+        print(f"graftlint: {e.args[0]}", file=sys.stderr)
+        return 2
+    for relpath, err in ctx.errors:
+        print(f"graftlint: syntax error in {relpath}: {err}", file=sys.stderr)
+
+    baseline_path = args.baseline or _default_baseline(root)
+    baseline = Baseline()
+    if baseline_path and not args.no_baseline:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except BaselineError as e:
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+    # entries for passes NOT selected this run are out of scope: they are
+    # neither stale (their pass didn't look) nor rewritable by
+    # --update-baseline (a pass-filtered update must not delete them)
+    out_of_scope = {
+        k: e
+        for k, e in baseline.entries.items()
+        if k.split(" ", 1)[0] not in selected_codes
+    }
+    baseline = Baseline(
+        {k: e for k, e in baseline.entries.items() if k not in out_of_scope}
+    )
+
+    if args.update_baseline:
+        if ctx.errors:
+            print(
+                "graftlint: refusing --update-baseline with unparseable "
+                "sources — their findings would silently drop out",
+                file=sys.stderr,
+            )
+            return 2
+        path = baseline_path or _default_baseline(root) or os.path.join(
+            os.path.dirname(os.path.abspath(root)), "GRAFTLINT_BASELINE.txt"
+        )
+        baseline.update(findings)
+        baseline.entries.update(out_of_scope)
+        baseline.save(path)
+        print(f"graftlint: wrote {len(baseline.entries)} entries to {path}")
+        fixmes = [e for e in baseline.entries.values() if e.needs_justification]
+        if fixmes:
+            print(
+                f"graftlint: {len(fixmes)} new entries carry a FIXME "
+                "justification — write a real one before committing"
+            )
+        return 0
+
+    new, stale = baseline.apply(findings)
+    suppressed = len(findings) - len(new)
+    for f in new:
+        print(f.render())
+    for entry in stale:
+        print(
+            f"{baseline_path}: stale baseline entry no longer matches any "
+            f"finding (fix shipped? delete the entry): {entry.key}"
+        )
+    counts: Dict[str, int] = {}
+    for f in new:
+        counts[f.code] = counts.get(f.code, 0) + 1
+    summary = ", ".join(f"{c}×{n}" for c, n in sorted(counts.items()))
+    if new or stale:
+        print(
+            f"\ngraftlint: {len(new)} finding(s)"
+            + (f" ({summary})" if summary else "")
+            + (f", {len(stale)} stale baseline entr(y/ies)" if stale else "")
+            + (f"; {suppressed} baselined" if suppressed else "")
+            + " — see docs/STATIC_ANALYSIS.md"
+        )
+        return 1
+    if ctx.errors:
+        print(
+            f"graftlint: FAILED — {len(ctx.errors)} unparseable file(s) "
+            "(see stderr); their findings are unknown"
+        )
+        return 1
+    n_mod = len(ctx.modules)
+    print(
+        f"graftlint: OK ({n_mod} modules, "
+        f"{suppressed} baselined finding(s), 0 new)"
+    )
+    return 0

@@ -1,0 +1,222 @@
+"""Lock-discipline pass (GL4xx): attributes annotated ``# guarded-by:
+<lock>`` must only be mutated inside ``with self.<lock>:``.
+
+The annotation lives as a trailing comment on the attribute's assignment
+line (typically in ``__init__``)::
+
+    self._stats_lock = threading.Lock()
+    self.stats = PipelineStats()  # guarded-by: _stats_lock
+
+Enforcement covers every method of the class *except* the method that
+declares the annotation (``__init__``-time construction happens before
+the object escapes to another thread):
+
+- GL401 — assignment / augmented assignment to ``self.<attr>`` (or any
+  deeper chain, ``self.stats.host_work_s += dt``) outside a ``with
+  self.<lock>:`` block;
+- GL401 — mutating container-method call (``append``/``update``/...) on a
+  guarded chain outside the lock;
+- GL402 — the annotation names a lock attribute never assigned in the
+  class (a typo'd lock name silently guards nothing).
+
+Scope: annotation-driven, so any module can opt in; the threaded pipeline
+modules (``pipeline/rollout_pipeline.py``) and the tracer
+(``observability/tracing.py``) carry annotations today.
+"""
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from trlx_tpu.analysis.callgraph import attr_chain
+from trlx_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    LintPass,
+    SourceModule,
+    register_pass,
+)
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_ATTR_ON_LINE_RE = re.compile(r"self\.([A-Za-z_][A-Za-z0-9_]*)\s*[:=]")
+
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popleft", "appendleft",
+    "clear", "update", "add", "discard", "setdefault", "sort", "reverse",
+}
+
+
+def _find_annotations(mod: SourceModule) -> List[Tuple[int, str, str]]:
+    """(lineno, attr, lockname) for every ``# guarded-by:`` line."""
+    out = []
+    for lineno, line in enumerate(mod.lines, start=1):
+        m = _GUARDED_RE.search(line)
+        if not m:
+            continue
+        attr = _ATTR_ON_LINE_RE.search(line)
+        if attr:
+            out.append((lineno, attr.group(1), m.group(1)))
+    return out
+
+
+def _enclosing_class(mod: SourceModule, lineno: int) -> Optional[ast.ClassDef]:
+    best: Optional[ast.ClassDef] = None
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= lineno <= end:
+                if best is None or node.lineno > best.lineno:
+                    best = node
+    return best
+
+
+def _holds_lock(mod: SourceModule, node: ast.AST, lockname: str) -> bool:
+    for anc in mod.ancestors(node):
+        if not isinstance(anc, (ast.With, ast.AsyncWith)):
+            continue
+        for item in anc.items:
+            expr = item.context_expr
+            # unwrap `with self._lock:` and `with lock.acquire_timeout(..)`
+            chain = attr_chain(expr)
+            if chain is None and isinstance(expr, ast.Call):
+                chain = attr_chain(expr.func)
+            if chain and lockname in chain:
+                return True
+    return False
+
+
+def _method_of(cls: ast.ClassDef, node: ast.AST, mod: SourceModule) -> Optional[str]:
+    for anc in [node] + list(mod.ancestors(node)):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for parent in mod.ancestors(anc):
+                if parent is cls:
+                    return anc.name
+    return None
+
+
+@register_pass
+class LockDisciplinePass(LintPass):
+    name = "lock-discipline"
+    codes = ("GL401", "GL402")
+    description = "guarded-by annotated state mutated outside its lock"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in ctx.modules:
+            annotations = _find_annotations(mod)
+            if not annotations:
+                continue
+            mod.build_parents()
+            # class → {attr: (lockname, declaring method)}
+            guarded: Dict[ast.ClassDef, Dict[str, Tuple[str, Optional[str]]]] = {}
+            for lineno, attr, lock in annotations:
+                cls = _enclosing_class(mod, lineno)
+                if cls is None:
+                    continue
+                decl_method = None
+                for node in ast.walk(cls):
+                    if (
+                        isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+                        and node.lineno == lineno
+                    ):
+                        decl_method = _method_of(cls, node, mod)
+                        break
+                guarded.setdefault(cls, {})[attr] = (lock, decl_method)
+            for cls, attrs in guarded.items():
+                findings.extend(self._check_class(mod, cls, attrs))
+        return findings
+
+    def _check_class(
+        self,
+        mod: SourceModule,
+        cls: ast.ClassDef,
+        attrs: Dict[str, Tuple[str, Optional[str]]],
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        # GL402: the named lock must exist as an attribute of the class
+        assigned: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for t in targets:
+                    chain = attr_chain(t)
+                    if chain and len(chain) == 2 and chain[0] == "self":
+                        assigned.add(chain[1])
+        for attr, (lock, _decl) in sorted(attrs.items()):
+            if lock not in assigned:
+                findings.append(
+                    Finding(
+                        code="GL402",
+                        path=mod.relpath,
+                        line=cls.lineno,
+                        symbol=cls.name,
+                        detail=f"{attr}->{lock}",
+                        message=f"`{attr}` is annotated guarded-by `{lock}`, "
+                        f"but `{cls.name}` never assigns `self.{lock}` — "
+                        "typo'd lock name guards nothing",
+                    )
+                )
+
+        for node in ast.walk(cls):
+            mutated = self._mutated_chain(node)
+            if mutated is None:
+                continue
+            chain, verb = mutated
+            if len(chain) < 2 or chain[0] != "self" or chain[1] not in attrs:
+                continue
+            attr = chain[1]
+            lock, decl_method = attrs[attr]
+            method = _method_of(cls, node, mod)
+            if method is None or method == decl_method or method == "__init__":
+                # construction before the object escapes needs no lock
+                continue
+            if _holds_lock(mod, node, lock):
+                continue
+            findings.append(
+                Finding(
+                    code="GL401",
+                    path=mod.relpath,
+                    line=node.lineno,
+                    symbol=f"{cls.name}.{method}",
+                    detail=f"{'.'.join(chain)}:{verb}",
+                    message=f"`{'.'.join(chain)}` ({verb}) is guarded by "
+                    f"`self.{lock}` but this mutation is outside any "
+                    f"`with self.{lock}:` block",
+                )
+            )
+        return findings
+
+    def _mutated_chain(self, node: ast.AST) -> Optional[Tuple[List[str], str]]:
+        """(chain, verb) when ``node`` mutates a self.* chain."""
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                base = t
+                # subscript store mutates the container: self.d[k] = v
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                chain = attr_chain(base)
+                if chain and chain[0] == "self":
+                    return chain, "assign"
+            return None
+        if isinstance(node, ast.AugAssign):
+            base = node.target
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            chain = attr_chain(base)
+            if chain and chain[0] == "self":
+                return chain, "augassign"
+            return None
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATORS:
+                base = node.func.value
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                chain = attr_chain(base)
+                if chain and chain[0] == "self":
+                    return chain, node.func.attr
+        return None

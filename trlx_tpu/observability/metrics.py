@@ -78,9 +78,12 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: Dict[str, float] = {}
-        self._gauges: Dict[str, float] = {}
-        self._hists: Dict[str, List[float]] = {}
+        # resilience counters inc() from pipeline worker threads while the
+        # learn loop snapshots: all mutations take the lock (enforced by
+        # graftlint's lock-discipline pass, docs/STATIC_ANALYSIS.md)
+        self._counters: Dict[str, float] = {}  # guarded-by: _lock
+        self._gauges: Dict[str, float] = {}  # guarded-by: _lock
+        self._hists: Dict[str, List[float]] = {}  # guarded-by: _lock
 
     def inc(self, name: str, value: float = 1.0) -> float:
         with self._lock:

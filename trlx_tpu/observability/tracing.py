@@ -92,12 +92,15 @@ class Tracer:
     def __init__(self, enabled: bool = True, max_events: int = 200_000):
         self.enabled = enabled
         self.max_events = max_events
-        self.dropped = 0
-        self._events: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
+        # spans close on pipeline worker threads too: every mutation of the
+        # shared buffers below takes the lock (enforced statically by
+        # graftlint's lock-discipline pass, docs/STATIC_ANALYSIS.md)
+        self.dropped = 0  # guarded-by: _lock
+        self._events: List[Dict[str, Any]] = []  # guarded-by: _lock
         self._local = threading.local()
         self._epoch = time.perf_counter()
-        self._last_duration: Dict[str, float] = {}
+        self._last_duration: Dict[str, float] = {}  # guarded-by: _lock
 
     # -- recording ------------------------------------------------------
 
@@ -161,7 +164,8 @@ class Tracer:
             if sp in stack:
                 stack.remove(sp)
             dur = sp.close()
-            self._last_duration[name] = dur
+            with self._lock:  # worker + main thread both close spans
+                self._last_duration[name] = dur
             if self.enabled:
                 self._record(sp)
 

@@ -28,6 +28,13 @@ slot-steps on device; only live (unfinished, occupied) slots produce real
 tokens. ``slot_utilization`` = live ÷ total slot-steps — the number the
 refill loop exists to keep high; ``padded_decode_frac`` = its complement,
 the waste the serial chunked path pays on heterogeneous response lengths.
+
+Thread affinity: the engine is single-threaded by design — only the
+trainer's main thread calls ``enqueue_prompts``/``step``; the rollout
+pipeline worker sees nothing but the harvested numpy copies. If shared
+mutable state is ever introduced here, annotate it ``# guarded-by:
+<lock>`` so graftlint's lock-discipline pass (docs/STATIC_ANALYSIS.md)
+enforces the locking, as in ``rollout_pipeline.py``.
 """
 
 import time

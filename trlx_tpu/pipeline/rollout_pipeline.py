@@ -115,7 +115,12 @@ class RolloutPipeline:
             raise ValueError(f"pipeline depth must be >= 1, got {depth}")
         self.depth = depth
         self.name = name
-        self.stats = PipelineStats(depth=depth)
+        # the worker writes host_work_s/chunk_host_s while the submitting
+        # thread writes wait_s/chunks and reads the aggregate (overlap_frac
+        # mid-run): all stats mutations take the lock. Enforced statically
+        # by graftlint's lock-discipline pass (docs/STATIC_ANALYSIS.md).
+        self._stats_lock = threading.Lock()
+        self.stats = PipelineStats(depth=depth)  # guarded-by: _stats_lock
         self._finalize = finalize
         self._tracer = tracer
         self._todo: "queue.Queue" = queue.Queue()
@@ -157,8 +162,9 @@ class RolloutPipeline:
                 self._cancel.set()
             finally:
                 dt = time.perf_counter() - t0
-                self.stats.host_work_s += dt
-                self.stats.chunk_host_s.append(dt)
+                with self._stats_lock:
+                    self.stats.host_work_s += dt
+                    self.stats.chunk_host_s.append(dt)
             self._done.put(chunk)
 
     # -- submitting-thread side -----------------------------------------
@@ -174,7 +180,8 @@ class RolloutPipeline:
                         chunk = self._done.get()
                 else:
                     chunk = self._done.get()
-                self.stats.wait_s += time.perf_counter() - t0
+                with self._stats_lock:
+                    self.stats.wait_s += time.perf_counter() - t0
             else:
                 chunk = self._done.get_nowait()
         except queue.Empty:
@@ -194,7 +201,8 @@ class RolloutPipeline:
                 if self._finalize is not None:
                     self._finalize(chunk.result)
                 self._finalized += 1
-                self.stats.chunks += 1
+                with self._stats_lock:
+                    self.stats.chunks += 1
             except BaseException:
                 self._cancel.set()
                 raise

@@ -918,12 +918,13 @@ class TPUBaseTrainer(BaseRLTrainer):
                 out, spec_stats = out
                 # recorded for make_experience's stats (rollout observability:
                 # the knob this informs is model.draft_gamma)
+                # device_get already lands host scalars; no asarray needed
                 self.last_spec_stats = {
                     "rollout/spec_acceptance_rate": float(
-                        np.asarray(jax.device_get(spec_stats["acceptance_rate"]))
+                        jax.device_get(spec_stats["acceptance_rate"])
                     ),
                     "rollout/spec_rounds": int(
-                        np.asarray(jax.device_get(spec_stats["rounds"]))
+                        jax.device_get(spec_stats["rounds"])
                     ),
                 }
             sp.fence((out.sequences, out.response_tokens))

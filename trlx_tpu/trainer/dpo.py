@@ -87,6 +87,21 @@ class DPOTrainer(TPUBaseTrainer):
         # the current parameters ARE the reference — zero extra param HBM.
         self.ref_params = None
 
+    def _get_ref_logp_fn(self):
+        """Memoized jitted reference-logprob program: a fresh
+        ``jax.jit(lambda ...)`` per ``make_experience`` call would compile a
+        new executable every invocation (the jit cache keys on function
+        identity — graftlint GL204); one named program serves every call."""
+        if getattr(self, "_ref_logp_fn", None) is None:
+            module = self.module
+            chunk = self._resolved_logit_chunk()
+
+            def ref_logps(p, ids, attn, out):
+                return _completion_logps(module, p, ids, attn, out, chunk)[0]
+
+            self._ref_logp_fn = jax.jit(ref_logps)
+        return self._ref_logp_fn
+
     def make_experience(self, samples: Sequence[Sequence[str]], seq_length: int) -> None:
         """Tokenize preference triples and precompute the frozen-reference
         completion logprobs for every pair."""
@@ -100,12 +115,7 @@ class DPOTrainer(TPUBaseTrainer):
         logger.info("Precomputing frozen-reference logprobs for %d pairs", len(self.store))
         from trlx_tpu.parallel import shard_batch
 
-        chunk = self._resolved_logit_chunk()
-        ref_fn = jax.jit(
-            lambda p, ids, attn, out: _completion_logps(
-                self.module, p, ids, attn, out, chunk
-            )[0]
-        )
+        ref_fn = self._get_ref_logp_fn()
         bs = min(self.config.train.batch_size, len(self.store))
         loader = self.store.create_loader(bs, shuffle=False, drop_last=False)
         idx = 0

@@ -144,8 +144,9 @@ class GRPOTrainer(PPOTrainer):
             scores, G, method.scale_advantage, baseline=method.baseline
         )
 
-        # reference KL for logging (the loss recomputes it on device)
-        lp, rlp = np.asarray(host["logprobs"]), np.asarray(host["ref_logprobs"])
+        # reference KL for logging (the loss recomputes it on device);
+        # to_host already landed numpy arrays — no further conversion
+        lp, rlp = host["logprobs"], host["ref_logprobs"]
         delta = (rlp - lp) * response_mask
         n_tok = max(response_mask.sum(), 1)
         mean_kl = float(((np.exp(delta) - delta - 1.0) * response_mask).sum() / n_tok)
@@ -200,8 +201,8 @@ class GRPOTrainer(PPOTrainer):
                     "response_mask": gen_out.response_mask,
                 }
             )
-            response_tokens = np.asarray(host_gen["response_tokens"])
-            response_mask = np.asarray(host_gen["response_mask"])
+            response_tokens = host_gen["response_tokens"]
+            response_mask = host_gen["response_mask"]
             agg["gen_time_sum"] += time() - gen_time
             # slot accounting (docs/PERFORMANCE.md): this chunk's decode ran
             # max(n_i) steps over B slots — same mask-derived gauges as

@@ -445,14 +445,15 @@ class PPOTrainer(TPUBaseTrainer):
             # ExitStack (not a plain `with`) mirrors the historical shape:
             # the span must close even if decode/reward raises mid-overlap
             score_sp = score_ctx.enter_context(self.obs.span("score"))
+            # to_host already lands numpy arrays — no further conversion
             host_gen = to_host(
                 {
                     "response_tokens": dev["gen_out"].response_tokens,
                     "response_mask": dev["gen_out"].response_mask,
                 }
             )
-            response_tokens = np.asarray(host_gen["response_tokens"])
-            response_mask = np.asarray(host_gen["response_mask"])
+            response_tokens = host_gen["response_tokens"]
+            response_mask = host_gen["response_mask"]
 
             samples, prompts, outputs = self.decode(
                 dev["prompt_ids"], response_tokens, append_eos_token=True
@@ -541,9 +542,11 @@ class PPOTrainer(TPUBaseTrainer):
             elements.append(
                 PPORLElement(
                     query_tensor=query,
+                    # host[...] landed via to_host: already numpy, slices
+                    # need no re-asarray
                     response_tensor=response_tokens[i, :n_i],
-                    logprobs=np.asarray(host["logprobs"][i, :n_i]),
-                    values=np.asarray(host["values"][i, :n_i]),
+                    logprobs=host["logprobs"][i, :n_i],
+                    values=host["values"][i, :n_i],
                     rewards=rewards[i, :n_i],
                 )
             )
