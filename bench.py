@@ -435,6 +435,16 @@ def main():
     bench_cb = os.environ.get("BENCH_CB", "0") == "1"
     if bench_cb:
         config = config.evolve(train=dict(continuous_batching=True))
+    # BENCH_ENGINE=1: continuous batching over the paged-KV engine with the
+    # prefix cache (docs/PERFORMANCE.md engine section) — the headline then
+    # carries prefix_hit_rate and kv_blocks_in_use; the dedicated A/B lives
+    # in `python -m trlx_tpu.benchmark engine-paged`
+    bench_engine = os.environ.get("BENCH_ENGINE", "0") == "1"
+    if bench_engine:
+        config = config.evolve(
+            train=dict(continuous_batching=True),
+            engine=dict(backend="paged", prefix_cache=True),
+        )
 
     # BENCH_FAULTS=1 (default): prove end-to-end recovery on this exact
     # build during the UNTIMED warmup cycle (docs/RESILIENCE.md) — the
@@ -637,6 +647,16 @@ def main():
     line["slot_utilization"] = (
         round(float(slot_util), 4) if slot_util is not None else None
     )
+    # paged-engine gauges (docs/PERFORMANCE.md): prefix-cache hit rate over
+    # full prompt blocks and the block pool's high-water, from the last
+    # cycle's rollout engine; null unless BENCH_ENGINE=1 selected the paged
+    # backend (+ prefix cache)
+    hit_rate = trainer.make_experience_stats.get("engine/prefix_hit_rate")
+    line["prefix_hit_rate"] = (
+        round(float(hit_rate), 4) if hit_rate is not None else None
+    )
+    blocks = trainer.make_experience_stats.get("engine/kv_blocks_in_use")
+    line["kv_blocks_in_use"] = int(blocks) if blocks is not None else None
     # resilience proof (docs/RESILIENCE.md): "ok" when the warmup cycle's
     # injected reward outage was retried away AND the injected NaN step left
     # the weights finite (update guard); null when BENCH_FAULTS=0

@@ -5,8 +5,9 @@ docs/STATIC_ANALYSIS.md).
 
 Kept so existing invocations (``python scripts/check_metric_names.py``) and
 ``tests/test_metric_names.py`` keep working unchanged — the public helpers
-(``find_violations``/``scanned_keys``/``LEGACY_KEYS``/``RESILIENCE_KEYS``)
-re-export the framework implementations with identical semantics. Prefer
+(``find_violations``/``scanned_keys``/``LEGACY_KEYS``/``RESILIENCE_KEYS``/
+``ENGINE_KEYS``) re-export the framework implementations with identical
+semantics. Prefer
 ``scripts/lint.py`` (all passes) going forward.
 """
 
@@ -18,6 +19,7 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 from trlx_tpu.analysis.conventions import (  # noqa: E402,F401
+    ENGINE_KEYS,
     LEGACY_KEYS,
     RESILIENCE_KEYS,
     _CONVENTION_RE,

@@ -503,6 +503,12 @@ def test_config_keys_on_real_configs():
     assert "rollout_pipeline_depth" in sections["train"]
     assert "update_guard" in sections["resilience"]
     assert "chunk_size" in sections["method"]  # union over MethodConfigs
+    # the engine: section (paged KV / prefix cache, docs/PERFORMANCE.md)
+    # resolves like every other TRLConfig field — a typo'd engine knob
+    # (config.engine.kv_blocksize) is a GL601 finding, not a silent default
+    assert {"backend", "kv_block_size", "max_kv_blocks", "prefix_cache"} <= (
+        sections["engine"]
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -252,7 +252,11 @@ class TestEngineStateMachine:
             "rollout/refill_prefills",
             "rollout/refilled_rows",
             "rollout/segments",
+            # the dense engine now reports its KV allocation too
+            # (docs/PERFORMANCE.md; engine/* gauges are paged-only)
+            "memory/kv_cache_bytes",
         }
+        assert metrics["memory/kv_cache_bytes"] > 0
 
 
 # ---------------------------------------------------------------------------

@@ -52,6 +52,24 @@ def test_scanner_sees_the_codebase():
     assert "resilience/update_ok" in keys
     assert "resilience/preemptions" in keys
     assert "resilience/goodput_frac" in keys
+    # generation-engine keys (docs/PERFORMANCE.md): block-pool / prefix-cache
+    # gauges from EngineStats.metrics and the serial path's KV-memory gauge
+    assert "memory/kv_cache_bytes" in keys
+    assert "engine/kv_blocks_in_use" in keys
+    assert "engine/prefix_hit_rate" in keys
+
+
+def test_engine_keys_registered_and_namespaced():
+    """Every canonical engine/* + memory gauge key (docs/PERFORMANCE.md) is
+    registered in the checker, follows the namespace/name convention, and
+    is visible to the static scanner (they are all literal sites)."""
+    checker = _load_checker()
+    assert checker.ENGINE_KEYS, "engine key registry is empty"
+    for key in checker.ENGINE_KEYS:
+        assert checker._CONVENTION_RE.match(key), key
+    keys = checker.scanned_keys()
+    missing = {k for k in checker.ENGINE_KEYS if k not in keys}
+    assert missing == set(), f"engine keys not seen by the scanner: {missing}"
 
 
 def test_resilience_keys_registered_and_namespaced():

@@ -62,6 +62,16 @@ def test_budget_gpt2_test_cb():
 
 
 @pytest.mark.slow
+def test_budget_gpt2_test_paged():
+    """The paged-KV engine hot path (paged_refill + paged_decode,
+    ops/paged_kv.py): the gather/scatter wrapped around the dense compute
+    is itself under regression guard — a table-indexing change that blows
+    up the gather (or quietly materializes the pool per step) shows up as
+    a byte/temp jump here."""
+    _assert_within_budget("gpt2_test_paged")
+
+
+@pytest.mark.slow
 def test_budget_ilql_gpt2_test():
     """ILQL's programs: twin-Q/CQL train step + the advantage-reshaping
     sampler (a different generate program than PPO's)."""

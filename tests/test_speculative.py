@@ -740,3 +740,63 @@ def test_pipe_mesh_greedy_matches_unpipelined(tmp_path):
             == np.asarray(jax.device_get(ref.response_mask))).all()
     assert 0.0 <= spec_t.last_spec_stats["rollout/spec_acceptance_rate"] <= 1.0
     set_global_mesh(None)
+
+
+class TestPerRowRngComposition:
+    """per_row_rng × speculative decoding (the continuous-batching
+    composition seam): multi-row requests are rejected with a precise,
+    knob-naming error; a single row is accepted because the per-row and
+    shared stream disciplines coincide there — with one row there is no
+    batch composition for a per-row chain to be invariant to."""
+
+    def test_multi_row_rejected_naming_the_knobs(self):
+        t, d = _models()
+        ids, mask = _prompts(B=3)
+        cfg = GenerationConfig(
+            max_new_tokens=4, pad_token_id=258, per_row_rng=True
+        )
+        with pytest.raises(ValueError) as exc:
+            _spec(t, d, ids, mask, cfg, 2)
+        msg = str(exc.value)
+        # the error must name the config knobs and the actual reason
+        assert "per_row_rng" in msg
+        assert "train.continuous_batching" in msg
+        assert "model.draft_model_path" in msg
+        assert "n_rows == 1" in msg
+
+    def test_single_row_accepted_greedy_bit_identical(self):
+        t, d = _models()
+        ids, mask = _prompts(B=3)
+        ids, mask = ids[:1], mask[:1]
+        cfg = GenerationConfig(
+            max_new_tokens=8, do_sample=False, eos_token_id=None,
+            pad_token_id=258, per_row_rng=True,
+        )
+        t_apply, t_params, t_cfg = t
+        ref = generate(
+            t_apply, t_params,
+            lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+            ids, mask, jax.random.PRNGKey(0), cfg,
+        )
+        out = _spec(t, d, ids, mask, cfg, 3)
+        assert (
+            np.asarray(out.response_tokens) == np.asarray(ref.response_tokens)
+        ).all()
+        assert (
+            np.asarray(out.response_mask) == np.asarray(ref.response_mask)
+        ).all()
+
+    def test_single_row_sampled_runs(self):
+        """Sampling with per_row_rng at n_rows == 1 executes (no raise) and
+        produces a well-formed output — the streams differ from the plain
+        sampler's by design (speculative sampling is distribution-exact,
+        not stream-equal)."""
+        t, d = _models()
+        ids, mask = _prompts(B=3)
+        cfg = GenerationConfig(
+            max_new_tokens=6, pad_token_id=258, eos_token_id=None,
+            per_row_rng=True,
+        )
+        out = _spec(t, d, ids[:1], mask[:1], cfg, 2)
+        assert np.asarray(out.response_tokens).shape == (1, 6)
+        assert int(np.asarray(out.response_mask).sum()) == 6

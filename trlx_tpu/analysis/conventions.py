@@ -75,6 +75,20 @@ RESILIENCE_KEYS = frozenset({
     "resilience/publish_fallbacks",
 })
 
+# Canonical generation-engine metric keys (trlx_tpu/engine/,
+# docs/PERFORMANCE.md): the paged-KV block-pool and prefix-cache gauges,
+# plus the KV-memory gauge both backends (and the serial sampler) report.
+# All are statically visible stats[...] / set_gauge sites, but the registry
+# is the single list tests assert convention + visibility against —
+# tests/test_metric_names.py.
+ENGINE_KEYS = frozenset({
+    "engine/kv_blocks_in_use",
+    "engine/block_pool_occupancy",
+    "engine/prefix_hit_rate",
+    "engine/prefix_tokens_saved",
+    "memory/kv_cache_bytes",
+})
+
 
 def _iter_line_keys(lines) -> "List[Tuple[int, str]]":
     """(lineno, key) for every literal metric-key site in ``lines`` — the

@@ -565,6 +565,191 @@ def measure_continuous_batching(
     return results
 
 
+def measure_engine_paged(
+    policy_layers: int = 8,
+    policy_hidden: int = 128,
+    batch_size: int = 16,
+    prompt_len: int = 32,
+    max_new_tokens: int = 96,
+    group_size: int = 8,
+    n_groups: int = 8,
+    passes: int = 2,
+    absorb_frac: float = 0.08,
+    kv_block_size: int = 8,
+    segment_len: int = 8,
+    seed: int = _SEED,
+) -> Dict[str, Any]:
+    """Engine A/B: dense per-slot KV vs paged block-pool KV + prefix cache
+    (docs/PERFORMANCE.md engine section) on a shared-prefix workload —
+    ``n_groups`` distinct prompts × ``group_size`` identical members (the
+    GRPO-group shape) driven through the engine for ``passes`` waves with
+    FIXED params (the repeated-eval shape; a trained-params wave would
+    flush the prefix cache, see ``ContinuousEngine.begin_collection``).
+
+    Responses are ~geometric in ``[1, max_new_tokens]`` via an absorbing
+    transition mask, so live tokens sit far below ``slots × max_length`` —
+    the regime the paged pool exists for. Both modes decode the SAME
+    per-row RNG streams and the harvest is asserted bit-identical inside
+    this function, so every delta is bookkeeping, never a workload change.
+
+    The two acceptance numbers (committed: benchmarks/ENGINE_PAGED_cpu.json):
+
+    - ``kv_bytes_high_water`` (paged) vs ``kv_cache_bytes`` (dense): the
+      paged pool's high-water is blocks-in-use × block bytes — live
+      tokens — while the dense cache is ``B × (P + N)`` regardless;
+    - ``prefill_tokens``: prefix-cache hits prefill only unshared
+      suffixes, so the paged engine prefills strictly fewer prompt tokens
+      (``prefix_tokens_saved`` = the columns skipped).
+    """
+    import numpy as np
+
+    from trlx_tpu.trlx import initialize_runtime
+
+    initialize_runtime()  # honors TRLX_TPU_PLATFORM before any backend init
+
+    import jax
+
+    from trlx_tpu.data.configs import ModelConfig
+    from trlx_tpu.engine.core import ContinuousEngine
+    from trlx_tpu.models.builder import build_causal_lm
+    from trlx_tpu.models.transformer import make_kv_cache
+    from trlx_tpu.ops.paged_kv import PagedSpec
+    from trlx_tpu.ops.sampling import (
+        GenerationConfig,
+        apply_transition_mask,
+        per_row_keys,
+    )
+    from trlx_tpu.ops.slot_refill import make_slot_refill_fns
+
+    # builtin:bytes vocab: ids 0..255 bytes, 256 bos, 257 eos, 258 pad (=259)
+    vocab, eos, pad = 259, 257, 258
+    absorb_n = max(1, int(absorb_frac * 256))
+    trans = np.ones((vocab, vocab), bool)
+    trans[:absorb_n, :] = False
+    trans[:absorb_n, eos] = True
+    import jax.numpy as jnp
+
+    tmask = jnp.asarray(trans)
+
+    def adjust(step_out, logits):
+        return apply_transition_mask(tmask, step_out["last_tokens"], logits)
+
+    policy_extra = dict(
+        num_layers=policy_layers,
+        hidden_size=policy_hidden,
+        num_heads=max(4, policy_hidden // 32),
+        intermediate_size=4 * policy_hidden,
+    )
+    module, params, tcfg = build_causal_lm(
+        ModelConfig(
+            model_path="builtin:gpt2-test", model_extra_kwargs=dict(policy_extra)
+        ),
+        head="value",
+    )
+
+    def apply_fn(p, ids, **kw):
+        return module.apply({"params": p}, ids, **kw)
+
+    gen_config = GenerationConfig(
+        max_new_tokens=max_new_tokens, eos_token_id=eos, pad_token_id=pad,
+        do_sample=True, per_row_rng=True,
+    )
+    B, P, N = batch_size, prompt_len, max_new_tokens
+    S = P + N
+    rs = np.random.RandomState(seed)
+    group_prompts = rs.randint(0, 200, (n_groups, P)).astype(np.int32)
+    prompts = np.repeat(group_prompts, group_size, axis=0)  # GRPO-group shape
+    masks = np.ones_like(prompts)
+    n = prompts.shape[0]
+    key_rng = jax.random.PRNGKey(seed)
+    pass_keys = []
+    for _ in range(passes + 1):  # +1 warmup wave
+        key_rng, call = jax.random.split(key_rng)
+        pass_keys.append(np.asarray(per_row_keys(call, n)))
+
+    TB = -(-S // kv_block_size)
+    results: Dict[str, Any] = {
+        "config": dict(
+            policy=policy_extra, batch_size=B, prompt_len=P,
+            max_new_tokens=N, group_size=group_size, n_groups=n_groups,
+            passes=passes, absorb_frac=absorb_frac,
+            kv_block_size=kv_block_size, segment_len=segment_len,
+        )
+    }
+    harvests: Dict[str, Dict[int, Any]] = {}
+    for mode in ("dense", "paged"):
+        paged = (
+            PagedSpec(block_size=kv_block_size, max_blocks=1 + 2 * B * TB)
+            if mode == "paged"
+            else None
+        )
+        fns = make_slot_refill_fns(
+            apply_fn, lambda b, s: make_kv_cache(tcfg, b, s), B, P, gen_config,
+            adjust_logits=adjust, segment_len=segment_len,
+            params_example=params, paged=paged,
+        )
+        engine = ContinuousEngine(
+            fns, params, pad, prefix_cache=(mode == "paged")
+        )
+
+        def wave(k, got):
+            engine.enqueue_prompts(prompts, masks, pass_keys[k])
+            while engine.busy:
+                for c in engine.step():
+                    got[c.index] = (c.tokens.tobytes(), c.logprobs.tobytes())
+
+        wave(0, {})  # warmup: compiles refill buckets + the segment program
+        engine.begin_collection(params)  # same params: prefix cache stays warm
+        got: Dict[int, Any] = {}
+        t0 = time.time()
+        for k in range(1, passes + 1):
+            wave(k, got)
+        dt = time.time() - t0
+        harvests[mode] = got
+        st = engine.stats
+        gen_tokens = st.live_slot_steps
+        results[mode] = {
+            "seconds": round(dt, 3),
+            "rollout_tokens_per_sec": round(gen_tokens / max(dt, 1e-9), 1),
+            "slot_utilization": round(st.slot_utilization, 4),
+            "kv_cache_bytes": int(st.kv_cache_bytes),
+            "prefill_tokens": int(st.prefill_tokens),
+        }
+        if mode == "paged":
+            results[mode].update(
+                kv_bytes_high_water=int(st.kv_bytes_high_water),
+                kv_blocks_in_use=int(st.kv_blocks_in_use),
+                kv_blocks_total=int(st.kv_blocks_total),
+                prefix_hit_rate=round(st.prefix_hit_rate, 4),
+                prefix_tokens_saved=int(st.prefix_tokens_saved),
+            )
+
+    assert harvests["dense"] == harvests["paged"], (
+        "paged harvest diverged from dense — bit-parity contract broken"
+    )
+    results["bit_identical"] = True
+    # claim (1): paged KV high-water (live tokens) vs the dense ceiling
+    results["kv_high_water_vs_dense"] = round(
+        results["paged"]["kv_bytes_high_water"]
+        / max(results["dense"]["kv_cache_bytes"], 1),
+        4,
+    )
+    # claim (2): prefill tokens saved by prefix-cache hits
+    results["prefill_tokens_saved_frac"] = round(
+        1.0
+        - results["paged"]["prefill_tokens"]
+        / max(results["dense"]["prefill_tokens"], 1),
+        4,
+    )
+    results["speedup"] = round(
+        results["dense"]["seconds"] / max(results["paged"]["seconds"], 1e-9), 3
+    )
+    import jax as _jax
+
+    results["backend"] = _jax.default_backend()
+    return results
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
@@ -600,6 +785,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     cb_p.add_argument("--absorb-frac", type=float, default=0.08)
     cb_p.add_argument("--segment-len", type=int, default=8)
     cb_p.add_argument("--rounds", type=int, default=3)
+    ep_p = sub.add_parser(
+        "engine-paged",
+        help="A/B generation engine: dense per-slot KV vs paged block-pool "
+        "KV + prefix cache on a shared-prefix (GRPO-group/eval) workload",
+    )
+    ep_p.add_argument("--output", default=None, help="write JSON here (default stdout)")
+    ep_p.add_argument("--policy-layers", type=int, default=8)
+    ep_p.add_argument("--policy-hidden", type=int, default=128)
+    ep_p.add_argument("--batch-size", type=int, default=16)
+    ep_p.add_argument("--prompt-len", type=int, default=32)
+    ep_p.add_argument("--max-new-tokens", type=int, default=96)
+    ep_p.add_argument("--group-size", type=int, default=8)
+    ep_p.add_argument("--n-groups", type=int, default=8)
+    ep_p.add_argument("--passes", type=int, default=2)
+    ep_p.add_argument("--absorb-frac", type=float, default=0.08)
+    ep_p.add_argument("--kv-block-size", type=int, default=8)
+    ep_p.add_argument("--segment-len", type=int, default=8)
     args = parser.parse_args(argv)
 
     if args.cmd == "run":
@@ -628,6 +830,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             absorb_frac=args.absorb_frac,
             segment_len=args.segment_len,
             rounds=args.rounds,
+        )
+        text = json.dumps(result, indent=2)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text + "\n")
+        print(text)
+        return 0
+    if args.cmd == "engine-paged":
+        result = measure_engine_paged(
+            policy_layers=args.policy_layers,
+            policy_hidden=args.policy_hidden,
+            batch_size=args.batch_size,
+            prompt_len=args.prompt_len,
+            max_new_tokens=args.max_new_tokens,
+            group_size=args.group_size,
+            n_groups=args.n_groups,
+            passes=args.passes,
+            absorb_frac=args.absorb_frac,
+            kv_block_size=args.kv_block_size,
+            segment_len=args.segment_len,
         )
         text = json.dumps(result, indent=2)
         if args.output:

@@ -255,6 +255,48 @@ class ResilienceConfig:
 
 
 @dataclass
+class EngineConfig:
+    """Generation-engine knobs (``trlx_tpu/engine/``, docs/PERFORMANCE.md).
+
+    Selects the KV backend behind the unified Engine interface the
+    trainers' rollout collection runs on (``train.continuous_batching``
+    routes through it; the serial path is always the dense reference).
+
+    :param backend: ``"dense"`` (default: the per-slot ``[B, S]`` KV cache,
+        byte-for-byte the PR-3 engine) or ``"paged"`` (block-pool KV with
+        per-slot block tables — persistent KV HBM tracks *live tokens*
+        instead of ``slots × max_length``; bit-identical outputs, pinned by
+        ``tests/test_engine.py``).
+    :param kv_block_size: cache columns per KV block. Smaller blocks track
+        live tokens tighter and share shorter prefixes, at more table/
+        gather overhead; larger blocks amortize bookkeeping. Power of two
+        recommended; must be ≤ the padded prompt width for prefix hits to
+        exist.
+    :param max_kv_blocks: pool size in blocks (including the reserved
+        zero block). 0 = auto: enough for every slot at full length, plus
+        an equal prefix-cache working set when ``prefix_cache`` is on.
+        Under-provisioned pools evict prefix entries first and raise a
+        clear error only when live rows themselves cannot be backed.
+    :param prefix_cache: share committed full prompt blocks between rows
+        whose *padded* prompts agree from column 0 (GRPO group members,
+        repeated eval prompts): hits prefill only the unshared suffix.
+        Requires ``backend: paged``. Auto-disabled (with a warning) for
+        MoE policies: expert-capacity coupling across a row's tokens
+        breaks the suffix-prefill bit-equality the cache relies on.
+    :param prefix_cache_blocks: entry cap for the prefix cache (0 = only
+        pool pressure evicts).
+    """
+
+    backend: str = "dense"
+    kv_block_size: int = 16
+    max_kv_blocks: int = 0
+    prefix_cache: bool = False
+    prefix_cache_blocks: int = 0
+
+    from_dict = classmethod(_strict_from_dict)
+
+
+@dataclass
 class TrainConfig:
     """Run-level knobs for the shared learn loop
     (reference: ``trlx/data/configs.py:142-230``)."""
@@ -360,6 +402,7 @@ class TRLConfig:
     train: TrainConfig
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
 
     @classmethod
     def load_yaml(cls, yml_fp: str) -> "TRLConfig":
@@ -385,6 +428,7 @@ class TRLConfig:
             "train": asdict(self.train),
             "parallel": asdict(self.parallel),
             "resilience": asdict(self.resilience),
+            "engine": asdict(self.engine),
         })
 
     @classmethod
@@ -398,6 +442,7 @@ class TRLConfig:
             train=TrainConfig.from_dict(config["train"]),
             parallel=ParallelConfig.from_dict(config.get("parallel", {})),
             resilience=ResilienceConfig.from_dict(config.get("resilience", {})),
+            engine=EngineConfig.from_dict(config.get("engine", {})),
         )
 
     def evolve(self, **kwargs) -> "TRLConfig":

@@ -1,0 +1,34 @@
+"""Unified generation Engine (docs/PERFORMANCE.md):
+
+- :mod:`trlx_tpu.engine.core` — the Engine interface, the serial
+  reference wrapper, and the continuous-batching engine over dense or
+  paged KV backends;
+- :mod:`trlx_tpu.engine.allocator` — refcounted KV-block allocator;
+- :mod:`trlx_tpu.engine.prefix_cache` — radix prefix cache over prompt
+  token chunks mapping to committed KV blocks.
+
+The device half (block pool layout, gather/scatter, slot-refill
+programs) lives in ``trlx_tpu/ops/paged_kv.py`` and
+``trlx_tpu/ops/slot_refill.py``.
+"""
+
+from trlx_tpu.engine.allocator import BlockAllocator, BlockPoolExhausted
+from trlx_tpu.engine.core import (
+    CompletedSequence,
+    ContinuousEngine,
+    Engine,
+    EngineStats,
+    SerialEngine,
+)
+from trlx_tpu.engine.prefix_cache import PrefixCache
+
+__all__ = [
+    "BlockAllocator",
+    "BlockPoolExhausted",
+    "CompletedSequence",
+    "ContinuousEngine",
+    "Engine",
+    "EngineStats",
+    "PrefixCache",
+    "SerialEngine",
+]

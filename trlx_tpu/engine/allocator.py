@@ -1,0 +1,103 @@
+"""Refcounted KV-block allocator (host half of the paged cache).
+
+Owns the free list over the pool built by ``ops/paged_kv.py``. Every block
+carries a reference count: a decoding slot holds one ref on each block its
+table points at, and the prefix cache (``prefix_cache.py``) holds one ref
+on each block it has committed — copy-on-write sharing is just "several
+holders, refcount > 1, nobody writes" (writes only ever target
+freshly-allocated refcount-1 blocks; shared blocks are full and immutable).
+
+Block 0 is the reserved all-zeros block (``paged_kv.ZERO_BLOCK``): never
+allocated, never freed — fresh table entries point there so gathers of
+unallocated regions reproduce the dense cache's zeros.
+
+Single-threaded by design, like the engine that owns it (see the thread-
+affinity note in ``trlx_tpu/engine/core.py``).
+"""
+
+from collections import deque
+from typing import Deque, Dict, Iterable, List
+
+from trlx_tpu.ops.paged_kv import ZERO_BLOCK
+
+__all__ = ["BlockPoolExhausted", "BlockAllocator"]
+
+
+class BlockPoolExhausted(RuntimeError):
+    """Raised when an allocation cannot be satisfied even after the caller
+    evicted everything evictable — ``engine.max_kv_blocks`` is too small
+    for the live working set."""
+
+
+class BlockAllocator:
+    """Free-list + refcount bookkeeping over ``max_blocks`` pool rows."""
+
+    def __init__(self, max_blocks: int):
+        if max_blocks < 2:
+            raise ValueError(
+                f"max_blocks {max_blocks} leaves no allocatable block beyond "
+                "the reserved zero block"
+            )
+        self.max_blocks = int(max_blocks)
+        # FIFO reuse keeps recycling deterministic (and spreads writes over
+        # the pool, which makes stale-data masking bugs surface in tests
+        # rather than hide behind just-zeroed blocks)
+        self._free: Deque[int] = deque(
+            b for b in range(self.max_blocks) if b != ZERO_BLOCK
+        )
+        self._refcount: Dict[int, int] = {}
+        self.high_water = 0  # max blocks simultaneously in use
+
+    # -- queries ---------------------------------------------------------
+
+    @property
+    def blocks_in_use(self) -> int:
+        return len(self._refcount)
+
+    @property
+    def blocks_free(self) -> int:
+        return len(self._free)
+
+    def refcount(self, block: int) -> int:
+        return self._refcount.get(block, 0)
+
+    # -- transitions -----------------------------------------------------
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` fresh blocks (refcount 1 each). Raises
+        :class:`BlockPoolExhausted` when the free list is short — the
+        engine catches this once, evicts prefix-cache entries, and retries
+        before giving up."""
+        if n > len(self._free):
+            raise BlockPoolExhausted(
+                f"need {n} KV blocks, {len(self._free)} free "
+                f"({self.blocks_in_use}/{self.max_blocks - 1} in use) — "
+                "raise engine.max_kv_blocks or shrink the slot batch"
+            )
+        out = [self._free.popleft() for _ in range(n)]
+        for b in out:
+            self._refcount[b] = 1
+        self.high_water = max(self.high_water, self.blocks_in_use)
+        return out
+
+    def retain(self, blocks: Iterable[int]) -> None:
+        """One more holder for already-allocated blocks (prefix-cache hit)."""
+        for b in blocks:
+            if b not in self._refcount:
+                raise ValueError(f"retain of unallocated block {b}")
+            self._refcount[b] += 1
+
+    def release(self, blocks: Iterable[int]) -> List[int]:
+        """Drop one ref per block; returns the blocks that became free."""
+        freed: List[int] = []
+        for b in blocks:
+            count = self._refcount.get(b)
+            if count is None:
+                raise ValueError(f"release of unallocated block {b}")
+            if count == 1:
+                del self._refcount[b]
+                self._free.append(b)
+                freed.append(b)
+            else:
+                self._refcount[b] = count - 1
+        return freed

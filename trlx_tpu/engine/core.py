@@ -1,0 +1,709 @@
+"""The unified generation Engine: one interface over the repo's generation
+paths, backed by a dense per-slot KV cache or the paged block pool.
+
+Three generation paths used to live inside trainers: serial ``generate``
+(ops/sampling.py), the PR-2 rollout pipeline (host overlap of an unchanged
+serial decode), and the PR-3 slot-refill continuous-batching engine
+(pipeline/continuous_batching.py). This module is their common home:
+
+- :class:`SerialEngine` — plain batch generate behind the Engine
+  interface. The dense serial path itself is untouched (it is the
+  bit-equivalence reference every other path is tested against).
+- :class:`ContinuousEngine` — the slot-refill engine (queue → refill →
+  segment decode → harvest), generalized over the KV backend:
+
+  * **dense** (default): the PR-3 per-slot ``[B, S]`` cache, byte-for-byte.
+  * **paged** (``fns.paged`` set): KV lives in a block pool with per-slot
+    block tables (``ops/paged_kv.py``). This engine owns the host half:
+    a refcounted :class:`~trlx_tpu.engine.allocator.BlockAllocator` and
+    lazy per-segment growth, so the pool's high-water tracks *live
+    tokens* instead of ``slots × max_length``; and optionally a
+    :class:`~trlx_tpu.engine.prefix_cache.PrefixCache` so rows whose
+    padded prompts share committed full blocks prefill only their
+    unshared suffix (GRPO groups, repeated eval prompts).
+
+Determinism and bit-parity are inherited from the device half
+(``ops/slot_refill.py``): prompts are assigned to slots in submission
+order, harvested in slot order, and every sequence's tokens / logprobs /
+values / mask are bit-identical to plain ``generate`` under per-row RNG —
+for the dense AND paged backends, with and without prefix hits
+(``tests/test_engine.py``, ``tests/test_continuous_batching.py``).
+
+Utilization accounting (docs/PERFORMANCE.md): every decode step costs
+``B`` slot-steps on device; only live slots produce real tokens.
+``slot_utilization`` = live ÷ total slot-steps; ``padded_decode_frac`` is
+its complement. The paged backend adds block-pool and prefix-cache gauges
+(``engine/*``, ``memory/kv_cache_bytes``) — registered in
+``tests/test_metric_names.py``.
+
+Thread affinity: engines are single-threaded by design — only the
+trainer's main thread calls ``enqueue_prompts``/``step``; the rollout
+pipeline worker sees nothing but the harvested numpy copies. If shared
+mutable state is ever introduced here, annotate it ``# guarded-by:
+<lock>`` so graftlint's lock-discipline pass (docs/STATIC_ANALYSIS.md)
+enforces the locking, as in ``rollout_pipeline.py``.
+"""
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from trlx_tpu.engine.allocator import BlockAllocator, BlockPoolExhausted
+from trlx_tpu.engine.prefix_cache import PrefixCache
+from trlx_tpu.ops.paged_kv import block_bytes, kv_bytes, num_table_blocks
+
+__all__ = [
+    "CompletedSequence",
+    "EngineStats",
+    "Engine",
+    "SerialEngine",
+    "ContinuousEngine",
+]
+
+
+@dataclass
+class CompletedSequence:
+    """One finished rollout, harvested from its slot."""
+
+    index: int  # global submission index (queue order)
+    prompt_ids: np.ndarray  # [P] left-padded prompt
+    prompt_mask: np.ndarray  # [P]
+    tokens: np.ndarray  # [N] response tokens (pad after eos)
+    logprobs: np.ndarray  # [N] behavior logprobs
+    values: np.ndarray  # [N] value-head outputs (0 if no head)
+    mask: np.ndarray  # [N] 1 on real response tokens (incl. eos)
+    meta: Any = None  # caller payload (e.g. GRPO group id)
+
+
+@dataclass
+class _Request:
+    index: int
+    input_ids: np.ndarray  # [P]
+    attention_mask: np.ndarray  # [P]
+    key: np.ndarray  # [2] per-row RNG chain start
+    meta: Any = None
+
+
+@dataclass
+class EngineStats:
+    """Aggregate slot / block / prefix accounting over one engine lifetime."""
+
+    segments: int = 0
+    decode_steps: int = 0  # device decode steps executed
+    slot_steps: int = 0  # decode_steps × B
+    live_slot_steps: int = 0  # slot-steps spent on live rows
+    refill_prefills: int = 0  # refill-program invocations
+    refilled_rows: int = 0  # prompts placed into slots
+    harvested: int = 0
+    decode_s: float = 0.0  # wall time inside decode segments
+    refill_s: float = 0.0  # wall time inside refill prefills
+    # KV memory (docs/PERFORMANCE.md): the persistent cache allocation, and
+    # for the paged backend the live-token-scaled high-water
+    kv_cache_bytes: int = 0  # dense cache / paged pool allocation
+    kv_blocks_total: int = 0  # 0 = dense backend
+    kv_blocks_in_use: int = 0  # high-water blocks simultaneously held
+    kv_bytes_high_water: int = 0  # blocks_in_use × per-block bytes (paged)
+    # prefix cache
+    prefix_enabled: bool = False
+    prefix_lookup_blocks: int = 0
+    prefix_hit_blocks: int = 0
+    prefix_tokens_saved: int = 0  # prompt columns NOT re-prefilled
+    prefix_evicted_blocks: int = 0
+    prefill_tokens: int = 0  # prompt columns actually prefilled
+
+    @property
+    def slot_utilization(self) -> float:
+        if self.slot_steps == 0:
+            return 0.0
+        return self.live_slot_steps / self.slot_steps
+
+    @property
+    def padded_decode_frac(self) -> float:
+        if self.slot_steps == 0:
+            return 0.0
+        return 1.0 - self.slot_utilization
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        if self.prefix_lookup_blocks == 0:
+            return 0.0
+        return self.prefix_hit_blocks / self.prefix_lookup_blocks
+
+    def metrics(self) -> Dict[str, float]:
+        """The observability-layer gauges (registered in
+        ``tests/test_metric_names.py``; see docs/OBSERVABILITY.md)."""
+        stats: Dict[str, float] = {}
+        stats["throughput/slot_utilization"] = self.slot_utilization
+        stats["rollout/padded_decode_frac"] = self.padded_decode_frac
+        stats["rollout/refill_prefills"] = float(self.refill_prefills)
+        stats["rollout/refilled_rows"] = float(self.refilled_rows)
+        stats["rollout/segments"] = float(self.segments)
+        stats["memory/kv_cache_bytes"] = float(self.kv_cache_bytes)
+        if self.kv_blocks_total:
+            stats["engine/kv_blocks_in_use"] = float(self.kv_blocks_in_use)
+            stats["engine/block_pool_occupancy"] = self.kv_blocks_in_use / max(
+                self.kv_blocks_total, 1
+            )
+        if self.prefix_enabled:
+            stats["engine/prefix_hit_rate"] = self.prefix_hit_rate
+            stats["engine/prefix_tokens_saved"] = float(self.prefix_tokens_saved)
+        return stats
+
+
+class Engine:
+    """The minimal contract every generation engine implements: feed
+    prompts with per-row RNG chain starts, turn the crank, collect
+    individually completed sequences. Trainers talk only to this surface
+    (``_collect_continuous``; ``generate`` routes through
+    :class:`SerialEngine`), so backends — dense, paged, and eventually the
+    disaggregated actor fleet (ROADMAP item 1) — swap under one interface.
+    """
+
+    stats: EngineStats
+
+    def enqueue_prompts(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: np.ndarray,
+        keys: np.ndarray,
+        metas: Optional[List[Any]] = None,
+    ) -> None:
+        raise NotImplementedError
+
+    def step(self) -> List[CompletedSequence]:
+        raise NotImplementedError
+
+    @property
+    def busy(self) -> bool:
+        raise NotImplementedError
+
+    def run(self) -> List[CompletedSequence]:
+        """Drain queue + slots to completion (small-scale convenience; the
+        trainers interleave :meth:`step` with downstream scoring instead)."""
+        out: List[CompletedSequence] = []
+        while self.busy:
+            out.extend(self.step())
+        return out
+
+
+class SerialEngine(Engine):
+    """Plain batch generate behind the Engine interface.
+
+    Wraps a jitted ``fn(params, input_ids, attention_mask, rng)`` — the
+    trainers' serial rollout program, UNCHANGED (it is the bit-equivalence
+    reference). The streaming surface buffers whole chunks with the rng
+    they were submitted under, so each :meth:`step` reproduces exactly one
+    serial ``generate`` call.
+    """
+
+    def __init__(self, generate_fn: Callable, params: Any, pad_token_id: int):
+        self._fn = generate_fn
+        self.params = params
+        self.pad_token_id = int(pad_token_id)
+        self._chunks: deque = deque()
+        self._submitted = 0
+        self.stats = EngineStats()
+
+    def generate(self, input_ids, attention_mask, rng):
+        """The batch-synchronous path ``TPUBaseTrainer.generate`` routes
+        through — returns whatever the wrapped program returns (a
+        GenerationOutput, or ``(output, stats)`` for the speculative
+        sampler)."""
+        return self._fn(self.params, input_ids, attention_mask, rng)
+
+    def enqueue_prompts(self, input_ids, attention_mask, keys=None, metas=None):
+        raise NotImplementedError(
+            "SerialEngine decodes whole chunks under one rng: use "
+            "submit_chunk(input_ids, attention_mask, rng) (per-row keys "
+            "are a continuous-batching concept)"
+        )
+
+    def submit_chunk(self, input_ids, attention_mask, rng, metas=None) -> None:
+        input_ids = np.asarray(input_ids, np.int32)
+        attention_mask = np.asarray(attention_mask, np.int32)
+        idx = list(range(self._submitted, self._submitted + input_ids.shape[0]))
+        self._submitted += input_ids.shape[0]
+        self._chunks.append((idx, input_ids, attention_mask, rng, metas))
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._chunks)
+
+    def step(self) -> List[CompletedSequence]:
+        if not self._chunks:
+            return []
+        idx, ids, mask, rng, metas = self._chunks.popleft()
+        t0 = time.perf_counter()
+        out = self.generate(ids, mask, rng)
+        if type(out) is tuple:  # speculative sampler: (output, stats)
+            out = out[0]
+        host = {
+            "tokens": np.asarray(out.response_tokens),
+            "logprobs": np.asarray(out.response_logprobs),
+            "values": np.asarray(out.response_values),
+            "mask": np.asarray(out.response_mask),
+        }
+        self.stats.decode_s += time.perf_counter() - t0
+        self.stats.segments += 1
+        n = len(idx)
+        steps = int(host["mask"].sum(axis=1).max()) if n else 0
+        self.stats.decode_steps += steps
+        self.stats.slot_steps += steps * n
+        self.stats.live_slot_steps += int(host["mask"].sum())
+        self.stats.harvested += n
+        return [
+            CompletedSequence(
+                index=idx[i],
+                prompt_ids=ids[i],
+                prompt_mask=mask[i],
+                tokens=host["tokens"][i],
+                logprobs=host["logprobs"][i],
+                values=host["values"][i],
+                mask=host["mask"][i],
+                meta=metas[i] if metas is not None else None,
+            )
+            for i in range(n)
+        ]
+
+
+class ContinuousEngine(Engine):
+    """Slot-refill decode over a fixed ``[B]`` slot batch.
+
+    ``fns`` are the compiled programs from
+    :func:`trlx_tpu.ops.slot_refill.make_slot_refill_fns` — their
+    ``paged`` field selects the KV backend; ``span`` is an optional
+    ``Observability.span``-shaped callable — each segment runs under a
+    fenced ``rollout/segment`` span so the trace shows device-true decode
+    time per segment. ``prefix_cache`` (paged backend only) turns on
+    shared-prefix prefill skipping.
+    """
+
+    def __init__(
+        self,
+        fns: Any,  # SlotRefillFns
+        params: Any,
+        pad_token_id: int,
+        span: Optional[Callable[..., Any]] = None,
+        prewarm: bool = True,
+        prefix_cache: bool = False,
+        prefix_capacity_blocks: int = 0,
+    ):
+        import jax.numpy as jnp  # deferred: host module, device state here only
+
+        self._jnp = jnp
+        self.fns = fns
+        self.params = params
+        self.pad_token_id = int(pad_token_id)
+        self._span = span
+        self.state = fns.init_state()
+        self.B = fns.batch_size
+        self.P = fns.prompt_len
+        self.N = fns.max_new_tokens
+        self._queue: deque = deque()
+        self._slots: List[Optional[_Request]] = [None] * self.B
+        self._submitted = 0
+        self.stats = EngineStats()
+
+        self.spec = getattr(fns, "paged", None)
+        self.allocator: Optional[BlockAllocator] = None
+        self.prefix: Optional[PrefixCache] = None
+        if self.spec is not None:
+            S = self.P + self.N
+            self._bs = self.spec.block_size
+            self._TB = num_table_blocks(S, self._bs)
+            self.allocator = BlockAllocator(self.spec.max_blocks)
+            if prefix_cache:
+                self.prefix = PrefixCache(self._bs, prefix_capacity_blocks)
+                self.stats.prefix_enabled = True
+            # host mirror of the device block table — authoritative between
+            # programs (refill programs apply the same rows on device;
+            # segment-growth pushes the whole mirror)
+            self._tables = np.zeros((self.B, self._TB), np.int32)
+            self._row_blocks: List[Optional[List[int]]] = [None] * self.B
+            # leading table entries with real (allocated) backing per slot
+            self._alloc_upto = [0] * self.B
+            # upper bound on each slot's decode step (segments survived)
+            self._steps_bound = [0] * self.B
+            self.stats.kv_blocks_total = self.spec.max_blocks - 1
+            self._block_bytes = block_bytes(self.state.cache)
+        elif prefix_cache:
+            raise ValueError(
+                "engine.prefix_cache requires the paged KV backend "
+                "(engine.backend: paged) — dense per-slot caches cannot "
+                "share blocks"
+            )
+        self.stats.kv_cache_bytes = kv_bytes(self.state.cache)
+        # identity of the params the pool's committed KV (and hence every
+        # prefix-cache entry) was computed under — a different params tree
+        # invalidates all cached KV (begin_collection flushes)
+        self._kv_params = params
+        if prewarm:
+            # once per SlotRefillFns (the fns — and their compiled bucket
+            # programs — outlive this engine via the trainer's program
+            # cache; later engines skip straight through)
+            self.state = self.fns.prewarm(self.params, self.state)
+
+    def begin_collection(self, params: Any) -> None:
+        """Reuse this engine for a fresh collection: reset the
+        per-collection stats, adopt the (possibly updated) policy params,
+        and drop any leftovers of an aborted run. Cached prefix KV is
+        valid ONLY under the params it was computed with — a new params
+        tree (the policy trained in between) flushes the prefix cache;
+        identical params (repeated eval, back-to-back collections without
+        an update) keep it warm, which is where cross-collection prefill
+        savings come from."""
+        self._queue.clear()
+        for slot in range(self.B):
+            if self._slots[slot] is None:
+                continue
+            # aborted-collection leftovers: free the slot (and its blocks —
+            # a refill that died inside _prepare_row assigned the slot but
+            # never wrote its block list, hence the None guard)
+            if self.spec is not None:
+                if self._row_blocks[slot] is not None:
+                    self.allocator.release(self._row_blocks[slot])
+                self._row_blocks[slot] = None
+                self._alloc_upto[slot] = 0
+                self._steps_bound[slot] = 0
+            self._slots[slot] = None
+        if not bool(np.asarray(self.state.done).all()):
+            # freeze any still-decoding device rows from the aborted run
+            self.state = self.state._replace(
+                done=self._jnp.ones((self.B,), bool)
+            )
+        if params is not self._kv_params:
+            if self.prefix is not None:
+                self.prefix.clear(self.allocator)
+            self._kv_params = params
+        self.params = params
+        kv_cache_bytes = self.stats.kv_cache_bytes
+        prefix_enabled = self.stats.prefix_enabled
+        kv_blocks_total = self.stats.kv_blocks_total
+        self.stats = EngineStats(
+            kv_cache_bytes=kv_cache_bytes,
+            prefix_enabled=prefix_enabled,
+            kv_blocks_total=kv_blocks_total,
+        )
+        if self.allocator is not None:
+            # per-collection high-water, not lifetime
+            self.allocator.high_water = self.allocator.blocks_in_use
+
+    # -- feeding ---------------------------------------------------------
+
+    def enqueue_prompts(
+        self,
+        input_ids: np.ndarray,  # [b, p] left-padded, p <= P
+        attention_mask: np.ndarray,  # [b, p]
+        keys: np.ndarray,  # [b, 2] per-row RNG chain starts
+        metas: Optional[List[Any]] = None,
+    ) -> None:
+        """Queue a prompt batch. Rows narrower than the engine width are
+        left-padded to ``P`` (bit-stream-neutral only when the caller also
+        runs its reference ``generate`` at width ``P``); wider rows are an
+        error — the KV cache was sized for ``P``."""
+        input_ids = np.asarray(input_ids, np.int32)
+        attention_mask = np.asarray(attention_mask, np.int32)
+        b, p = input_ids.shape
+        if p > self.P:
+            raise ValueError(
+                f"prompt width {p} exceeds the engine's padded width {self.P}; "
+                "size the engine from the widest prompt chunk (or pin the "
+                "prompt loader's width with fixed_length)"
+            )
+        if p < self.P:
+            pad = self.P - p
+            input_ids = np.concatenate(
+                [np.full((b, pad), self.pad_token_id, np.int32), input_ids], axis=1
+            )
+            attention_mask = np.concatenate(
+                [np.zeros((b, pad), np.int32), attention_mask], axis=1
+            )
+        keys = np.asarray(keys)
+        for i in range(b):
+            self._queue.append(
+                _Request(
+                    index=self._submitted,
+                    input_ids=input_ids[i],
+                    attention_mask=attention_mask[i],
+                    key=keys[i],
+                    meta=metas[i] if metas is not None else None,
+                )
+            )
+            self._submitted += 1
+
+    # -- state -----------------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        """Prompts queued but not yet in a slot."""
+        return len(self._queue)
+
+    @property
+    def live(self) -> int:
+        """Slots currently holding an unharvested sequence."""
+        return sum(1 for r in self._slots if r is not None)
+
+    @property
+    def busy(self) -> bool:
+        return self.live > 0 or self.pending > 0
+
+    # -- paged-block bookkeeping ----------------------------------------
+
+    def _alloc_blocks(self, n: int) -> List[int]:
+        """Allocate with one eviction retry: on pool pressure, drop LRU
+        prefix-cache entries (their blocks free unless a live row still
+        shares them) before giving up."""
+        if n == 0:
+            return []
+        try:
+            return self.allocator.alloc(n)
+        except BlockPoolExhausted:
+            if self.prefix is not None:
+                self.stats.prefix_evicted_blocks += self.prefix.evict(
+                    self.allocator, blocks_needed=n - self.allocator.blocks_free
+                )
+                return self.allocator.alloc(n)  # exhausted again → caller's error
+            raise
+
+    def _note_block_usage(self) -> None:
+        self.stats.kv_blocks_in_use = self.allocator.high_water
+        self.stats.kv_bytes_high_water = (
+            self.allocator.high_water * self._block_bytes
+        )
+
+    def _prepare_row(self, req: "_Request", slot: int) -> int:
+        """Assign blocks for one refilled row: shared prefix blocks from
+        the cache (refcount++), fresh private blocks for the rest of the
+        prompt region. Returns the row's hit length in cache columns
+        (block-aligned, capped so at least one prompt column is always
+        recomputed — the refill forward must produce last-position logits
+        to seed the sampler)."""
+        shared: List[int] = []
+        if self.prefix is not None:
+            shared = self.prefix.match(req.input_ids, req.attention_mask)
+            shared = shared[: (self.P - 1) // self._bs]
+            # denominator = blocks a hit could ever cover — the cap above
+            # always recomputes the last prompt block, so a fully warm
+            # repeat prompt reaches hit_rate 1.0
+            self.stats.prefix_lookup_blocks += (self.P - 1) // self._bs
+            self.stats.prefix_hit_blocks += len(shared)
+        hit = len(shared) * self._bs
+        n_prompt_blocks = (self.P - 1) // self._bs + 1
+        # retain the matched chain BEFORE allocating: _alloc_blocks may
+        # evict prefix-cache entries under pool pressure, and a cache-only
+        # ref on a just-matched block would let eviction free it and hand
+        # it back as this row's writable "fresh" block (aliasing a shared
+        # prefix position with a write target). With the row's ref held,
+        # eviction only ever drops the cache's ref — the block survives.
+        if shared:
+            self.allocator.retain(shared)
+        try:
+            fresh = self._alloc_blocks(n_prompt_blocks - len(shared))
+        except BlockPoolExhausted:
+            if shared:
+                self.allocator.release(shared)  # no leak on the error path
+            raise
+        row = np.zeros(self._TB, np.int32)
+        row[: len(shared)] = shared
+        row[len(shared) : n_prompt_blocks] = fresh
+        self._tables[slot] = row
+        self._row_blocks[slot] = shared + fresh
+        self._alloc_upto[slot] = n_prompt_blocks
+        self._steps_bound[slot] = 0
+        return hit
+
+    def _ensure_decode_blocks(self, segment_len: int) -> bool:
+        """Grow each live row's table to cover the columns the next decode
+        segment may write — lazy allocation is what makes the pool's
+        high-water track live tokens. Returns True when any table changed
+        (the mirror must be pushed to device)."""
+        dirty = False
+        for slot in range(self.B):
+            if self._slots[slot] is None:
+                continue
+            need_cols = self.P + min(
+                self.N, self._steps_bound[slot] + segment_len
+            )
+            need_blocks = (need_cols - 1) // self._bs + 1
+            have = self._alloc_upto[slot]
+            if need_blocks > have:
+                fresh = self._alloc_blocks(need_blocks - have)
+                self._tables[slot, have:need_blocks] = fresh
+                self._row_blocks[slot].extend(fresh)
+                self._alloc_upto[slot] = need_blocks
+                dirty = True
+        return dirty
+
+    def _push_tables(self) -> None:
+        self.state = self.state._replace(
+            cache=self.state.cache._replace(
+                block_table=self._jnp.asarray(self._tables)
+            )
+        )
+
+    # -- the slot-refill state machine -----------------------------------
+
+    def _refill(self) -> None:
+        free = [s for s in range(self.B) if self._slots[s] is None]
+        if not free or not self._queue:
+            return
+        rows: List[_Request] = []
+        slots: List[int] = []
+        for slot in free:
+            if not self._queue:
+                break
+            req = self._queue.popleft()
+            self._slots[slot] = req
+            rows.append(req)
+            slots.append(slot)
+        t0 = time.perf_counter()
+        if self.spec is None:
+            # gather-prefill-scatter: only the fresh rows run the prefill
+            # (bucketed to a power of two inside refill_rows)
+            self.state = self.fns.refill_rows(
+                self.params,
+                self.state,
+                np.stack([r.input_ids for r in rows]),
+                np.stack([r.attention_mask for r in rows]),
+                np.asarray(slots, np.int32),
+                np.stack([r.key for r in rows]),
+            )
+            self.stats.refill_prefills += 1
+            self.stats.prefill_tokens += self.P * len(rows)
+        else:
+            self._refill_paged(rows, slots)
+        self.stats.refill_s += time.perf_counter() - t0
+        self.stats.refilled_rows += len(rows)
+
+    def _refill_paged(self, rows: List["_Request"], slots: List[int]) -> None:
+        """Paged refill: assign blocks (prefix hits → shared, rest fresh),
+        then one refill program per distinct hit length. Matching runs
+        against the cache as-is and insertion strictly AFTER the program
+        calls: blocks written by THIS refill event are not yet committed
+        when sibling rows gather their views."""
+        hits = [self._prepare_row(req, slot) for req, slot in zip(rows, slots)]
+        by_hit: Dict[int, List[int]] = {}
+        for i, h in enumerate(hits):
+            by_hit.setdefault(h, []).append(i)
+        for hit, idxs in sorted(by_hit.items()):
+            self.state = self.fns.refill_rows(
+                self.params,
+                self.state,
+                np.stack([rows[i].input_ids for i in idxs]),
+                np.stack([rows[i].attention_mask for i in idxs]),
+                np.asarray([slots[i] for i in idxs], np.int32),
+                np.stack([rows[i].key for i in idxs]),
+                table_rows=np.stack([self._tables[slots[i]] for i in idxs]),
+                hit=hit,
+            )
+            self.stats.refill_prefills += 1
+            self.stats.prefill_tokens += (self.P - hit) * len(idxs)
+            self.stats.prefix_tokens_saved += hit * len(idxs)
+        if self.prefix is not None:
+            # commit only blocks a later match could USE: _prepare_row caps
+            # hits at (P-1)//bs (the last prompt block is always
+            # recomputed), so when P is block-aligned the P//bs-th entry
+            # would be permanently pinned yet never shareable
+            n_full = (self.P - 1) // self._bs
+            for req, slot in zip(rows, slots):
+                self.prefix.insert(
+                    req.input_ids,
+                    req.attention_mask,
+                    list(self._tables[slot, :n_full]),
+                    self.allocator,
+                )
+        self._note_block_usage()
+
+    def _harvest(self) -> List[CompletedSequence]:
+        done = np.asarray(self.state.done)
+        finished = [
+            s for s in range(self.B) if self._slots[s] is not None and done[s]
+        ]
+        if not finished:
+            return []
+        idx = self._jnp.asarray(np.asarray(finished, np.int32))
+        rows = {
+            name: getattr(self.state, name)[idx]
+            for name in ("tokens", "logprobs", "values", "mask")
+        }
+        # ship immediately: start the device→host copies without blocking —
+        # by the time the consumer reads them they have usually landed
+        for leaf in rows.values():
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        host = {k: np.asarray(v) for k, v in rows.items()}
+        completed = []
+        for j, slot in enumerate(finished):  # slot order: deterministic
+            req = self._slots[slot]
+            self._slots[slot] = None
+            if self.spec is not None:
+                # free the row's block refs; blocks the prefix cache (or a
+                # sharing sibling) still holds stay allocated. The device
+                # table row goes stale, which is harmless: the slot is
+                # frozen done and every stale position is slot-masked out
+                # of (row-independent) attention until the next refill
+                # overwrites the row.
+                self.allocator.release(self._row_blocks[slot])
+                self._row_blocks[slot] = None
+                self._alloc_upto[slot] = 0
+                self._steps_bound[slot] = 0
+            completed.append(
+                CompletedSequence(
+                    index=req.index,
+                    prompt_ids=req.input_ids,
+                    prompt_mask=req.attention_mask,
+                    tokens=host["tokens"][j],
+                    logprobs=host["logprobs"][j],
+                    values=host["values"][j],
+                    mask=host["mask"][j],
+                    meta=req.meta,
+                )
+            )
+        self.stats.harvested += len(completed)
+        return completed
+
+    def step(self) -> List[CompletedSequence]:
+        """One refill → segment → harvest turn; returns newly completed
+        sequences (possibly empty while long rows keep decoding)."""
+        self._refill()
+        if self.live == 0:
+            return []
+        if self.spec is not None:
+            # reserve writable blocks for the columns this segment may
+            # produce, then push the grown tables to device
+            if self._ensure_decode_blocks(self.fns.segment_len):
+                self._push_tables()
+            self._note_block_usage()
+        if self._span is not None:
+            with self._span(
+                "rollout/segment", live=self.live, pending=self.pending
+            ) as sp:
+                self.state, live_steps, steps = self.fns.decode_segment(
+                    self.params, self.state
+                )
+                sp.fence((self.state.done, self.state.tokens))
+            self.stats.decode_s += sp.duration
+        else:
+            t0 = time.perf_counter()
+            self.state, live_steps, steps = self.fns.decode_segment(
+                self.params, self.state
+            )
+            # fetching the step counters below blocks on the segment anyway
+        steps = int(np.asarray(steps))
+        live_steps = int(np.asarray(live_steps))
+        if self._span is None:
+            self.stats.decode_s += time.perf_counter() - t0
+        self.stats.segments += 1
+        self.stats.decode_steps += steps
+        self.stats.slot_steps += steps * self.B
+        self.stats.live_slot_steps += live_steps
+        if self.spec is not None:
+            for slot in range(self.B):
+                if self._slots[slot] is not None:
+                    self._steps_bound[slot] = min(
+                        self.N, self._steps_bound[slot] + steps
+                    )
+        return self._harvest()

@@ -1,0 +1,172 @@
+"""Host-side prefix cache: a radix tree over prompt token prefixes mapping
+to committed KV blocks.
+
+Eval prompts and GRPO group members share long prompt prefixes and, before
+this cache, re-prefilled them on every collection. Here each FULL prompt
+block (``block_size`` cache columns entirely inside the prompt region) is
+keyed by its *(token, mask) chunk chained on its parent block* — the radix
+property: two padded prompt rows that agree on columns ``[0, t)`` (tokens
+AND attention mask) have bit-identical KV for those columns at every
+layer, because column ``j``'s KV depends only on columns ``≤ j``. A lookup
+walks the chain from the root and returns the longest committed run of
+full blocks; the engine points the new row's block table at them
+(refcount++ via the allocator — copy-on-write: shared blocks are full and
+immutable, writes only ever target fresh private blocks) and prefills only
+the unshared suffix.
+
+Alignment caveat (docs/PERFORMANCE.md): keys cover the *padded* row from
+column 0, so sharing requires identical left padding — exactly what
+repeated eval prompts and GRPO groups (identical full prompts) have.
+Cross-length text prefixes under different pad widths do not align and
+miss; a right-padded or offset-keyed scheme would recover them at the cost
+of positional invariance, which left-padded decode does not have.
+
+Entries hold their own allocator ref, so cached blocks survive the
+sequences that produced them; eviction (LRU, leaves first — an interior
+entry is unreachable without its parent) drops that ref, and the block is
+actually freed once no live row shares it. The engine evicts on pool
+pressure and on the optional ``capacity_blocks`` cap.
+
+Single-threaded by design, like the engine that owns it (see the thread-
+affinity note in ``trlx_tpu/engine/core.py``).
+"""
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from trlx_tpu.engine.allocator import BlockAllocator
+
+__all__ = ["PrefixCache"]
+
+
+@dataclass
+class _Entry:
+    key: Tuple[int, bytes]  # (parent uid, chunk bytes)
+    uid: int
+    block: int  # physical pool block holding this chunk's KV
+    children: int = 0
+    last_used: int = 0
+    parent: Optional["_Entry"] = None
+
+
+_ROOT_UID = -1
+
+
+class PrefixCache:
+    def __init__(self, block_size: int, capacity_blocks: int = 0):
+        self.block_size = int(block_size)
+        self.capacity_blocks = int(capacity_blocks)
+        self._entries: Dict[Tuple[int, bytes], _Entry] = {}
+        self._next_uid = 0
+        self._clock = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def _chunk_key(self, tokens: np.ndarray, mask: np.ndarray, i: int) -> bytes:
+        a, b = i * self.block_size, (i + 1) * self.block_size
+        return (
+            np.ascontiguousarray(tokens[a:b], np.int32).tobytes()
+            + np.ascontiguousarray(mask[a:b] > 0, np.int8).tobytes()
+        )
+
+    def _full_blocks(self, prompt_len: int) -> int:
+        """Blocks entirely inside the prompt region ``[0, prompt_len)`` —
+        the only immutable (hence cacheable) ones: the block straddling the
+        prompt/response boundary is written during decode."""
+        return prompt_len // self.block_size
+
+    def match(self, tokens: np.ndarray, mask: np.ndarray) -> List[int]:
+        """Longest committed chain of full prompt blocks for this padded
+        row; returns their physical block ids (the caller retains them)."""
+        n_full = self._full_blocks(tokens.shape[0])
+        blocks: List[int] = []
+        parent_uid = _ROOT_UID
+        for i in range(n_full):
+            entry = self._entries.get((parent_uid, self._chunk_key(tokens, mask, i)))
+            if entry is None:
+                break
+            self._clock += 1
+            entry.last_used = self._clock
+            blocks.append(entry.block)
+            parent_uid = entry.uid
+        return blocks
+
+    def insert(
+        self,
+        tokens: np.ndarray,
+        mask: np.ndarray,
+        blocks: List[int],  # the row's table prefix: one id per full block
+        allocator: BlockAllocator,
+    ) -> int:
+        """Commit a freshly prefilled row's full prompt blocks. Chunks
+        already present are left alone (a concurrent duplicate keeps its
+        private copy until harvest frees it); new entries retain their
+        block so it outlives the row. Returns entries inserted."""
+        n = min(self._full_blocks(tokens.shape[0]), len(blocks))
+        inserted = 0
+        parent: Optional[_Entry] = None
+        parent_uid = _ROOT_UID
+        for i in range(n):
+            key = (parent_uid, self._chunk_key(tokens, mask, i))
+            entry = self._entries.get(key)
+            if entry is None:
+                self._clock += 1
+                entry = _Entry(
+                    key=key,
+                    uid=self._next_uid,
+                    block=blocks[i],
+                    last_used=self._clock,
+                    parent=parent,
+                )
+                self._next_uid += 1
+                allocator.retain([entry.block])
+                self._entries[key] = entry
+                if parent is not None:
+                    parent.children += 1
+                inserted += 1
+            parent = entry
+            parent_uid = entry.uid
+        if self.capacity_blocks > 0 and len(self._entries) > self.capacity_blocks:
+            self.evict(
+                allocator, entries=len(self._entries) - self.capacity_blocks
+            )
+        return inserted
+
+    def evict(
+        self,
+        allocator: BlockAllocator,
+        blocks_needed: int = 0,
+        entries: int = 0,
+    ) -> int:
+        """Drop LRU leaf entries until ``blocks_needed`` blocks came FREE
+        (refs shared with live rows free later, at the rows' release) or
+        ``entries`` entries are gone, whichever target was given; returns
+        blocks actually freed."""
+        freed = 0
+        dropped = 0
+        while self._entries:
+            if blocks_needed > 0 and freed >= blocks_needed:
+                break
+            if entries > 0 and dropped >= entries:
+                break
+            if blocks_needed <= 0 and entries <= 0:
+                break
+            leaves = [e for e in self._entries.values() if e.children == 0]
+            if not leaves:
+                break
+            victim = min(leaves, key=lambda e: e.last_used)
+            del self._entries[victim.key]
+            if victim.parent is not None:
+                victim.parent.children -= 1
+            freed += len(allocator.release([victim.block]))
+            dropped += 1
+        return freed
+
+    def clear(self, allocator: BlockAllocator) -> None:
+        """Release every entry's ref (end-of-engine teardown)."""
+        for entry in self._entries.values():
+            allocator.release([entry.block])
+        self._entries.clear()

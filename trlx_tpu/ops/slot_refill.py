@@ -34,8 +34,23 @@ The ingredients:
   exactly what the plain loop would have produced, and refilling later
   cannot disturb them.
 
-Host-side orchestration (queue, harvest order, stats) lives in
-``trlx_tpu/pipeline/continuous_batching.py``.
+Cache backends: the decode/refill programs are generic over where the KV
+actually lives. The default (dense) backend keeps the historical per-slot
+``[B, S]`` cache byte-for-byte. With ``paged=PagedSpec(...)`` the
+persistent state is a block pool + per-slot block tables
+(``ops/paged_kv.py``): each program gathers the pool into the exact dense
+view the model consumes, runs the *unchanged* dense compute, and scatters
+the written span back — so paged decode is bit-identical to dense decode
+by construction (``tests/test_engine.py``). The paged refill additionally
+supports a static ``hit`` offset: rows whose leading ``hit`` cache columns
+are already committed (prefix-cache hits, ``trlx_tpu/engine/``) prefill
+only their unshared suffix ``[hit, P)`` — the suffix forward attends to
+the shared blocks through the gathered view, reproducing the full
+prefill's values bit-for-bit.
+
+Host-side orchestration (queue, harvest order, block allocation, stats)
+lives in ``trlx_tpu/engine/core.py`` (re-exported for compatibility from
+``trlx_tpu/pipeline/continuous_batching.py``).
 """
 
 import dataclasses
@@ -44,6 +59,14 @@ from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from trlx_tpu.ops.paged_kv import (
+    PagedKV,
+    PagedSpec,
+    gather_view,
+    init_paged_kv,
+    scatter_span,
+    scatter_steps,
+)
 from trlx_tpu.ops.sampling import (
     GenerationConfig,
     last_step_info,
@@ -78,16 +101,19 @@ class SlotRefillFns(NamedTuple):
     """The compiled slot-refill programs + static shape info."""
 
     init_state: Callable[[], SlotState]  # fresh all-empty state (host-cheap)
-    # (params, state, ids [r,P], mask [r,P], slot_idx [r], keys [r,2]) —
-    # host wrapper that pads r to a power-of-two bucket and dispatches the
-    # cached compiled program for that bucket
+    # (params, state, ids [r,P], mask [r,P], slot_idx [r], keys [r,2]
+    #  [, table_rows [r,TB], hit]) — host wrapper that pads r to a
+    # power-of-two bucket and dispatches the cached compiled program for
+    # that (bucket, hit) pair
     refill_rows: Callable[..., SlotState]
-    refill_program: Callable[[int], Callable]  # bucket size → compiled fn
+    refill_program: Callable[..., Callable]  # (bucket[, hit]) → compiled fn
     prewarm: Callable[[Any, SlotState], SlotState]  # once-per-fns bucket warmup
     decode_segment: Callable[..., Tuple[SlotState, jax.Array, jax.Array]]
     batch_size: int
     prompt_len: int  # padded prompt width P (fixed per engine)
     max_new_tokens: int
+    segment_len: int = 8  # decode steps per compiled segment
+    paged: Optional[PagedSpec] = None  # None = dense per-slot cache
 
 
 def _row_where(flag: jax.Array, new: Any, old: Any) -> Any:
@@ -123,6 +149,7 @@ def make_slot_refill_fns(
     segment_len: int = 8,
     params_example: Any = None,
     jit: bool = True,
+    paged: Optional[PagedSpec] = None,
 ) -> SlotRefillFns:
     """Build the (jitted) slot-refill programs for one shape bucket.
 
@@ -132,6 +159,11 @@ def make_slot_refill_fns(
     shape the ``step_out`` carry of the empty state via ``eval_shape`` —
     nothing is executed. ``config.per_row_rng`` must be True: slot migration
     is only stream-invariant under per-row key chains.
+
+    ``paged`` switches the KV backend to a block pool + per-slot block
+    tables (``ops/paged_kv.py``); the refill and segment programs then take
+    their block-table rows from the host allocator (``trlx_tpu/engine/``)
+    and gather/scatter around the unchanged dense compute.
     """
     if not config.per_row_rng:
         config = dataclasses.replace(config, per_row_rng=True)
@@ -139,20 +171,21 @@ def make_slot_refill_fns(
     S = P + N
 
     def empty_state() -> SlotState:
-        cache = init_cache_fn(B, S)
         # step_out structure comes from an abstract prefill — shapes only
+        # (the dense [B, S] cache inside eval_shape never materializes,
+        # which matters for the paged backend: its persistent state is the
+        # block pool, not a dense cache)
         out_sds = jax.eval_shape(
-            lambda p, c: apply_fn(
+            lambda p: apply_fn(
                 p,
                 jnp.zeros((B, P), jnp.int32),
                 attention_mask=jnp.zeros((B, S), jnp.int32),
                 positions=None,
-                cache=c,
+                cache=init_cache_fn(B, S),
                 cache_index=jnp.asarray(0, jnp.int32),
                 logits_span=(P - 1, P),
             ),
             params_example,
-            cache,
         )
         step_out = jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape[:1] + s.shape[2:], s.dtype),
@@ -160,6 +193,11 @@ def make_slot_refill_fns(
         )
         step_out["last_tokens"] = jnp.zeros((B,), jnp.int32)
         logits_sds = out_sds["logits"]
+        cache = (
+            init_paged_kv(init_cache_fn, paged, B, S)
+            if paged is not None
+            else init_cache_fn(B, S)
+        )
         return SlotState(
             tokens=jnp.full((B, N), config.pad_token_id, jnp.int32),
             logprobs=jnp.zeros((B, N), jnp.float32),
@@ -188,7 +226,7 @@ def make_slot_refill_fns(
             if k not in _NON_CARRY_KEYS and v is not None
         }
 
-    def _make_refill(R: int):
+    def _make_refill(R: int, hit: int = 0):
         def refill(
             params: Any,
             state: SlotState,
@@ -196,27 +234,49 @@ def make_slot_refill_fns(
             prompt_mask: jax.Array,  # [R, P]
             slot_idx: jax.Array,  # [R] target slots; >= B = padding (dropped)
             new_keys: jax.Array,  # [R, 2] per-row key chains
+            table_rows: Optional[jax.Array] = None,  # [R, TB] (paged only)
         ) -> SlotState:
             """Gather-prefill-scatter into freed cache slots: only the ``R``
-            refilled rows run the prefill forward (cost ``R·P`` tokens — the
-            exact serial-path prefill cost amortized over the run, instead
-            of a full ``B·P`` forward per refill event), then scatter into
-            the big state at ``slot_idx``. Out-of-range indices (the
-            power-of-two bucket padding) drop: every lane write is
-            deterministic, no duplicate-index races."""
+            refilled rows run the prefill forward (cost ``R·(P − hit)``
+            tokens — the serial path's prefill cost amortized over the run,
+            minus prefix-cache hits — instead of a full ``B·P`` forward per
+            refill event), then scatter into the big state at ``slot_idx``.
+            Out-of-range indices (the power-of-two bucket padding) drop:
+            every lane write is deterministic, no duplicate-index races.
+
+            With the paged backend and ``hit > 0`` the leading ``hit`` cache
+            columns are already committed in shared blocks: only the suffix
+            ``[hit, P)`` runs the forward, attending to the shared prefix
+            through the gathered dense view — per-query-row independence of
+            every dense op makes the suffix's KV/logits bit-identical to a
+            full prefill's (the same property the bucket-size invariance
+            already relies on)."""
             input_ids = input_ids.astype(jnp.int32)
             prompt_mask = prompt_mask.astype(jnp.int32)
             slot_mask_r = jnp.concatenate(
                 [prompt_mask, jnp.zeros((R, N), jnp.int32)], axis=1
             )
+            if paged is not None and hit > 0:
+                # dense view of the refilled rows: shared prefix blocks hold
+                # committed values; everything else reads the zero block or
+                # recycled slots the mask keeps out of attention (masked
+                # scores underflow softmax to exactly 0.0, same as the
+                # dense cache's zeros)
+                row_cache = gather_view(state.cache.pool, table_rows, S)
+            else:
+                # cold refill (dense, or paged with no committed prefix):
+                # the forward writes every prompt column itself and the
+                # response region is masked — a zero cache is equivalent
+                # and skips the pool gather entirely
+                row_cache = init_cache_fn(R, S)
             out = apply_fn(
                 params,
-                input_ids,
+                input_ids[:, hit:],
                 attention_mask=slot_mask_r,
                 positions=None,
-                cache=init_cache_fn(R, S),
-                cache_index=jnp.asarray(0, jnp.int32),
-                logits_span=(P - 1, P),
+                cache=row_cache,
+                cache_index=jnp.asarray(hit, jnp.int32),
+                logits_span=(P - hit - 1, P - hit),
             )
             step_out_r = {**last_step_info(out), "last_tokens": input_ids[:, -1]}
 
@@ -231,6 +291,22 @@ def make_slot_refill_fns(
                 # scanned layout [L, B, S, KV, D]: batch axis 1
                 return big.at[:, slot_idx].set(rows.astype(big.dtype), mode="drop")
 
+            if paged is not None:
+                # commit the recomputed span [hit, P) and point the slots'
+                # table rows at their (shared + fresh) blocks
+                new_cache = PagedKV(
+                    pool=scatter_span(
+                        state.cache.pool, table_rows, out["cache"], hit, P - hit
+                    ),
+                    block_table=state.cache.block_table.at[slot_idx].set(
+                        table_rows, mode="drop"
+                    ),
+                )
+            else:
+                new_cache = jax.tree_util.tree_map(
+                    scat_cache, state.cache, out["cache"]
+                )
+
             tree_scat = lambda big, rows: jax.tree_util.tree_map(  # noqa: E731
                 scat, big, rows, is_leaf=lambda x: x is None
             )
@@ -240,7 +316,7 @@ def make_slot_refill_fns(
                 values=scat(state.values, jnp.zeros((R, N), jnp.float32)),
                 mask=scat(state.mask, jnp.zeros((R, N), jnp.int32)),
                 slot_mask=scat(state.slot_mask, slot_mask_r),
-                cache=jax.tree_util.tree_map(scat_cache, state.cache, out["cache"]),
+                cache=new_cache,
                 logits=scat(state.logits, out["logits"][:, -1, :]),
                 step_out=tree_scat(state.step_out, step_out_r),
                 prompt_len=scat(state.prompt_len, jnp.sum(prompt_mask, axis=1)),
@@ -251,23 +327,28 @@ def make_slot_refill_fns(
 
         return refill
 
-    _refill_cache: Dict[int, Callable] = {}
+    _refill_cache: Dict[Tuple[int, int], Callable] = {}
     _warmed = {"done": False}
 
-    def refill_program(bucket: int) -> Callable:
-        """The compiled refill program for one power-of-two bucket size."""
-        if bucket not in _refill_cache:
-            fn = _make_refill(bucket)
-            _refill_cache[bucket] = jax.jit(fn) if jit else fn
-        return _refill_cache[bucket]
+    def refill_program(bucket: int, hit: int = 0) -> Callable:
+        """The compiled refill program for one (power-of-two bucket size,
+        prefix-hit offset) pair. ``hit`` is always 0 on the dense backend;
+        paged prefix-cache hits compile one extra variant per distinct
+        block-aligned hit length, on first use."""
+        if (bucket, hit) not in _refill_cache:
+            fn = _make_refill(bucket, hit)
+            _refill_cache[(bucket, hit)] = jax.jit(fn) if jit else fn
+        return _refill_cache[(bucket, hit)]
 
     def prewarm(params: Any, state: SlotState) -> SlotState:
-        """Compile every refill bucket with dropped no-op calls (all
-        ``slot_idx = B``) so a collection's completion pattern never
-        triggers a mid-run XLA compile. Runs ONCE per fns — these programs
-        are cached per shape bucket, so later engines over the same fns
-        (one per ``make_experience`` call) skip straight through instead of
-        re-executing ~2·B·P tokens of dead prefill every collection.
+        """Compile every cold (hit = 0) refill bucket with dropped no-op
+        calls (all ``slot_idx = B``) so a collection's completion pattern
+        never triggers a mid-run XLA compile. Runs ONCE per fns — these
+        programs are cached per shape bucket, so later engines over the
+        same fns (one per ``make_experience`` call) skip straight through
+        instead of re-executing ~2·B·P tokens of dead prefill every
+        collection. Prefix-hit variants (paged) compile lazily on first
+        hit: their set depends on the prompt stream.
 
         The no-op results thread through ``state`` (content unchanged —
         every write drops): jit's executable cache keys on input *placement*
@@ -280,14 +361,20 @@ def make_slot_refill_fns(
         while buckets[-1] < B:
             buckets.append(min(buckets[-1] * 2, B))
         for bucket in [buckets[0]] + buckets:
-            state = refill_program(bucket)(
+            args = [
                 params,
                 state,
                 jnp.full((bucket, P), config.pad_token_id, jnp.int32),
                 jnp.zeros((bucket, P), jnp.int32),
                 jnp.full((bucket,), B, jnp.int32),  # out of range: drop
                 jnp.zeros((bucket, 2), jnp.asarray(state.rng).dtype),
-            )
+            ]
+            if paged is not None:
+                TB = state.cache.block_table.shape[1]
+                # out-of-range block ids: gathers clamp to a lane the zero
+                # slot mask hides, scatters drop — a true no-op
+                args.append(jnp.full((bucket, TB), paged.max_blocks, jnp.int32))
+            state = refill_program(bucket)(*args)
         _warmed["done"] = True
         return state
 
@@ -298,11 +385,13 @@ def make_slot_refill_fns(
         prompt_mask: Any,
         slot_idx: Any,  # [r] distinct target slots
         new_keys: Any,
+        table_rows: Any = None,  # [r, TB] block-table rows (paged only)
+        hit: int = 0,  # committed leading cache columns (block-aligned)
     ) -> SlotState:
         """Host wrapper: round ``r`` up to the next power-of-two bucket
         (padding rows carry ``slot_idx = B`` and scatter-drop), so at most
-        ``log2(B)+1`` refill programs ever compile while the prefill cost
-        stays within 2× of the rows actually refilled."""
+        ``log2(B)+1`` refill programs ever compile per hit length while the
+        prefill cost stays within 2× of the rows actually refilled."""
         import numpy as np
 
         input_ids = np.asarray(input_ids, np.int32)
@@ -316,6 +405,8 @@ def make_slot_refill_fns(
         bucket = min(bucket, max(B, 1))
         if bucket < r:  # r > B cannot happen (more rows than slots)
             raise ValueError(f"refilling {r} rows into {B} slots")
+        if paged is not None:
+            table_rows = np.asarray(table_rows, np.int32)
         if bucket > r:
             pad = bucket - r
             input_ids = np.concatenate(
@@ -326,17 +417,60 @@ def make_slot_refill_fns(
             new_keys = np.concatenate(
                 [new_keys, np.zeros((pad, 2), new_keys.dtype)]
             )
-        return refill_program(bucket)(
+            if paged is not None:
+                table_rows = np.concatenate(
+                    [
+                        table_rows,
+                        np.full(
+                            (pad, table_rows.shape[1]), paged.max_blocks, np.int32
+                        ),
+                    ]
+                )
+        args = [
             params, state, jnp.asarray(input_ids), jnp.asarray(prompt_mask),
             jnp.asarray(slot_idx), jnp.asarray(new_keys),
-        )
+        ]
+        if paged is not None:
+            args.append(jnp.asarray(table_rows))
+        return refill_program(bucket, hit)(*args)
 
     def decode_segment(params: Any, state: SlotState):
         """Up to ``segment_len`` decode steps over live slots; early exit
         when every slot is done. Returns ``(state, live_steps, steps_run)``
         — the utilization numerators/denominators for
-        ``throughput/slot_utilization`` / ``rollout/padded_decode_frac``."""
+        ``throughput/slot_utilization`` / ``rollout/padded_decode_frac``.
 
+        Paged backend: gather the pool into the dense view once per
+        segment, run the UNCHANGED dense loop on it, scatter each row's
+        live writes (columns ``P + step_before .. P + step_after − 1``)
+        back into its table's blocks. The loop body literally is the dense
+        body over bit-identical values, so paged decode inherits the dense
+        backend's bit-parity with plain ``generate``; the view is a
+        per-program temporary (the Pallas in-place paged decode kernel is
+        ROADMAP item 3)."""
+        if paged is not None:
+            paged_cache = state.cache
+            view = gather_view(paged_cache.pool, paged_cache.block_table, S)
+            step_before = state.step
+            st, live_steps, steps = _decode_segment_dense(
+                params, state._replace(cache=view)
+            )
+            pool = scatter_steps(
+                paged_cache.pool,
+                paged_cache.block_table,
+                st.cache,
+                P + step_before,
+                st.step - step_before,
+                segment_len,
+            )
+            return (
+                st._replace(cache=PagedKV(pool, paged_cache.block_table)),
+                live_steps,
+                steps,
+            )
+        return _decode_segment_dense(params, state)
+
+    def _decode_segment_dense(params: Any, state: SlotState):
         def sample_step(carry):
             st, live_steps, k = carry
             new_rng, sample_rng = split_row_keys(st.rng)
@@ -410,4 +544,6 @@ def make_slot_refill_fns(
         batch_size=B,
         prompt_len=P,
         max_new_tokens=N,
+        segment_len=segment_len,
+        paged=paged,
     )

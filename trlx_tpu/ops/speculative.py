@@ -158,15 +158,27 @@ def generate_speculative(
     head is attached), the draft's just ``logits``. Fully jittable with
     static ``config``/``gamma``.
     """
-    if config.per_row_rng:
-        raise NotImplementedError(
-            "per_row_rng is not supported by the speculative sampler: its "
-            "accept/reject stream is round-structured, not per-step, so a "
-            "slot-position-invariant per-row chain has no lossless analogue "
-            "here. Use the plain sampler (no draft model) for "
-            "continuous-batching rollouts."
-        )
     B, P = input_ids.shape
+    if config.per_row_rng and B > 1:
+        # A single row is exempt: per-row chains exist to make a row's
+        # sample stream independent of batch composition and slot
+        # position, and with n_rows == 1 there is no other row to depend
+        # on — the shared stream already carries the per-row guarantee
+        # (greedy outputs are bit-identical either way; sampled streams
+        # are both exact draws from the target distribution). That is the
+        # seam the speculative × continuous-batching composition grows
+        # through: single-slot speculative decode inside a slot engine.
+        raise ValueError(
+            "gen_kwargs.per_row_rng=True (implied by "
+            "train.continuous_batching) is incompatible with speculative "
+            f"decoding (model.draft_model_path) at batch size {B}: the "
+            "accept/reject stream consumes one batch-wide uniform draw "
+            "per ROUND (a variable number of committed tokens), so there "
+            "is no per-step per-row key chain that reproduces plain "
+            "generate's stream row-independently. Drop "
+            "model.draft_model_path, set per_row_rng=False, or generate "
+            "row-by-row (n_rows == 1 is accepted)."
+        )
     N = config.max_new_tokens
     G = gamma
     NB = N + G + 1  # token buffer padded so block writes never clip

@@ -47,11 +47,20 @@ TRAINER_PROGRAMS = {
 # rollout hot path (ops/slot_refill.py).
 CONTINUOUS_BATCHING_PROGRAMS = ("cb_refill", "cb_segment")
 
+# The same two hot programs over the paged KV backend (engine.backend:
+# paged — gather → dense compute → scatter around a block pool,
+# ops/paged_kv.py): budgeted separately so the gather/scatter overhead is
+# itself under regression guard.
+PAGED_ENGINE_PROGRAMS = ("paged_refill", "paged_decode")
+
 
 def _config_programs(config: TRLConfig) -> Tuple[str, ...]:
     programs = TRAINER_PROGRAMS[config.train.trainer.lower()]
     if bool(getattr(config.train, "continuous_batching", False)):
-        programs = programs + CONTINUOUS_BATCHING_PROGRAMS
+        if config.engine.backend == "paged":
+            programs = programs + PAGED_ENGINE_PROGRAMS
+        else:
+            programs = programs + CONTINUOUS_BATCHING_PROGRAMS
     return programs
 
 
@@ -196,7 +205,10 @@ def hot_program_costs(
     if programs is None:
         programs = TRAINER_PROGRAMS.get(trainer_name, ("train_step",))
         if bool(getattr(config.train, "continuous_batching", False)):
-            programs = programs + CONTINUOUS_BATCHING_PROGRAMS
+            if config.engine.backend == "paged":
+                programs = programs + PAGED_ENGINE_PROGRAMS
+            else:
+                programs = programs + CONTINUOUS_BATCHING_PROGRAMS
 
     B, P, N = batch_size, prompt_len, gen_len
     SDS = jax.ShapeDtypeStruct
@@ -249,10 +261,14 @@ def hot_program_costs(
                 )
             )
 
-        if any(p in programs for p in CONTINUOUS_BATCHING_PROGRAMS):
+        cb_all = CONTINUOUS_BATCHING_PROGRAMS + PAGED_ENGINE_PROGRAMS
+        if any(p in programs for p in cb_all):
             # the continuous-batching rollout programs: the on-demand refill
             # prefill and the fixed-size segment decode (ops/slot_refill.py)
-            # — lowered over an abstract SlotState so nothing materializes
+            # — lowered over an abstract SlotState so nothing materializes.
+            # With engine.backend == "paged" the SAME entry points carry the
+            # block-pool backend (gather/scatter around the dense compute),
+            # budgeted under the paged_* names.
             gen_kwargs = dict(trainer.generate_kwargs)
             gen_kwargs["max_new_tokens"] = N
             gen_kwargs["per_row_rng"] = True
@@ -267,21 +283,28 @@ def hot_program_costs(
             )
             fns = trainer._get_slot_refill_fns(gen_config, (), B, P, seg)
             state_sds = jax.eval_shape(fns.init_state)
-            if "cb_refill" in programs:
-                # the full-bucket (R = B) refill program: worst-case refill
-                # cost; smaller power-of-two buckets are strictly cheaper
-                results["cb_refill"] = _costs_of(
-                    fns.refill_program(B).lower(
-                        params,
-                        state_sds,
-                        batch_sds((B, P), np.int32),
-                        batch_sds((B, P), np.int32),
-                        SDS((B,), np.int32),
-                        SDS((B, 2), np.uint32),
-                    )
+            if "cb_refill" in programs or "paged_refill" in programs:
+                # the full-bucket (R = B) cold refill program: worst-case
+                # refill cost; smaller buckets / prefix hits are cheaper
+                refill_args = [
+                    params,
+                    state_sds,
+                    batch_sds((B, P), np.int32),
+                    batch_sds((B, P), np.int32),
+                    SDS((B,), np.int32),
+                    SDS((B, 2), np.uint32),
+                ]
+                name = "cb_refill"
+                if fns.paged is not None:
+                    name = "paged_refill"
+                    TB = state_sds.cache.block_table.shape[1]
+                    refill_args.append(SDS((B, TB), np.int32))
+                results[name] = _costs_of(
+                    fns.refill_program(B).lower(*refill_args)
                 )
-            if "cb_segment" in programs:
-                results["cb_segment"] = _costs_of(
+            if "cb_segment" in programs or "paged_decode" in programs:
+                name = "paged_decode" if fns.paged is not None else "cb_segment"
+                results[name] = _costs_of(
                     fns.decode_segment.lower(params, state_sds)
                 )
 
@@ -416,6 +439,19 @@ def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
                 train=dict(continuous_batching=True),
                 model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
                 tokenizer=dict(tokenizer_path="builtin:bytes"),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "gpt2_test_paged": (
+            # the paged-KV engine hot path (paged_refill + paged_decode):
+            # gather/scatter around the dense compute over a block pool —
+            # guards the new engine backend's per-program overhead
+            # (docs/PERFORMANCE.md engine section)
+            base.evolve(
+                train=dict(continuous_batching=True),
+                model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+                engine=dict(backend="paged", kv_block_size=8, prefix_cache=True),
             ),
             dict(batch_size=8, prompt_len=32, gen_len=16),
         ),

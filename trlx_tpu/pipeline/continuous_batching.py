@@ -1,313 +1,19 @@
-"""Continuous-batching rollout engine: host orchestration of slot-refill
-decode (the device half lives in ``trlx_tpu/ops/slot_refill.py``).
+"""Compatibility shim: the continuous-batching engine moved to
+``trlx_tpu/engine/core.py`` when the unified generation Engine subsumed
+the three generation paths (serial generate, the rollout pipeline, slot
+refill) behind one interface with dense and paged KV backends.
 
-The engine owns a prompt queue and ``B`` device slots. Each :meth:`step`:
-
-1. **refills** freed slots from the queue — one on-demand prefill program
-   writes fresh prompts into the freed KV-cache rows (skipped when nothing
-   is free or the queue is empty);
-2. runs one fixed-size **decode segment** (one compiled program, static
-   shapes, reused for the whole collection);
-3. **harvests** finished slots — each completed sequence ships immediately
-   as an individual :class:`CompletedSequence` (device→host copies started
-   asynchronously), freeing its slot for the next refill.
-
-So the device batch stays full until the prompt queue is empty, instead of
-every chunk draining at the pace of its longest row (PipelineRL,
-arXiv:2509.19128; OPPO, arXiv:2509.25762).
-
-Determinism: prompts are assigned to slots in submission order (queue FIFO,
-freed slots filled lowest-index first) and harvested in slot order at each
-segment boundary — the completion stream is a deterministic function of the
-sampled lengths. Each prompt carries its own RNG key chain, so its tokens /
-logprobs / values are bit-identical to plain ``generate`` on that prompt
-regardless of which slot it lands in (``tests/test_continuous_batching.py``).
-
-Utilization accounting (docs/PERFORMANCE.md): every decode step costs ``B``
-slot-steps on device; only live (unfinished, occupied) slots produce real
-tokens. ``slot_utilization`` = live ÷ total slot-steps — the number the
-refill loop exists to keep high; ``padded_decode_frac`` = its complement,
-the waste the serial chunked path pays on heterogeneous response lengths.
-
-Thread affinity: the engine is single-threaded by design — only the
-trainer's main thread calls ``enqueue_prompts``/``step``; the rollout
-pipeline worker sees nothing but the harvested numpy copies. If shared
-mutable state is ever introduced here, annotate it ``# guarded-by:
-<lock>`` so graftlint's lock-discipline pass (docs/STATIC_ANALYSIS.md)
-enforces the locking, as in ``rollout_pipeline.py``.
+``ContinuousBatchingEngine`` remains the historical name for the
+dense-backend engine; new code should import
+:class:`trlx_tpu.engine.ContinuousEngine` directly.
 """
 
-import time
-from collections import deque
-from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from trlx_tpu.engine.core import (
+    CompletedSequence,
+    ContinuousEngine,
+    EngineStats,
+)
 
-import numpy as np
+ContinuousBatchingEngine = ContinuousEngine
 
 __all__ = ["CompletedSequence", "ContinuousBatchingEngine", "EngineStats"]
-
-
-@dataclass
-class CompletedSequence:
-    """One finished rollout, harvested from its slot."""
-
-    index: int  # global submission index (queue order)
-    prompt_ids: np.ndarray  # [P] left-padded prompt
-    prompt_mask: np.ndarray  # [P]
-    tokens: np.ndarray  # [N] response tokens (pad after eos)
-    logprobs: np.ndarray  # [N] behavior logprobs
-    values: np.ndarray  # [N] value-head outputs (0 if no head)
-    mask: np.ndarray  # [N] 1 on real response tokens (incl. eos)
-    meta: Any = None  # caller payload (e.g. GRPO group id)
-
-
-@dataclass
-class _Request:
-    index: int
-    input_ids: np.ndarray  # [P]
-    attention_mask: np.ndarray  # [P]
-    key: np.ndarray  # [2] per-row RNG chain start
-    meta: Any = None
-
-
-@dataclass
-class EngineStats:
-    """Aggregate slot accounting over one engine lifetime."""
-
-    segments: int = 0
-    decode_steps: int = 0  # device decode steps executed
-    slot_steps: int = 0  # decode_steps × B
-    live_slot_steps: int = 0  # slot-steps spent on live rows
-    refill_prefills: int = 0  # refill-program invocations
-    refilled_rows: int = 0  # prompts placed into slots
-    harvested: int = 0
-    decode_s: float = 0.0  # wall time inside decode segments
-    refill_s: float = 0.0  # wall time inside refill prefills
-
-    @property
-    def slot_utilization(self) -> float:
-        if self.slot_steps == 0:
-            return 0.0
-        return self.live_slot_steps / self.slot_steps
-
-    @property
-    def padded_decode_frac(self) -> float:
-        if self.slot_steps == 0:
-            return 0.0
-        return 1.0 - self.slot_utilization
-
-    def metrics(self) -> Dict[str, float]:
-        """The observability-layer gauges (registered in
-        ``tests/test_metric_names.py``; see docs/OBSERVABILITY.md)."""
-        stats: Dict[str, float] = {}
-        stats["throughput/slot_utilization"] = self.slot_utilization
-        stats["rollout/padded_decode_frac"] = self.padded_decode_frac
-        stats["rollout/refill_prefills"] = float(self.refill_prefills)
-        stats["rollout/refilled_rows"] = float(self.refilled_rows)
-        stats["rollout/segments"] = float(self.segments)
-        return stats
-
-
-class ContinuousBatchingEngine:
-    """Slot-refill decode over a fixed ``[B]`` slot batch.
-
-    ``fns`` are the compiled programs from
-    :func:`trlx_tpu.ops.slot_refill.make_slot_refill_fns`; ``span`` is an
-    optional ``Observability.span``-shaped callable — each segment runs
-    under a fenced ``rollout/segment`` span so the trace shows device-true
-    decode time per segment.
-    """
-
-    def __init__(
-        self,
-        fns: Any,  # SlotRefillFns
-        params: Any,
-        pad_token_id: int,
-        span: Optional[Callable[..., Any]] = None,
-        prewarm: bool = True,
-    ):
-        import jax.numpy as jnp  # deferred: host module, device state here only
-
-        self._jnp = jnp
-        self.fns = fns
-        self.params = params
-        self.pad_token_id = int(pad_token_id)
-        self._span = span
-        self.state = fns.init_state()
-        self.B = fns.batch_size
-        self.P = fns.prompt_len
-        self.N = fns.max_new_tokens
-        self._queue: deque = deque()
-        self._slots: List[Optional[_Request]] = [None] * self.B
-        self._submitted = 0
-        self.stats = EngineStats()
-        if prewarm:
-            # once per SlotRefillFns (the fns — and their compiled bucket
-            # programs — outlive this engine via the trainer's program
-            # cache; later engines skip straight through)
-            self.state = self.fns.prewarm(self.params, self.state)
-
-    # -- feeding ---------------------------------------------------------
-
-    def enqueue_prompts(
-        self,
-        input_ids: np.ndarray,  # [b, p] left-padded, p <= P
-        attention_mask: np.ndarray,  # [b, p]
-        keys: np.ndarray,  # [b, 2] per-row RNG chain starts
-        metas: Optional[List[Any]] = None,
-    ) -> None:
-        """Queue a prompt batch. Rows narrower than the engine width are
-        left-padded to ``P`` (bit-stream-neutral only when the caller also
-        runs its reference ``generate`` at width ``P``); wider rows are an
-        error — the KV cache was sized for ``P``."""
-        input_ids = np.asarray(input_ids, np.int32)
-        attention_mask = np.asarray(attention_mask, np.int32)
-        b, p = input_ids.shape
-        if p > self.P:
-            raise ValueError(
-                f"prompt width {p} exceeds the engine's padded width {self.P}; "
-                "size the engine from the widest prompt chunk (or pin the "
-                "prompt loader's width with fixed_length)"
-            )
-        if p < self.P:
-            pad = self.P - p
-            input_ids = np.concatenate(
-                [np.full((b, pad), self.pad_token_id, np.int32), input_ids], axis=1
-            )
-            attention_mask = np.concatenate(
-                [np.zeros((b, pad), np.int32), attention_mask], axis=1
-            )
-        keys = np.asarray(keys)
-        for i in range(b):
-            self._queue.append(
-                _Request(
-                    index=self._submitted,
-                    input_ids=input_ids[i],
-                    attention_mask=attention_mask[i],
-                    key=keys[i],
-                    meta=metas[i] if metas is not None else None,
-                )
-            )
-            self._submitted += 1
-
-    # -- state -----------------------------------------------------------
-
-    @property
-    def pending(self) -> int:
-        """Prompts queued but not yet in a slot."""
-        return len(self._queue)
-
-    @property
-    def live(self) -> int:
-        """Slots currently holding an unharvested sequence."""
-        return sum(1 for r in self._slots if r is not None)
-
-    @property
-    def busy(self) -> bool:
-        return self.live > 0 or self.pending > 0
-
-    # -- the slot-refill state machine -----------------------------------
-
-    def _refill(self) -> None:
-        free = [s for s in range(self.B) if self._slots[s] is None]
-        if not free or not self._queue:
-            return
-        rows: List[_Request] = []
-        slots: List[int] = []
-        for slot in free:
-            if not self._queue:
-                break
-            req = self._queue.popleft()
-            self._slots[slot] = req
-            rows.append(req)
-            slots.append(slot)
-        t0 = time.perf_counter()
-        # gather-prefill-scatter: only the fresh rows run the prefill
-        # (bucketed to a power of two inside refill_rows)
-        self.state = self.fns.refill_rows(
-            self.params,
-            self.state,
-            np.stack([r.input_ids for r in rows]),
-            np.stack([r.attention_mask for r in rows]),
-            np.asarray(slots, np.int32),
-            np.stack([r.key for r in rows]),
-        )
-        self.stats.refill_s += time.perf_counter() - t0
-        self.stats.refill_prefills += 1
-        self.stats.refilled_rows += len(rows)
-
-    def _harvest(self) -> List[CompletedSequence]:
-        done = np.asarray(self.state.done)
-        finished = [
-            s for s in range(self.B) if self._slots[s] is not None and done[s]
-        ]
-        if not finished:
-            return []
-        idx = self._jnp.asarray(np.asarray(finished, np.int32))
-        rows = {
-            name: getattr(self.state, name)[idx]
-            for name in ("tokens", "logprobs", "values", "mask")
-        }
-        # ship immediately: start the device→host copies without blocking —
-        # by the time the consumer reads them they have usually landed
-        for leaf in rows.values():
-            if hasattr(leaf, "copy_to_host_async"):
-                leaf.copy_to_host_async()
-        host = {k: np.asarray(v) for k, v in rows.items()}
-        completed = []
-        for j, slot in enumerate(finished):  # slot order: deterministic
-            req = self._slots[slot]
-            self._slots[slot] = None
-            completed.append(
-                CompletedSequence(
-                    index=req.index,
-                    prompt_ids=req.input_ids,
-                    prompt_mask=req.attention_mask,
-                    tokens=host["tokens"][j],
-                    logprobs=host["logprobs"][j],
-                    values=host["values"][j],
-                    mask=host["mask"][j],
-                    meta=req.meta,
-                )
-            )
-        self.stats.harvested += len(completed)
-        return completed
-
-    def step(self) -> List[CompletedSequence]:
-        """One refill → segment → harvest turn; returns newly completed
-        sequences (possibly empty while long rows keep decoding)."""
-        self._refill()
-        if self.live == 0:
-            return []
-        if self._span is not None:
-            with self._span(
-                "rollout/segment", live=self.live, pending=self.pending
-            ) as sp:
-                self.state, live_steps, steps = self.fns.decode_segment(
-                    self.params, self.state
-                )
-                sp.fence((self.state.done, self.state.tokens))
-            self.stats.decode_s += sp.duration
-        else:
-            t0 = time.perf_counter()
-            self.state, live_steps, steps = self.fns.decode_segment(
-                self.params, self.state
-            )
-            # fetching the step counters below blocks on the segment anyway
-        steps = int(np.asarray(steps))
-        live_steps = int(np.asarray(live_steps))
-        if self._span is None:
-            self.stats.decode_s += time.perf_counter() - t0
-        self.stats.segments += 1
-        self.stats.decode_steps += steps
-        self.stats.slot_steps += steps * self.B
-        self.stats.live_slot_steps += live_steps
-        return self._harvest()
-
-    def run(self) -> List[CompletedSequence]:
-        """Drain queue + slots to completion (small-scale convenience; the
-        trainers interleave :meth:`step` with downstream scoring instead)."""
-        out: List[CompletedSequence] = []
-        while self.busy:
-            out.extend(self.step())
-        return out

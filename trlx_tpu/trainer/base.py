@@ -153,6 +153,14 @@ class TPUBaseTrainer(BaseRLTrainer):
                 f"train.batch_size ({config.train.batch_size}) must be divisible "
                 f"by train.grad_accum ({config.train.grad_accum})"
             )
+        if config.engine.prefix_cache and config.engine.backend != "paged":
+            # fail at construction, not at the first rollout collection
+            # (and never silently: with continuous_batching off this knob
+            # would otherwise just do nothing)
+            raise ValueError(
+                "engine.prefix_cache: true requires engine.backend: paged — "
+                "dense per-slot KV caches cannot share blocks"
+            )
         self.mesh = make_mesh(config.parallel)
         set_global_mesh(self.mesh)  # model code reads this for sequence-parallel ops
         # NOTE: the global mesh is process-wide; entry points re-assert it so
@@ -863,7 +871,11 @@ class TPUBaseTrainer(BaseRLTrainer):
         import dataclasses as _dc
 
         gen_config = _dc.replace(gen_config, per_row_rng=True)
-        key = ("slot_refill", gen_config, extra_kwargs, batch_size, prompt_len, segment_len)
+        paged = self._resolve_paged_spec(batch_size, prompt_len, gen_config)
+        key = (
+            "slot_refill", gen_config, extra_kwargs, batch_size, prompt_len,
+            segment_len, paged,
+        )
         if key not in self._generate_fns:
             from trlx_tpu.ops.slot_refill import make_slot_refill_fns
 
@@ -878,8 +890,56 @@ class TPUBaseTrainer(BaseRLTrainer):
                 adjust_logits=adjust,
                 segment_len=segment_len,
                 params_example=self.state.params,
+                paged=paged,
             )
         return self._generate_fns[key]
+
+    def _resolve_paged_spec(self, batch_size: int, prompt_len: int, gen_config):
+        """The paged-KV geometry for this trainer's ``engine:`` config
+        section, or None for the dense backend. ``max_kv_blocks`` auto
+        (0) sizes the pool so every slot can reach full length, plus an
+        equal prefix-cache working set when the cache is on — lazy
+        per-segment growth then keeps the *used* fraction at live tokens
+        (docs/PERFORMANCE.md)."""
+        ecfg = self.config.engine
+        if ecfg.backend == "dense":
+            return None
+        if ecfg.backend != "paged":
+            raise ValueError(
+                f"unknown engine.backend '{ecfg.backend}' (dense | paged)"
+            )
+        from trlx_tpu.ops.paged_kv import PagedSpec, num_table_blocks
+
+        bs = int(ecfg.kv_block_size)
+        if bs < 1:
+            raise ValueError(f"engine.kv_block_size {bs} must be >= 1")
+        table_blocks = num_table_blocks(
+            prompt_len + gen_config.max_new_tokens, bs
+        )
+        max_blocks = int(ecfg.max_kv_blocks)
+        if max_blocks <= 0:
+            max_blocks = 1 + batch_size * table_blocks * (
+                2 if self._prefix_cache_enabled() else 1
+            )
+        return PagedSpec(block_size=bs, max_blocks=max_blocks)
+
+    def _prefix_cache_enabled(self) -> bool:
+        """engine.prefix_cache, gated off (with a one-time warning) for MoE
+        policies: expert capacity couples a row's tokens, so a suffix-only
+        prefill is not bit-identical to the full prefill there."""
+        if not self.config.engine.prefix_cache:
+            return False
+        if getattr(self.tcfg, "num_experts", 0):
+            if not getattr(self, "_warned_moe_prefix", False):
+                self._warned_moe_prefix = True
+                logger.warning(
+                    "engine.prefix_cache disabled: MoE expert capacity is "
+                    "shared across a sequence's tokens, so suffix-only "
+                    "prefill would not be bit-identical to the full "
+                    "prefill (set engine.prefix_cache: false to silence)"
+                )
+            return False
+        return True
 
     def generate(
         self,
@@ -900,7 +960,11 @@ class TPUBaseTrainer(BaseRLTrainer):
         if attention_mask is None:
             attention_mask = (input_ids != self.tokenizer.pad_token_id).astype(np.int32)
         self._rollout_rng, rng = jax.random.split(self._rollout_rng)
-        fn = self._get_generate_fn(gen_config, extra_kwargs)
+        # the serial dense path behind the unified Engine interface
+        # (trlx_tpu/engine/core.py) — the wrapped jitted program is
+        # unchanged: it stays the bit-equivalence reference for the
+        # continuous-batching and paged backends
+        engine = self._get_serial_engine(gen_config, extra_kwargs)
         batch = shard_batch(
             {"input_ids": input_ids, "attention_mask": np.asarray(attention_mask, np.int32)},
             self.mesh,
@@ -909,10 +973,11 @@ class TPUBaseTrainer(BaseRLTrainer):
         # path — a draft-less or seq2seq generate must not keep reporting a
         # stale acceptance rate from an earlier speculative call
         self.last_spec_stats = {}
+        self._note_dense_kv_gauge(input_ids.shape, gen_config)
         # fenced span: duration is device-true decode time, not dispatch
         # latency (nests under make_experience's "rollout" span)
         with self.obs.span("generate", eval_mode=bool(eval_mode)) as sp:
-            out = fn(self.state.params, batch["input_ids"], batch["attention_mask"], rng)
+            out = engine.generate(batch["input_ids"], batch["attention_mask"], rng)
             if type(out) is tuple:  # speculative sampler: (output, stats) —
                 # GenerationOutput itself is a NamedTuple, hence the exact check
                 out, spec_stats = out
@@ -929,8 +994,46 @@ class TPUBaseTrainer(BaseRLTrainer):
                 }
             sp.fence((out.sequences, out.response_tokens))
         self.last_generate_time = sp.duration
-        self.obs.recompile.observe("generate", fn)
+        self.obs.recompile.observe("generate", engine._fn)
         return out
+
+    def _get_serial_engine(self, gen_config, extra_kwargs):
+        """The SerialEngine wrapping this (config, kwargs)'s jitted rollout
+        program — cached alongside the programs themselves; params are
+        refreshed per call (the policy trains between collections)."""
+        key = ("serial_engine", gen_config, extra_kwargs)
+        if key not in self._generate_fns:
+            from trlx_tpu.engine.core import SerialEngine
+
+            self._generate_fns[key] = SerialEngine(
+                self._get_generate_fn(gen_config, extra_kwargs),
+                self.state.params,
+                self.tokenizer.pad_token_id,
+            )
+        engine = self._generate_fns[key]
+        engine.params = self.state.params
+        return engine
+
+    def _note_dense_kv_gauge(self, prompt_shape, gen_config) -> None:
+        """``memory/kv_cache_bytes`` for the serial dense path: the cache
+        is allocated inside the jitted program, so the gauge is computed
+        from the static shapes (exact). The continuous-batching engines
+        report their own measured gauge (EngineStats.metrics)."""
+        if self.is_seq2seq:
+            return  # T5 cross/self caches have their own layout; not gauged
+        from trlx_tpu.ops.paged_kv import dense_kv_bytes
+
+        B, P = prompt_shape
+        S = P + gen_config.max_new_tokens
+        total = dense_kv_bytes(self.tcfg, B, S)
+        if self.draft_module is not None:
+            # speculative decoding: target + draft caches, both S + gamma
+            # slots (ops/speculative.py sizes them P + N + G)
+            S_spec = S + int(self.config.model.draft_gamma)
+            total = dense_kv_bytes(self.tcfg, B, S_spec) + dense_kv_bytes(
+                self.draft_tcfg, B, S_spec
+            )
+        self.obs.metrics.set_gauge("memory/kv_cache_bytes", float(total))
 
     def generate_eval(self, input_ids, attention_mask=None, **kwargs) -> GenerationOutput:
         return self.generate(input_ids, attention_mask, eval_mode=True, **kwargs)

@@ -661,15 +661,24 @@ class PPOTrainer(TPUBaseTrainer):
         }
 
     def _cb_make_engine(self, gen_config, extra_kwargs, rows: int, chunk_width: int):
-        """Build the slot-refill engine for this trainer — the single home of
+        """Build the rollout engine for this trainer — the single home of
         the engine-width invariant (PPO and GRPO must agree): the trainer-
         level prompt budget ``seq_length − max_new_tokens``, bumped to the
         first chunk's collation width if a loader pads wider. Prompt loaders
         pad to the longest row per batch, and the engine's one compiled
         shape must fit every chunk; narrower chunks left-pad
         (attention-masked, so harvested sequences stay bit-identical to
-        plain generate at THIS width)."""
-        from trlx_tpu.pipeline.continuous_batching import ContinuousBatchingEngine
+        plain generate at THIS width).
+
+        The KV backend (dense per-slot vs paged block pool) and the prefix
+        cache come from the ``engine:`` config section
+        (docs/PERFORMANCE.md); outputs are bit-identical across backends,
+        so the choice is purely a memory/throughput knob. Engines are
+        cached per shape bucket and reused across collections —
+        ``begin_collection`` resets the per-collection stats, and flushes
+        the prefix cache exactly when the params tree changed (cached KV
+        is only valid under the params that computed it)."""
+        from trlx_tpu.engine.core import ContinuousEngine
 
         seg = max(
             1, int(getattr(self.config.train, "continuous_batching_segment", 8) or 8)
@@ -678,10 +687,23 @@ class PPOTrainer(TPUBaseTrainer):
             int(self.config.train.seq_length) - gen_config.max_new_tokens,
             chunk_width,
         )
-        fns = self._get_slot_refill_fns(gen_config, extra_kwargs, rows, engine_p, seg)
-        return ContinuousBatchingEngine(
-            fns, self.state.params, self.tokenizer.pad_token_id, span=self.obs.span
-        )
+        key = ("cb_engine", gen_config, extra_kwargs, rows, engine_p, seg)
+        engine = self._generate_fns.get(key)
+        if engine is None:
+            fns = self._get_slot_refill_fns(
+                gen_config, extra_kwargs, rows, engine_p, seg
+            )
+            engine = ContinuousEngine(
+                fns,
+                self.state.params,
+                self.tokenizer.pad_token_id,
+                span=self.obs.span,
+                prefix_cache=self._prefix_cache_enabled(),
+                prefix_capacity_blocks=int(self.config.engine.prefix_cache_blocks),
+            )
+            self._generate_fns[key] = engine
+        engine.begin_collection(self.state.params)
+        return engine
 
     def _cb_chunk_keys(self, rows: int) -> np.ndarray:
         """Per-row RNG chain starts for one prompt chunk: one rng split per
