@@ -52,6 +52,10 @@ def test_scanner_sees_the_codebase():
     assert "resilience/update_ok" in keys
     assert "resilience/preemptions" in keys
     assert "resilience/goodput_frac" in keys
+    # elastic-restore keys (docs/RESILIENCE.md "Elastic restore"): the
+    # reshard timing gauge and the elastic-path counter are literal sites
+    assert "resilience/reshard_s" in keys
+    assert "resilience/elastic_restores" in keys
     # generation-engine keys (docs/PERFORMANCE.md): block-pool / prefix-cache
     # gauges from EngineStats.metrics and the serial path's KV-memory gauge
     assert "memory/kv_cache_bytes" in keys

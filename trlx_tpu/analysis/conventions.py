@@ -73,6 +73,11 @@ RESILIENCE_KEYS = frozenset({
     "resilience/publish_retries",
     "resilience/publish_failures",
     "resilience/publish_fallbacks",
+    # elastic topology-change restore (docs/RESILIENCE.md "Elastic
+    # restore"): wall-seconds of the host-side reshard, and how many
+    # restores took the elastic path this run
+    "resilience/reshard_s",
+    "resilience/elastic_restores",
 })
 
 # Canonical generation-engine metric keys (trlx_tpu/engine/,

@@ -225,6 +225,21 @@ class ResilienceConfig:
         fails EVERY call is a deterministic bug, not a transient outage,
         and must not silently train on zero rewards to ``total_steps``.
         0 disables the cap.
+    :param elastic: reshard-on-restore (docs/RESILIENCE.md "Elastic
+        restore"): checkpoints carry a topology manifest, and a restore
+        whose live mesh differs from the saved one (an n=4 checkpoint on an
+        n=2 slice, or a changed process count) loads leaves host-side and
+        re-places them under the live mesh's shardings — values
+        byte-preserved, post-resume trajectory bit-identical to an
+        uninterrupted run on the destination topology. False = strict:
+        a topology mismatch fails with a clear diagnostic instead.
+    :param coordinate_preemption: multihost jobs only — allgather the
+        preemption flag at every step boundary so a SIGTERM on ONE host
+        makes ALL processes commit the same emergency-checkpoint step
+        (process 0 writes the marker). Without it, one host exits while the
+        peers keep stepping and no consistent restorable state exists.
+        Cost: one scalar allgather per update when ``process_count > 1``;
+        no-op single-process.
     :param publish_retries: tracker/hub publish retries; after exhaustion
         the record is *dropped* (logging never kills training).
     :param publish_backoff_s: base backoff for publish retries.
@@ -241,6 +256,8 @@ class ResilienceConfig:
     update_guard: str = "off"
     max_consecutive_nonfinite: int = 25
     keep_last_n: int = 0
+    elastic: bool = True
+    coordinate_preemption: bool = True
     reward_retries: int = 3
     reward_backoff_s: float = 0.5
     reward_backoff_max_s: float = 30.0

@@ -53,6 +53,23 @@ def get_global_mesh() -> Optional[Mesh]:
     return _GLOBAL_MESH
 
 
+def mesh_descriptor(mesh: Mesh) -> dict:
+    """JSON-serializable identity of a mesh — the fields the elastic
+    checkpoint manifest compares to decide whether a restore crosses a
+    topology change (``trlx_tpu/resilience/elastic.py``). Axis names and
+    sizes plus the process/device counts pin the placement; device ids are
+    deliberately excluded (the same topology on different physical chips —
+    a rescheduled pod — must compare equal)."""
+    devices = np.asarray(mesh.devices).ravel()
+    return {
+        "axes": [str(a) for a in mesh.axis_names],
+        "shape": [int(mesh.shape[a]) for a in mesh.axis_names],
+        "device_count": int(devices.size),
+        "process_count": len({d.process_index for d in devices}),
+        "platform": str(getattr(devices[0], "platform", "unknown")),
+    }
+
+
 def mesh_shape_from_config(
     parallel: ParallelConfig, device_count: Optional[int] = None
 ) -> Tuple[int, int, int, int, int, int]:

@@ -318,14 +318,31 @@ def ring_flash_attention(
         window,
     )
     shard = P(None, axis, None, None)
-    f = jax.shard_map(
-        ring,
-        mesh=mesh,
-        in_specs=(shard, shard, shard, P(None, axis), P(None, axis), P(None, axis), P()),
-        out_specs=shard,
-        axis_names={axis},
-        check_vma=False,
-    )
+    in_specs = (shard, shard, shard, P(None, axis), P(None, axis), P(None, axis), P())
+    if hasattr(jax, "shard_map"):
+        f = jax.shard_map(
+            ring,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=shard,
+            axis_names={axis},
+            check_vma=False,
+        )
+    else:
+        # pre-0.5 jax: the public API lives in jax.experimental and spells
+        # partial-manual mode as the complement (`auto` = the axes that
+        # STAY automatic) instead of `axis_names`; `check_rep` is the old
+        # name of `check_vma`
+        from jax.experimental.shard_map import shard_map
+
+        f = shard_map(
+            ring,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=shard,
+            check_rep=False,
+            auto=frozenset(mesh.axis_names) - {axis},
+        )
     out = f(q, k, v, key_mask, qpos, kpos, slopes)
     if zigzag:
         out = jnp.take(out, inverse, axis=1)

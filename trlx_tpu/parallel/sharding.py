@@ -159,11 +159,81 @@ def param_shardings(params: Any, mesh: Mesh) -> Any:
     )
 
 
+def _stage_global(
+    x: Any, sharding: NamedSharding, staged: list, reland: bool = False
+) -> jax.Array:
+    """One leaf of :func:`put_global`: ``device_put`` when fully addressable,
+    else the callback path with the staged (host-provenance) buffer appended
+    to ``staged`` for the caller's :func:`_land_staged` sync+delete.
+
+    ``reland=True`` forces the copy protocol on the fully-addressable branch
+    too: CPU ``device_put`` of a host numpy array can alias the host buffer
+    zero-copy, and a leaf that will be DONATED into a cached executable must
+    be a fresh XLA-owned buffer (the restore heap-corruption hazard —
+    ``utils/checkpoint.py::restore_state``). Plain placement (params built
+    on device, non-donated batches) skips the copy.
+
+    Multihost ``jax.device_put`` of host data onto a non-fully-addressable
+    sharding inserts a cross-process value-equality check implemented as a
+    psum — which the CPU collective backend rejects, and which is redundant
+    here: every caller places host values all processes computed
+    identically (SPMD host code, same seed/config). The callback path
+    assembles each process's addressable shards directly — no collective,
+    identical result, and the single-process behavior stays plain
+    ``device_put``."""
+    import jax.numpy as jnp
+
+    if sharding.is_fully_addressable:
+        out = jax.device_put(x, sharding)
+        if not reland:
+            return out
+        staged.append(out)
+        return jnp.copy(out)
+
+    arr = np.asarray(x)
+    buf = jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+    # callback buffers are host-provenance: donated into an executable
+    # deserialized from the persistent compile cache they corrupt the heap
+    # (the hazard utils/checkpoint.py::restore_state and resilience/
+    # elastic.py re-land against). shard_params output IS donated into the
+    # train step, so re-land here too; the copy is placement-time cost for
+    # params and a minor per-batch cost for multihost shard_batch.
+    staged.append(buf)
+    return jnp.copy(buf)
+
+
+def _land_staged(out: Any, staged: list) -> None:
+    """ONE device sync for a whole placed tree, then free the staged
+    buffers. The copies must have landed before their sources are deleted,
+    but syncing per leaf would serialize transfers the runtime pipelines —
+    a k-leaf batch pays one barrier, not k (non-array leaves in ``out`` are
+    ignored by ``jax.block_until_ready``)."""
+    if staged:
+        jax.block_until_ready(out)
+        for buf in staged:
+            buf.delete()
+
+
+def put_global(x: Any, sharding: NamedSharding, reland: bool = False) -> jax.Array:
+    """``device_put`` that also works when ``sharding`` spans processes
+    (see :func:`_stage_global`; ``reland`` for leaves headed into donating
+    executables). Single-leaf entry — tree placement goes through
+    :func:`shard_params`/:func:`shard_batch`, which batch the device sync
+    across leaves."""
+    staged: list = []
+    out = _stage_global(x, sharding, staged, reland=reland)
+    _land_staged(out, staged)
+    return out
+
+
 def shard_params(params: Any, mesh: Mesh) -> Any:
     """Place a parameter pytree onto the mesh per the rule table."""
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, s), params, param_shardings(params, mesh)
+    staged: list = []
+    out = jax.tree_util.tree_map(
+        lambda x, s: _stage_global(x, s, staged), params, param_shardings(params, mesh)
     )
+    _land_staged(out, staged)
+    return out
 
 
 # Params at or above this size (bytes, assuming 4 B/element — specs see only
@@ -234,6 +304,21 @@ def fit_spec(mesh: Mesh, shape: Tuple[int, ...], spec: Tuple[Any, ...]) -> P:
     return P(*out)
 
 
+def spec_to_jsonable(spec: P) -> list:
+    """A PartitionSpec as JSON-safe nested lists (``None`` | axis name |
+    list of names per dim) — the checkpoint topology manifest's per-leaf
+    spec record (``trlx_tpu/resilience/elastic.py``)."""
+    out = []
+    for axis in tuple(spec):
+        if axis is None:
+            out.append(None)
+        elif isinstance(axis, tuple):
+            out.append([str(a) for a in axis])
+        else:
+            out.append(str(axis))
+    return out
+
+
 def spec_shards(mesh: Mesh, spec: P) -> int:
     """Total ways ``spec`` splits an array on ``mesh`` (1 = pure no-op)."""
     total = 1
@@ -273,6 +358,8 @@ def shard_batch(batch: Any, mesh: Mesh, sequence_sharded: bool = False) -> Any:
     Non-array leaves (strings etc.) pass through untouched.
     """
 
+    staged: list = []
+
     def put(x):
         if not hasattr(x, "ndim") or x.ndim == 0:
             return x
@@ -281,6 +368,8 @@ def shard_batch(batch: Any, mesh: Mesh, sequence_sharded: bool = False) -> Any:
             spec = P()
         else:
             spec = batch_spec(x.ndim, sequence_sharded)
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return _stage_global(x, NamedSharding(mesh, spec), staged)
 
-    return jax.tree_util.tree_map(put, batch)
+    out = jax.tree_util.tree_map(put, batch)
+    _land_staged(out, staged)
+    return out

@@ -25,6 +25,14 @@ both trust only *committed* checkpoints. Knobs: ``config.resilience``
 
 from typing import Any, Callable, Optional
 
+from trlx_tpu.resilience.elastic import (
+    ElasticRestoreError,
+    build_manifest,
+    coordinate_preemption,
+    manifest_mismatch,
+    read_manifest,
+    restore_state_elastic,
+)
 from trlx_tpu.resilience.faults import (
     FaultPlan,
     InjectedFault,
@@ -45,6 +53,7 @@ from trlx_tpu.resilience.retry import (
 )
 
 __all__ = [
+    "ElasticRestoreError",
     "FaultPlan",
     "HostCallGuard",
     "InjectedFault",
@@ -55,9 +64,14 @@ __all__ = [
     "TrainingPreempted",
     "UPDATE_OK_KEY",
     "UpdateGuard",
+    "build_manifest",
+    "coordinate_preemption",
     "get_active_plan",
+    "manifest_mismatch",
     "neutral_rewards",
     "poll_fault",
+    "read_manifest",
+    "restore_state_elastic",
     "set_active_plan",
 ]
 

@@ -13,20 +13,26 @@ Plan syntax (``;``-separated entries, whitespace ignored)::
     kind@trigger:N[*count]
 
     kind     one of: reward_raise | publish_raise | sigterm | sigint |
-             nan_loss | crash_save
+             sigterm_one_proc | nan_loss | crash_save | topology_shrink
     trigger  call  — the Nth invocation of the consulting site (1-based;
                      for reward_raise/publish_raise every *attempt* counts,
                      so retries advance the counter)
              step  — fires when the trainer's completed-update count == N
              save  — the Nth ``save_state`` call (1-based)
+             resume — the Nth checkpoint restore (1-based)
     count    consecutive firings (default 1)
 
 Examples::
 
     reward_raise@call:3*2        # reward_fn attempts 3 and 4 raise
     sigterm@step:5               # SIGTERM delivered before update 6 starts
+    sigterm_one_proc@step:5      # same, but ONLY process 0 is signaled —
+                                 # the coordinated-preemption allgather must
+                                 # propagate it to the peers
     nan_loss@step:7              # the loss of update 8 is poisoned to NaN
     crash_save@save:2            # the 2nd save_state dies before committing
+    topology_shrink@resume:1     # the 1st restore takes the elastic reshard
+                                 # path even on a matching mesh
 
 Plans come from ``config.resilience.fault_plan`` or the
 ``TRLX_TPU_FAULT_PLAN`` env var (env wins — a relaunched run can drop the
@@ -41,10 +47,11 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-_KINDS = frozenset(
-    {"reward_raise", "publish_raise", "sigterm", "sigint", "nan_loss", "crash_save"}
-)
-_TRIGGERS = frozenset({"call", "step", "save"})
+_KINDS = frozenset({
+    "reward_raise", "publish_raise", "sigterm", "sigint", "sigterm_one_proc",
+    "nan_loss", "crash_save", "topology_shrink",
+})
+_TRIGGERS = frozenset({"call", "step", "save", "resume"})
 
 
 class InjectedFault(RuntimeError):
@@ -127,16 +134,16 @@ class FaultPlan:
         """Should the consulting site fault now?
 
         With ``step=None`` this is an *invocation* poll: the per-kind call
-        counter advances by one and call/save-triggered entries match
-        against it. With ``step=s`` only step-triggered entries are checked
-        (idempotent — the trainer polls once per update)."""
+        counter advances by one and call/save/resume-triggered entries
+        match against it. With ``step=s`` only step-triggered entries are
+        checked (idempotent — the trainer polls once per update)."""
         if not self.specs:
             return False
         with self._lock:
             if step is None:
                 value = self._counters.get(kind, 0) + 1
                 self._counters[kind] = value
-                triggers = ("call", "save")
+                triggers = ("call", "save", "resume")
             else:
                 value = step
                 triggers = ("step",)

@@ -55,7 +55,6 @@ from trlx_tpu.utils.checkpoint import (
     newest_committed_checkpoint,
     prune_checkpoints,
     read_extra,
-    restore_state,
     save_pretrained,
     save_state,
     wait_for_saves,
@@ -251,14 +250,16 @@ class TPUBaseTrainer(BaseRLTrainer):
             opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
         from jax.sharding import NamedSharding, PartitionSpec
 
+        from trlx_tpu.parallel.sharding import put_global
+
         replicated = NamedSharding(self.mesh, PartitionSpec())
         rng = jax.random.PRNGKey(config.train.seed)
         rollout_rng, state_rng = jax.random.split(rng)
         self.state = TrainState(
             params=params,
             opt_state=opt_state,
-            step=jax.device_put(jnp.zeros((), jnp.int32), replicated),
-            rng=jax.device_put(state_rng, replicated),
+            step=put_global(jnp.zeros((), jnp.int32), replicated),
+            rng=put_global(state_rng, replicated),
         )
         self._rollout_rng = rollout_rng
 
@@ -295,6 +296,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         self.nth_evaluation = 0
         self.best_reward = -float("inf")
         self._emergency_resume = False
+        self._prompt_chunks_drawn = 0
 
     # ------------------------------------------------------------------
     # subclass contract
@@ -561,6 +563,18 @@ class TPUBaseTrainer(BaseRLTrainer):
         preserves batch order, so rollout determinism is unaffected."""
         depth = int(getattr(self.config.train, "rollout_pipeline_depth", 0) or 0)
         return self._maybe_prefetch(loader, depth)
+
+    def _count_prompt_chunks(self, iterator):
+        """Wrap the (infinite) prompt iterator so every chunk the trainer
+        consumes advances ``_prompt_chunks_drawn``. Emergency checkpoints
+        record the count and resume replays exactly that many draws
+        (:meth:`load`), so the prompt stream — and the loader's per-epoch
+        shuffle RNG behind it — sits precisely where an uninterrupted run
+        would have it. Without this, the first post-resume collection trains
+        on the *initial* prompts again and the trajectory silently forks."""
+        for chunk in iterator:
+            self._prompt_chunks_drawn += 1
+            yield chunk
 
     def _batch_token_count(self, batch: Any) -> int:
         """Real (unpadded) tokens this batch feeds the step — from the batch
@@ -1216,8 +1230,9 @@ class TPUBaseTrainer(BaseRLTrainer):
 
     def _check_faults_and_preemption(self) -> None:
         """Step-boundary seam, called before every update: deliver any
-        fault-plan signals for this step, then honor a pending preemption
-        request with a committed emergency checkpoint."""
+        fault-plan signals for this step, coordinate the preemption flag
+        across processes, then honor an agreed request with one committed
+        emergency checkpoint."""
         import signal as _signal
 
         plan = self.resilience.plan
@@ -1228,9 +1243,29 @@ class TPUBaseTrainer(BaseRLTrainer):
                 _signal.raise_signal(_signal.SIGTERM)
             if plan.poll("sigint", step=self.iter_count):
                 _signal.raise_signal(_signal.SIGINT)
+            # the multihost fault: every process polls (lockstep counters),
+            # only process 0 is actually signaled — the coordination
+            # allgather below must carry the request to the peers
+            if (
+                plan.poll("sigterm_one_proc", step=self.iter_count)
+                and jax.process_index() == 0
+            ):
+                _signal.raise_signal(_signal.SIGTERM)
         preemption = self.resilience.preemption
-        if not preemption.requested:
+        requested = preemption.requested
+        if self.resilience.config.coordinate_preemption:
+            # multihost: ALL processes must agree on the checkpoint step —
+            # a SIGTERM lands on one host while the others keep stepping.
+            # The allgather runs every boundary (SPMD lockstep), so the
+            # first boundary after any signal is the step everyone picks.
+            from trlx_tpu.resilience.elastic import coordinate_preemption
+
+            requested = coordinate_preemption(requested)
+        if not requested:
             return
+        if not preemption.requested:
+            # this process was not signaled itself; a peer was
+            preemption.request("peer preemption (coordinated)")
         subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
         path = os.path.join(self.config.train.checkpoint_dir, subfolder)
         logger.warning(
@@ -1516,12 +1551,19 @@ class TPUBaseTrainer(BaseRLTrainer):
         directory = directory or self.config.train.checkpoint_dir
         extra = {"iter_count": self.iter_count, "best_reward": self.best_reward}
         extra.update(self._extra_checkpoint_state())
+        # every checkpoint records the prompt-stream position (one int):
+        # interval-checkpoint resumes need the same replay as emergency
+        # ones, or the fresh iterator re-draws the epoch's first prompts
+        extra["prompt_chunks_drawn"] = self._prompt_chunks_drawn
         if emergency:
             extra["emergency"] = True
             extra["rollout_rng"] = self._rng_to_list(self._rollout_rng)
             extra["nth_evaluation"] = self.nth_evaluation
-            os.makedirs(directory, exist_ok=True)
-            self._save_emergency_payload(directory)
+            if jax.process_index() == 0:
+                # host-side payload files have one author; peers read them
+                # back from the shared checkpoint dir on resume
+                os.makedirs(directory, exist_ok=True)
+                self._save_emergency_payload(directory)
         save_state(directory, self.state, extra=extra)
 
     def load(
@@ -1531,7 +1573,19 @@ class TPUBaseTrainer(BaseRLTrainer):
         **kwargs,
     ) -> None:
         directory = directory or self.config.train.checkpoint_dir
-        self.state = restore_state(directory, self.state)
+        # the one restore seam (docs/RESILIENCE.md "Elastic restore"): a
+        # matching topology takes the sharded Orbax fast path unchanged; a
+        # checkpoint saved on a DIFFERENT mesh (device or process count)
+        # reshards host-side onto the live mesh — resilience.elastic gates
+        # it, resilience/reshard_s gauges it
+        from trlx_tpu.resilience.elastic import restore_state_elastic
+
+        self.state = restore_state_elastic(
+            directory,
+            self.state,
+            elastic=self.resilience.config.elastic,
+            metrics=self.obs.metrics,
+        )
         extra = read_extra(directory)
         self.iter_count = int(extra.get("iter_count", 0))
         if "best_reward" in extra:
@@ -1549,6 +1603,24 @@ class TPUBaseTrainer(BaseRLTrainer):
                 extra.get("nth_evaluation", self.nth_evaluation)
             )
             self._restore_emergency_payload(directory)
+        if restore_payload:
+            # replay the prompt-stream position: the uninterrupted run has
+            # consumed `prompt_chunks_drawn` chunks by this boundary; draw
+            # and discard until this run's (fresh) iterator catches up, so
+            # the NEXT collection trains on the same prompts in the same
+            # shuffle order. Host-only work (collation), no device cost.
+            # Applies to interval checkpoints too (any save records the
+            # position); rollback passes restore_payload=False — its
+            # iterator is live mid-run and must not be advanced.
+            target = int(extra.get("prompt_chunks_drawn", 0))
+            iterator = getattr(self, "prompt_iterator", None)
+            if iterator is not None and target > self._prompt_chunks_drawn:
+                logger.info(
+                    f"resume: fast-forwarding the prompt stream "
+                    f"by {target - self._prompt_chunks_drawn} chunks"
+                )
+                while self._prompt_chunks_drawn < target:
+                    next(iterator)
 
     def _rollback_to_committed(self) -> None:
         """Update-guard rollback: restore the newest committed checkpoint's

@@ -75,8 +75,11 @@ class GRPOTrainer(PPOTrainer):
             seed=self.config.train.seed,
         )
         # same prompt-prefetch seam as PPO (GRPO's make_experience is still
-        # serial — prefetch only overlaps collation, not reward scoring)
-        self.prompt_iterator = infinite_loader(self._maybe_prefetch_prompts(loader))
+        # serial — prefetch only overlaps collation, not reward scoring);
+        # the chunk counter lets an emergency resume replay the stream
+        self.prompt_iterator = self._count_prompt_chunks(
+            infinite_loader(self._maybe_prefetch_prompts(loader))
+        )
 
     # scoring reuses PPOTrainer._get_score_fn, which adapts to the head-less
     # policy (no value output, branch params bound at the tree root)

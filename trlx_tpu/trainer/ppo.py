@@ -127,8 +127,11 @@ class PPOTrainer(TPUBaseTrainer):
             self.config.method.chunk_size, shuffle=True, seed=self.config.train.seed
         )
         # prompt collation prefetches on a background thread when the rollout
-        # pipeline is on, so chunk dispatch never stalls on next(...)
-        self.prompt_iterator = infinite_loader(self._maybe_prefetch_prompts(loader))
+        # pipeline is on, so chunk dispatch never stalls on next(...); the
+        # chunk counter lets an emergency resume replay the stream position
+        self.prompt_iterator = self._count_prompt_chunks(
+            infinite_loader(self._maybe_prefetch_prompts(loader))
+        )
 
     def _extra_checkpoint_state(self) -> Dict[str, Any]:
         return {

@@ -73,6 +73,27 @@ def initialize_runtime() -> None:
     if os.environ.get("TRLX_TPU_MULTIHOST") or coordinator:
         import jax
 
+        requested = (platform or os.environ.get("JAX_PLATFORMS", "")).lower()
+        if not requested or requested.startswith("cpu"):
+            # CPU multiprocess collectives live behind an explicit backend
+            # selection since jax 0.4.x ("Multiprocess computations aren't
+            # implemented on the CPU backend" otherwise): gloo carries the
+            # cross-process allgathers/psums the multihost harness (and the
+            # coordinated-preemption flag exchange) relies on. Must be set
+            # before the backend initializes. The empty case covers jax's
+            # automatic CPU fallback (no accelerator, nothing requested) —
+            # the first step-boundary preemption allgather would otherwise
+            # die; when another platform wins auto-detection the setting
+            # only configures the unused CPU client, so it is harmless.
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception as e:  # pragma: no cover - jax version drift
+                from trlx_tpu.utils import logging
+
+                logging.get_logger(__name__).warning(
+                    f"could not enable gloo CPU collectives ({e}); "
+                    "cross-process collectives may be unavailable"
+                )
         kwargs = {}
         if coordinator:
             kwargs = dict(

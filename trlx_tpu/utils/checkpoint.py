@@ -36,6 +36,25 @@ COMMIT_MARKER = "COMMITTED"
 _PENDING_COMMIT: Optional[Callable[[], None]] = None
 
 
+def _is_primary() -> bool:
+    """Multihost: exactly one process owns the host-side checkpoint files
+    (extra JSON, topology manifest, commit marker, swap renames). The Orbax
+    tree write itself is collective — every process writes its own shards —
+    but the commit protocol must have a single author or the renames race."""
+    return jax.process_index() == 0
+
+
+def _commit_barrier(name: str) -> None:
+    """Line up every process at a commit-protocol edge. No-op single
+    process. All ``wait_for_saves`` call sites run in SPMD lockstep (save/
+    restore/prune/end-of-learn), so the matching calls always pair up."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(f"trlx_tpu_ckpt_{name}")
+
+
 def _async_checkpointer():
     global _ASYNC_CKPTR
     if _ASYNC_CKPTR is None:
@@ -66,7 +85,11 @@ def _recover_interrupted_swap(directory: str) -> None:
     tree_dir = os.path.join(os.path.abspath(directory), "state")
     old_dir = tree_dir + ".old"
     if os.path.isdir(old_dir) and not os.path.isdir(tree_dir):
-        os.rename(old_dir, tree_dir)
+        try:
+            os.rename(old_dir, tree_dir)
+        except OSError:  # a peer process healed it first (multihost restore)
+            if not os.path.isdir(tree_dir):
+                raise
 
 
 def is_committed(directory: str) -> bool:
@@ -157,44 +180,82 @@ def save_state(
     staging_dir = tree_dir + ".staging"
     # join + commit any in-flight save before touching shared paths
     wait_for_saves()
-    os.makedirs(directory, exist_ok=True)
-    _recover_interrupted_swap(directory)
-    if os.path.exists(staging_dir):  # leftover from a crashed save: garbage
-        shutil.rmtree(staging_dir)
-    # extra JSON stages alongside the tree: a crash pre-commit must not mix
-    # a new iter_count with the old params
+    primary = _is_primary()
+    if primary:
+        os.makedirs(directory, exist_ok=True)
+        _recover_interrupted_swap(directory)
+        if os.path.exists(staging_dir):  # leftover from a crashed save: garbage
+            shutil.rmtree(staging_dir)
+    # non-primary processes must not start writing shards into a staging
+    # dir the primary is still clearing
+    _commit_barrier("pre_stage")
+    # extra JSON and the topology manifest stage alongside the tree: a
+    # crash pre-commit must not mix a new iter_count (or a new mesh shape)
+    # with the old params. Host-side files have a single author (primary).
     extra_path = os.path.join(directory, "trainer_state.json")
-    if extra is not None:
+    if extra is not None and primary:
         with open(extra_path + ".staging", "w") as f:
             json.dump(extra, f)
+    from trlx_tpu.resilience.elastic import MANIFEST_NAME, build_manifest
+
+    # the manifest is authored (and consumed at commit) only by the primary;
+    # peers skip the per-leaf tree walk
+    manifest = build_manifest(state) if primary else None
+    manifest_path = os.path.join(directory, MANIFEST_NAME)
+    if manifest is not None and primary:
+        with open(manifest_path + ".staging", "w") as f:
+            json.dump(manifest, f)
 
     def commit() -> None:
         from trlx_tpu.resilience.faults import InjectedFault, poll_fault
 
+        # every process polls (identical plans keep counters in lockstep),
+        # and every process raises — BEFORE the barrier, so an injected
+        # crash can't strand a peer waiting on a dead primary
         if poll_fault("crash_save"):
             raise InjectedFault(
                 f"fault plan: crash before checkpoint commit ({directory})"
             )
-        # Swap order keeps SOME complete tree recoverable at every instant:
-        # the marker is never deleted (it vouches for whichever complete
-        # tree is present), the old tree moves aside intact, and a crash
-        # between the renames is healed by _recover_interrupted_swap (old
-        # tree moved back) on the next save/restore of this directory.
-        marker = os.path.join(directory, COMMIT_MARKER)
-        old_dir = tree_dir + ".old"
-        if os.path.exists(old_dir):
-            shutil.rmtree(old_dir)
-        if os.path.exists(tree_dir):
-            os.rename(tree_dir, old_dir)
-        else:
-            old_dir = None
-        os.rename(staging_dir, tree_dir)
-        if extra is not None:
-            os.replace(extra_path + ".staging", extra_path)
-        with open(marker, "w") as f:
-            json.dump({"time": time.time()}, f)
-        if old_dir is not None:
-            shutil.rmtree(old_dir)
+        _commit_barrier("pre_commit")  # all shards landed before any rename
+        try:
+            if primary:
+                # Swap order keeps SOME complete tree recoverable at every
+                # instant: the marker is never deleted (it vouches for
+                # whichever complete tree is present), the old tree moves
+                # aside intact, and a crash between the renames is healed by
+                # _recover_interrupted_swap (old tree moved back) on the next
+                # save/restore of this directory.
+                marker = os.path.join(directory, COMMIT_MARKER)
+                old_dir = tree_dir + ".old"
+                if os.path.exists(old_dir):
+                    shutil.rmtree(old_dir)
+                if os.path.exists(tree_dir):
+                    os.rename(tree_dir, old_dir)
+                else:
+                    old_dir = None
+                os.rename(staging_dir, tree_dir)
+                if extra is not None:
+                    os.replace(extra_path + ".staging", extra_path)
+                if manifest is not None:
+                    os.replace(manifest_path + ".staging", manifest_path)
+                elif os.path.exists(manifest_path):
+                    # a manifest-less save over a manifested checkpoint: a
+                    # stale topology record would mislead the next elastic
+                    # restore
+                    os.remove(manifest_path)
+                with open(marker, "w") as f:
+                    json.dump({"time": time.time()}, f)
+                if old_dir is not None:
+                    shutil.rmtree(old_dir)
+        finally:
+            # peers must not read (or exit) until the marker is down — and
+            # the barrier must be reached even when the primary's commit IO
+            # raises (disk full on a rename, marker write failure): peers
+            # are already blocked in the timeout-less post_commit collective,
+            # so a pre-barrier raise would hang the whole slice instead of
+            # failing the job with the real error (the peers then die with
+            # the primary via the coordination service)
+            _commit_barrier("post_commit")
 
     if async_save:
         global _PENDING_COMMIT
