@@ -270,11 +270,19 @@ def _make_cycle(trainer, config, chunk):
         stats = None
         for batch in loader:
             for _ in range(config.method.ppo_epochs):
+                t_step = time.perf_counter()
                 stats = trainer.train_step(batch)
                 # the learn loop owns this counter normally; step-triggered
                 # fault-plan entries (BENCH_FAULTS) key off it, so a cycle
                 # must advance it too or step:N faults re-fire forever
                 trainer.iter_count += 1
+                # cluster-telemetry beat (docs/OBSERVABILITY.md "Distributed
+                # telemetry"): the learn loop drives this at its step
+                # boundaries; the bench cycle mirrors it so the headline
+                # carries cluster/step_skew_s (0.0 single-process —
+                # max-min over one rank — nonzero on a real pod)
+                trainer.obs.cluster.note_step(time.perf_counter() - t_step)
+                trainer.obs.cluster.beat(False, step=trainer.iter_count)
         jax.block_until_ready(trainer.state.params)
         return stats
 
@@ -473,6 +481,48 @@ def _elastic_probe(trainer):
     return result
 
 
+def _flightrec_probe(trainer):
+    """Untimed flight-recorder probe (docs/OBSERVABILITY.md "Flight
+    recorder"): dump the forensic ring the warmup cycle populated, reload
+    the JSON, and verify it actually carries span and metric records —
+    proving the black box this build would leave behind on a crash is
+    readable and non-empty. Returns "ok" / "degraded..." for the headline's
+    ``flight_recorder`` field; never raises (evidence, not a gate)."""
+    import shutil
+    import tempfile
+
+    t0 = time.time()
+    tmp = tempfile.mkdtemp(prefix="trlx_tpu_bench_flightrec_")
+    kinds = []
+    try:
+        path = trainer.obs.dump_flight_record(reason="bench probe", directory=tmp)
+        ok = False
+        if path:
+            with open(path) as f:
+                doc = json.load(f)
+            records = doc.get("records", [])
+            kinds = sorted({r.get("kind") for r in records})
+            ok = bool(records) and "span" in kinds and "metric" in kinds
+        result = "ok" if ok else "degraded"
+    except Exception as e:  # evidence, never a blocker
+        result = f"degraded: {e}"
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(
+        json.dumps(
+            {
+                "flightrec_proof": {
+                    "recovery": result,
+                    "record_kinds": kinds,
+                    "probe_s": round(time.time() - t0, 2),
+                }
+            }
+        ),
+        file=sys.stderr,
+    )
+    return result
+
+
 _T0 = time.time()
 
 
@@ -582,6 +632,7 @@ def main():
             file=sys.stderr,
         )
     elastic_recovery = _elastic_probe(trainer) if bench_faults else None
+    flight_recorder = _flightrec_probe(trainer) if bench_faults else None
     n_cycles = int(os.environ.get("BENCH_CYCLES", 1 if on_cpu else 3))
     t0 = time.time()
     for _ in range(n_cycles):
@@ -752,6 +803,17 @@ def main():
     # halved mesh (or, single-device, through the forced reshard path)
     # byte-identically; null when BENCH_FAULTS=0
     line["elastic_recovery"] = elastic_recovery
+    # flight-recorder proof (docs/OBSERVABILITY.md "Flight recorder"): "ok"
+    # when the untimed dump+reload probe found span AND metric records in
+    # the ring the warmup populated; null when BENCH_FAULTS=0
+    line["flight_recorder"] = flight_recorder
+    # cross-rank step skew (docs/OBSERVABILITY.md "Distributed telemetry"):
+    # max−min per-rank step time at the last cluster beat — 0.0 on a
+    # single process, the straggler signal on a pod
+    skew = trainer.obs.metrics.snapshot(reset_histograms=False).get(
+        "cluster/step_skew_s"
+    )
+    line["step_skew_s"] = round(float(skew), 4) if skew is not None else None
     if note:
         line["note"] = note
     # the headline contract is emitted BEFORE the optional xl stage: an

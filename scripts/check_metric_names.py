@@ -19,8 +19,11 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 from trlx_tpu.analysis.conventions import (  # noqa: E402,F401
+    CLUSTER_KEYS,
     ENGINE_KEYS,
+    FLIGHTREC_KEYS,
     LEGACY_KEYS,
+    OBS_KEYS,
     RESILIENCE_KEYS,
     _CONVENTION_RE,
     _KEY_RE,

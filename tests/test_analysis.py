@@ -448,6 +448,49 @@ def test_metric_names_pass(tmp_path):
     ]
 
 
+def test_span_names_pass(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "mod.py": """
+            def f(self, tracer, name):
+                with self.obs.span("bad span name"):
+                    pass
+                with self.obs.span("rollout"):        # frozen legacy allowlist
+                    pass
+                with tracer.span("engine/queue_wait"):  # namespaced: ok
+                    pass
+                with self._span(
+                    "also_bad", live=3                # multi-line call: caught
+                ):
+                    pass
+                tracer.instant("bad_instant")
+                tracer.add_complete_event("engine/prefill", 0.0, 1.0)
+                with tracer.span(name):               # dynamic: out of scope
+                    pass
+                with tracer.span(f"{{name}}/x"):        # f-string: out of scope
+                    pass
+            """
+        },
+        passes=["span-names"],
+    )
+    assert [(f.code, f.detail) for f in findings] == [
+        ("GL502", "bad span name"),
+        ("GL502", "also_bad"),
+        ("GL502", "bad_instant"),
+    ]
+
+
+def test_span_names_legacy_allowlist_is_exact():
+    from trlx_tpu.analysis.conventions import LEGACY_SPAN_NAMES
+
+    # frozen: the five pre-convention trainer spans, nothing else. Adding
+    # here instead of namespacing a new span is a review error.
+    assert LEGACY_SPAN_NAMES == {
+        "rollout", "generate", "score", "reward", "train_step",
+    }
+
+
 _CONFIG_FILES = {
     "configs.py": """
     from dataclasses import dataclass
@@ -742,7 +785,7 @@ def test_pass_registry_and_codes():
     passes = all_passes()
     assert set(passes) == {
         "host-sync", "recompile-hazard", "donation-safety",
-        "lock-discipline", "metric-names", "config-keys",
+        "lock-discipline", "metric-names", "span-names", "config-keys",
     }
     seen = set()
     for cls in passes.values():

@@ -252,6 +252,7 @@ class TestEngineStateMachine:
             "rollout/refill_prefills",
             "rollout/refilled_rows",
             "rollout/segments",
+            "engine/queue_wait_s",
             # the dense engine now reports its KV allocation too
             # (docs/PERFORMANCE.md; engine/* gauges are paged-only)
             "memory/kv_cache_bytes",

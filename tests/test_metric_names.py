@@ -61,6 +61,15 @@ def test_scanner_sees_the_codebase():
     assert "memory/kv_cache_bytes" in keys
     assert "engine/kv_blocks_in_use" in keys
     assert "engine/prefix_hit_rate" in keys
+    assert "engine/queue_wait_s" in keys
+    # distributed-telemetry keys (docs/OBSERVABILITY.md "Distributed
+    # telemetry"): the cluster beat's literal set_gauge sites
+    assert "cluster/step_skew_s" in keys
+    assert "cluster/straggler_rank" in keys
+    assert "cluster/step_time_max_s" in keys
+    # flight-recorder + observability self-accounting keys
+    assert "flightrec/dumps" in keys
+    assert "obs/spans_dropped" in keys
 
 
 def test_engine_keys_registered_and_namespaced():
@@ -88,6 +97,23 @@ def test_resilience_keys_registered_and_namespaced():
     keys = checker.scanned_keys()
     visible = {k for k in checker.RESILIENCE_KEYS if k in keys}
     assert {"resilience/update_ok", "resilience/preemptions"} <= visible
+
+
+def test_cluster_flightrec_obs_keys_registered_and_namespaced():
+    """Every canonical cluster/* + flightrec/* + obs/* key
+    (docs/OBSERVABILITY.md) is registered in the checker, follows the
+    convention, and the literal sites are visible to the scanner."""
+    checker = _load_checker()
+    keys = checker.scanned_keys()
+    for registry_name in ("CLUSTER_KEYS", "FLIGHTREC_KEYS", "OBS_KEYS"):
+        registry = getattr(checker, registry_name)
+        assert registry, f"{registry_name} is empty"
+        for key in registry:
+            assert checker._CONVENTION_RE.match(key), key
+        missing = {k for k in registry if k not in keys}
+        assert missing == set(), (
+            f"{registry_name} entries not seen by the scanner: {missing}"
+        )
 
 
 def test_lint_catches_a_bad_key(tmp_path):

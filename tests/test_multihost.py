@@ -492,6 +492,105 @@ def test_elastic_grow_resume_loss_parity(tmp_path, elastic_reference):
         )
 
 
+# Cluster-observability worker (docs/OBSERVABILITY.md "Distributed
+# telemetry"): a 2-process PPO run whose LAST rank is made a deterministic
+# straggler (sleep_one_proc fault stalls its train step). The cluster beat
+# rides the coordinated-preemption allgather at every boundary, so both
+# processes see the same straggler verdict and skew; rank 0 merges both
+# ranks' span streams into one Perfetto trace at exit.
+CLUSTER_OBS_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import trlx_tpu.trlx as trlx
+    trlx.initialize_runtime()
+    import jax
+    import numpy as np
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    cfg = default_ppo_config().evolve(
+        train=dict(seq_length=40, batch_size=3, total_steps=5, epochs=3,
+                   eval_interval=100, checkpoint_interval=100,
+                   tracker="jsonl", logging_dir={log_dir!r},
+                   checkpoint_dir={ckpt_dir!r}),
+        model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+        parallel=dict(data=-1),
+        method=dict(num_rollouts=6, chunk_size=3, ppo_epochs=1,
+                    gen_kwargs=dict(max_new_tokens=6, top_k=0, top_p=1.0,
+                                    do_sample=True)),
+        resilience=dict(fault_plan="sleep_one_proc@step:1*3"),
+    )
+    prompts = ["hello world", "the quick brown fox", "lorem ipsum"] * 2
+
+    def reward_fn(samples=None, prompts=None, outputs=None, **kw):
+        return [float(sum(c in "aeiou" for c in o)) for o in outputs]
+
+    t = trlx.train(reward_fn=reward_fn, prompts=prompts, config=cfg)
+    snap = t.obs.metrics.snapshot(reset_histograms=False)
+    print("CLU", jax.process_index(),
+          int(snap.get("cluster/straggler_rank", -2)),
+          float(snap.get("cluster/step_skew_s", -1.0)),
+          int(snap.get("cluster/size", 0)), flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_cluster_straggler_and_merged_trace(tmp_path):
+    """Distributed-observability acceptance: an injected per-rank sleep
+    fault surfaces ``cluster/straggler_rank`` (the last rank) with a
+    matching step-time skew on BOTH processes, and process 0 exports ONE
+    merged Perfetto trace containing both ranks' spans on an aligned
+    clock."""
+    import json as _json
+
+    log_dir = str(tmp_path / "logs")
+    outs = _run_two_process(
+        CLUSTER_OBS_WORKER,
+        extra_env={**_CLUSTER_ENV, "TRLX_TPU_FAULT_SLEEP_S": "2.0"},
+        timeout=540,
+        marker="CLU",
+        fmt={"log_dir": log_dir, "ckpt_dir": str(tmp_path / "ckpt")},
+    )
+    for pid, out in enumerate(outs):
+        line = next(l for l in out.splitlines() if l.startswith(f"CLU {pid}"))
+        _, _, straggler, skew, size = line.split()
+        # the beat's gathered matrix is identical on every rank: both
+        # processes agree the LAST rank (1) is the straggler
+        assert int(straggler) == 1, line
+        assert float(skew) > 1.0, line  # 2s injected sleep dominates
+        assert int(size) == 2, line
+
+    # ONE merged trace with both ranks' spans (rank files written by each
+    # process's own export, merged by process 0 with clock offsets)
+    with open(os.path.join(log_dir, "trace.json")) as f:
+        trace = _json.load(f)
+    events = trace["traceEvents"]
+    span_pids = {e["pid"] for e in events if e.get("ph") == "X"}
+    assert span_pids == {0, 1}, span_pids
+    for pid in (0, 1):
+        names = {
+            e["name"] for e in events if e.get("ph") == "X" and e["pid"] == pid
+        }
+        assert "train_step" in names, (pid, sorted(names)[:20])
+    labels = {
+        e["pid"]: e["args"]["name"]
+        for e in events
+        if e.get("name") == "process_name"
+    }
+    assert labels == {0: "rank 0", 1: "rank 1"}
+    # clock alignment was estimated from the shared beats
+    assert trace.get("clock_offsets_s", {}).get("1") is not None
+    # the straggler's train_step spans are visibly longer than rank 0's
+    def _max_dur(pid, name):
+        return max(
+            (e["dur"] for e in events
+             if e.get("ph") == "X" and e["pid"] == pid and e["name"] == name),
+            default=0.0,
+        )
+    assert _max_dur(1, "train_step") > _max_dur(0, "train_step") + 1.0e6
+
+
 @pytest.mark.slow
 def test_two_process_pipeline_train_step(tmp_path):
     """Pipeline parallelism ACROSS process boundaries: a 2-process cluster

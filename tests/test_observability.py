@@ -5,12 +5,17 @@
 - watchdogs: recompile detection on a shape-changing second call, memory
   gauge CPU fallback;
 - profiling: ``TRLX_TPU_PROFILE`` spec parsing and window no-ops;
+- distributed telemetry: cluster beats over an injected allgather —
+  straggler flagging, desync diagnostics, clock offsets, merged traces;
+- flight recorder: ring semantics, span/metric taps, dump/reload, and the
+  end-to-end NaN-halt dump;
 - end-to-end: a tiny PPO smoke run emits the canonical throughput/time keys
   per step and writes a loadable ``trace.json`` with nested
   rollout→generate spans.
 """
 
 import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +24,9 @@ import pytest
 
 from trlx_tpu.observability import (
     DEFAULT_PEAK_FLOPS,
+    ClusterDesyncError,
+    ClusterTelemetry,
+    FlightRecorder,
     MetricsRegistry,
     Observability,
     ProfileWindow,
@@ -286,6 +294,262 @@ class TestProfileWindow:
 
 
 # ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_counts_all_records(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record("step", {"iter": i})
+        snap = rec.snapshot()
+        assert len(snap) == 4
+        assert [r["data"]["iter"] for r in snap] == [6, 7, 8, 9]
+        assert rec.recorded == 10
+
+    def test_span_tap_outlives_the_tracer_cap(self):
+        """The recorder ring must keep rotating after the tracer's bounded
+        buffer starts dropping — that tail is exactly the crash window."""
+        tracer = Tracer(max_events=3)
+        rec = FlightRecorder(capacity=5)
+        tracer.add_listener(rec.span_listener)
+        for i in range(10):
+            with tracer.span(f"obs/s{i}"):
+                pass
+        assert len(tracer.events()) == 3 and tracer.dropped == 7
+        names = [r["data"]["name"] for r in rec.snapshot()]
+        assert names == ["obs/s5", "obs/s6", "obs/s7", "obs/s8", "obs/s9"]
+
+    def test_metric_tap_records_writes(self):
+        reg = MetricsRegistry()
+        rec = FlightRecorder()
+        reg.add_listener(rec.metric_listener)
+        reg.inc("resilience/nonfinite_updates")
+        reg.set_gauge("cluster/step_skew_s", 0.25)
+        kinds = [(r["data"]["op"], r["data"]["name"]) for r in rec.snapshot()]
+        assert ("inc", "resilience/nonfinite_updates") in kinds
+        assert ("gauge", "cluster/step_skew_s") in kinds
+
+    def test_dump_reload_and_jsonable_coercion(self, tmp_path):
+        rec = FlightRecorder(capacity=8)
+        rec.record("engine_stats", {"arr": np.arange(6).reshape(2, 3),
+                                    "scalar": np.float32(1.5)})
+        path = rec.dump(str(tmp_path / "flightrec.json"), reason="test crash")
+        doc = json.load(open(path))
+        assert doc["reason"] == "test crash"
+        assert doc["records"][0]["kind"] == "engine_stats"
+        assert doc["records"][0]["data"]["scalar"] == pytest.approx(1.5)
+        assert "shape=(2, 3)" in doc["records"][0]["data"]["arr"]
+        # a second dump is a fresh atomic write, numbered
+        path2 = rec.dump(str(tmp_path / "flightrec.json"), reason="again")
+        assert json.load(open(path2))["dump_number"] == 2
+
+    def test_observability_dump_counts_and_gauges(self, tmp_path):
+        obs = Observability(trace_dir=str(tmp_path))
+        with obs.span("obs/unit"):
+            pass
+        path = obs.dump_flight_record(reason="unit")
+        assert path and path.endswith("flightrec.json")
+        snap = obs.metrics.snapshot()
+        assert snap["flightrec/dumps"] == 1
+        assert snap["flightrec/records"] >= 1
+        kinds = {r["kind"] for r in json.load(open(path))["records"]}
+        assert "span" in kinds
+
+
+def test_spans_dropped_gauge_warns_once(trlx_log_records):
+    obs = Observability()
+    obs.tracer.max_events = 2
+    for i in range(5):
+        with obs.span(f"obs/s{i}"):
+            pass
+    obs.note_dropped_spans()
+    obs.note_dropped_spans()
+    assert obs.metrics.snapshot()["obs/spans_dropped"] == 3
+    warnings = [r for r in trlx_log_records if "dropped" in r.getMessage()]
+    assert len(warnings) == 1  # warn-once
+    # zero drops: gauge present, no warning
+    obs2 = Observability()
+    obs2.note_dropped_spans()
+    assert obs2.metrics.snapshot()["obs/spans_dropped"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# distributed telemetry (cluster beats, stragglers, merged traces)
+# ---------------------------------------------------------------------------
+
+
+def _fake_cluster(tracer, metrics, peers, **kwargs):
+    """A ClusterTelemetry whose allgather stacks the local vector with
+    fabricated peer rows — 2-rank semantics without a second process.
+    ``peers`` is a list of dicts overriding PACK_FIELDS per fake rank."""
+    from trlx_tpu.observability.distributed import PACK_FIELDS
+
+    def allgather(vec):
+        rows = [vec]
+        for peer in peers:
+            row = np.array(vec, np.float32)
+            for field, value in peer.items():
+                row[PACK_FIELDS.index(field)] = value
+            rows.append(row)
+        return np.stack(rows)
+
+    return ClusterTelemetry(
+        tracer, metrics, allgather=allgather, enabled=True, **kwargs
+    )
+
+
+class TestClusterTelemetry:
+    def test_single_process_beat_publishes_local_gauges(self):
+        reg = MetricsRegistry()
+        cluster = ClusterTelemetry(Tracer(), reg, enabled=True)
+        cluster.note_step(0.2, tokens_per_sec=100.0, device_bytes=1e6)
+        assert cluster.beat(False, step=0) is False
+        snap = reg.snapshot()
+        assert snap["cluster/size"] == 1.0
+        assert snap["cluster/step_time_max_s"] == pytest.approx(0.2)
+        assert snap["cluster/step_skew_s"] == 0.0
+        assert snap["cluster/straggler_rank"] == -1.0
+
+    def test_straggler_flagged_after_patience_beats(self, trlx_log_records):
+        reg = MetricsRegistry()
+        cluster = _fake_cluster(
+            Tracer(), reg, peers=[{"step_time_s": 0.9}], straggler_patience=2
+        )
+        cluster.note_step(0.1)
+        cluster.beat(False, step=0)
+        snap = reg.snapshot()
+        assert snap["cluster/straggler_rank"] == -1.0  # one beat: not yet
+        assert snap["cluster/step_skew_s"] == pytest.approx(0.8)
+        cluster.note_step(0.1)
+        cluster.beat(False, step=1)
+        snap = reg.snapshot()
+        assert snap["cluster/straggler_rank"] == 1.0
+        assert any("straggler" in r.getMessage() for r in trlx_log_records)
+        # recovery clears the flag
+        cluster = _fake_cluster(Tracer(), reg, peers=[{}], straggler_patience=2)
+        cluster.note_step(0.1)
+        cluster.beat(False, step=0)
+        cluster.beat(False, step=1)
+        assert reg.snapshot()["cluster/straggler_rank"] == -1.0
+
+    def test_desync_raises_hard_diagnostic(self):
+        cluster = _fake_cluster(Tracer(), MetricsRegistry(), peers=[{"step": 7}])
+        cluster.note_step(0.1)
+        with pytest.raises(ClusterDesyncError, match="rank 1: step 7"):
+            cluster.beat(False, step=3)
+
+    def test_preemption_flag_rides_the_beat(self):
+        reg = MetricsRegistry()
+        assert _fake_cluster(Tracer(), reg, peers=[{"preempt": 1.0}]).beat(
+            False, step=0
+        ) is True
+        assert _fake_cluster(Tracer(), reg, peers=[{}]).beat(True, step=0) is True
+        assert _fake_cluster(Tracer(), reg, peers=[{}]).beat(False, step=0) is False
+
+    def test_clock_offsets_estimated_from_beats(self):
+        # the fake peer's clock reads 2.5s behind rank 0's at every barrier
+        cluster = _fake_cluster(
+            Tracer(), MetricsRegistry(), peers=[{"clock_s": 0.0}]
+        )
+        for step in range(3):
+            cluster.beat(False, step=step)
+        offsets = cluster.clock_offsets()
+        assert offsets[0] == pytest.approx(0.0)
+        assert offsets[1] > 0  # rank 1's clock_s=0 → offset = rank0's clock
+
+    def test_disabled_beat_is_a_noop(self):
+        reg = MetricsRegistry()
+        cluster = ClusterTelemetry(Tracer(), reg, enabled=False)
+        assert cluster.beat(True, step=0) is True
+        assert "cluster/size" not in reg.snapshot()
+
+
+class TestMergedTrace:
+    def _rank_doc(self, events):
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def test_merges_rank_files_on_rank_zero_clock(self, tmp_path):
+        from trlx_tpu.observability.distributed import merge_cluster_trace
+
+        tracer = Tracer()
+        with tracer.span("train_step"):
+            pass
+        peer_events = [
+            {"name": "train_step", "ph": "X", "ts": 100.0, "dur": 50.0,
+             "pid": 1, "tid": 7},
+        ]
+        (tmp_path / "trace_rank1.json").write_text(
+            json.dumps(self._rank_doc(peer_events))
+        )
+        out = merge_cluster_trace(
+            tracer, str(tmp_path), process_count=2, offsets={1: 0.5},
+            timeout_s=1.0,
+        )
+        doc = json.load(open(out))
+        events = doc["traceEvents"]
+        pids = {e["pid"] for e in events if e["ph"] == "X"}
+        assert pids == {0, 1}
+        merged_peer = next(
+            e for e in events if e["ph"] == "X" and e["pid"] == 1
+        )
+        assert merged_peer["ts"] == pytest.approx(100.0 + 0.5e6)
+        labels = {
+            e["pid"]: e["args"]["name"]
+            for e in events
+            if e["name"] == "process_name"
+        }
+        assert labels == {0: "rank 0", 1: "rank 1"}
+        assert doc["clock_offsets_s"] == {"1": 0.5}
+
+    def test_stale_peer_file_is_not_merged(self, tmp_path, trlx_log_records):
+        # a relaunched run sharing the logging dir must not merge the
+        # PREVIOUS incarnation's peer trace as this run's spans
+        from trlx_tpu.observability.distributed import merge_cluster_trace
+
+        tracer = Tracer()
+        with tracer.span("train_step"):
+            pass
+        path = tmp_path / "trace_rank1.json"
+        path.write_text(
+            json.dumps(
+                self._rank_doc(
+                    [{"name": "train_step", "ph": "X", "ts": 1.0,
+                      "dur": 1.0, "pid": 1, "tid": 7}]
+                )
+            )
+        )
+        out = merge_cluster_trace(
+            tracer,
+            str(tmp_path),
+            process_count=2,
+            timeout_s=0.0,
+            min_mtime=os.path.getmtime(path) + 10.0,
+        )
+        doc = json.load(open(out))
+        assert doc["missing_ranks"] == [1]
+        assert {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"} == {0}
+
+    def test_missing_rank_is_bounded_not_fatal(self, tmp_path, trlx_log_records):
+        from trlx_tpu.observability.distributed import merge_cluster_trace
+
+        tracer = Tracer()
+        with tracer.span("train_step"):
+            pass
+        out = merge_cluster_trace(
+            tracer, str(tmp_path), process_count=2, timeout_s=0.0
+        )
+        doc = json.load(open(out))
+        assert doc["missing_ranks"] == [1]
+        assert {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"} == {0}
+        assert any(
+            "no fresh trace from rank 1" in r.getMessage()
+            for r in trlx_log_records
+        )
+
+
+# ---------------------------------------------------------------------------
 # end-to-end PPO smoke (the acceptance-criteria run)
 # ---------------------------------------------------------------------------
 
@@ -363,3 +627,129 @@ def test_ppo_smoke_emits_throughput_and_trace(tmp_path):
     assert nested, "no generate span nested inside a rollout span"
     # span stream export landed too
     assert (tmp_path / "logs" / "spans.jsonl").exists()
+    # distributed-telemetry gauges ride the stream even single-process
+    # (skew degenerates to 0.0 over one rank) with the drop gauge beside
+    assert "cluster/step_skew_s" in keys
+    assert "cluster/straggler_rank" in keys
+    assert "obs/spans_dropped" in keys
+
+
+def _obs_ppo_config(tmp_path, **train_overrides):
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    train = dict(
+        seq_length=24,
+        batch_size=8,
+        total_steps=2,
+        eval_interval=10,
+        checkpoint_interval=10,
+        epochs=1,
+        save_best=False,
+        checkpoint_dir=str(tmp_path / "ckpts"),
+        logging_dir=str(tmp_path / "logs"),
+        tracker="jsonl",
+    )
+    train.update(train_overrides)
+    return default_ppo_config().evolve(
+        train=train,
+        model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+        tokenizer=dict(tokenizer_path="builtin:bytes"),
+        method=dict(
+            num_rollouts=8,
+            chunk_size=8,
+            ppo_epochs=2,
+            gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+
+
+def _run_obs_ppo(config):
+    import trlx_tpu.trlx as trlx
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        return [float(len(o)) for o in outputs]
+
+    prompts = ["ab", "cd", "ef", "gh", "ij", "kl", "mn", "op"]
+    return trlx.train(reward_fn=reward_fn, prompts=prompts, config=config)
+
+
+def test_flightrec_dumps_on_nan_halt(tmp_path):
+    """Acceptance: an injected NaN-halt crash leaves a ``flightrec.json``
+    carrying the final step's spans and the resilience events that killed
+    the run — the crash-safe shutdown path, not a happy-path export."""
+    from trlx_tpu.resilience import NonFiniteUpdateError
+
+    # step 0 completes cleanly (its stats land in the ring); step 1's loss
+    # is poisoned and the halt policy raises out of learn()
+    config = _obs_ppo_config(tmp_path).evolve(
+        resilience=dict(update_guard="halt", fault_plan="nan_loss@step:1"),
+    )
+    with pytest.raises(NonFiniteUpdateError):
+        _run_obs_ppo(config)
+
+    doc = json.load(open(tmp_path / "logs" / "flightrec.json"))
+    assert "NonFiniteUpdateError" in doc["reason"]
+    records = doc["records"]
+    span_names = {
+        r["data"]["name"] for r in records if r["kind"] == "span"
+    }
+    # the final (poisoned) step's spans are in the ring
+    assert "train_step" in span_names
+    assert "generate" in span_names
+    # resilience events: the guard counted the non-finite update through
+    # the metrics tap before halting
+    metric_names = {
+        r["data"]["name"] for r in records if r["kind"] == "metric"
+    }
+    assert "resilience/nonfinite_updates" in metric_names
+    # the per-step stats records rode along
+    assert any(r["kind"] == "step" for r in records)
+
+
+def test_engine_request_spans_and_flightrec_fault(tmp_path):
+    """Continuous-batching run: per-request Engine lifecycle spans
+    (queue wait → prefill → decode) land in the trace on per-slot tracks,
+    ``engine/queue_wait_s`` rides the stats stream, and the deterministic
+    ``flightrec_dump@step:N`` fault dumps mid-run without any crash."""
+    config = _obs_ppo_config(tmp_path, continuous_batching=True).evolve(
+        resilience=dict(fault_plan="flightrec_dump@step:1"),
+    )
+    _run_obs_ppo(config)
+
+    trace = json.load(open(tmp_path / "logs" / "trace.json"))
+    events = trace["traceEvents"]
+    lifecycle = {
+        name: [e for e in events if e["name"] == name]
+        for name in ("engine/queue_wait", "engine/prefill", "engine/decode")
+    }
+    for name, evs in lifecycle.items():
+        assert evs, f"no {name} events in the trace"
+    # per-request ordering on a slot track: queue_wait → prefill → decode
+    first_decode = lifecycle["engine/decode"][0]
+    idx = first_decode["args"]["index"]
+    chain = {
+        name: next(e for e in evs if e["args"]["index"] == idx)
+        for name, evs in lifecycle.items()
+    }
+    qw, pf, dec = (
+        chain["engine/queue_wait"], chain["engine/prefill"], chain["engine/decode"]
+    )
+    assert qw["tid"] == pf["tid"] == dec["tid"]  # one slot track
+    assert qw["ts"] + qw["dur"] <= pf["ts"] + 1e-3
+    assert pf["ts"] + pf["dur"] <= dec["ts"] + 1e-3
+    # slot tracks are labeled
+    track_names = {
+        e["args"]["name"] for e in events if e["name"] == "thread_name"
+    }
+    assert any(n.startswith("engine/slot") for n in track_names)
+
+    records = [
+        json.loads(l) for l in open(tmp_path / "logs" / "stats.jsonl")
+    ]
+    keys = set().union(*(set(r) for r in records))
+    assert "engine/queue_wait_s" in keys
+
+    # the fault-plan dump fired mid-run (no crash): reason names the fault
+    doc = json.load(open(tmp_path / "logs" / "flightrec.json"))
+    assert "flightrec_dump@step:1" in doc["reason"]
+    assert any(r["kind"] == "span" for r in doc["records"])

@@ -1,5 +1,5 @@
-"""Convention passes: metric-name namespace (GL501) and config-key
-resolution (GL601).
+"""Convention passes: metric-name namespace (GL501), span-name namespace
+(GL502), and config-key resolution (GL601).
 
 ``metric-names`` is the framework home of the former standalone
 ``scripts/check_metric_names.py`` (that script is now a thin shim over
@@ -8,6 +8,15 @@ string-keyed ``stats[...]`` subscript and ``metrics.inc/set_gauge(...)``
 call site must use a ``namespace/name`` key. ``LEGACY_KEYS`` is frozen;
 ``RESILIENCE_KEYS`` registers the canonical resilience counters the
 static scan can't see (parameterized helper emissions).
+
+``span-names`` (GL502) holds span/instant/complete-event names to the SAME
+``namespace/name`` rule: spans land in the same dashboards and merged
+multi-rank traces as metrics, so one naming convention covers both.
+``LEGACY_SPAN_NAMES`` freezes the five pre-convention trainer spans
+(``rollout``/``generate``/``score``/``reward``/``train_step``) — do not
+add to it; new spans must be namespaced. AST-based (unlike the GL501 line
+scan) so multi-line calls and docstring examples are handled correctly;
+dynamically-named spans (f-strings, variables) are out of scope.
 
 ``config-keys`` resolves ``config.<section>.<field>`` attribute chains
 against the dataclasses in ``data/configs.py`` (sections) and every
@@ -91,7 +100,39 @@ ENGINE_KEYS = frozenset({
     "engine/block_pool_occupancy",
     "engine/prefix_hit_rate",
     "engine/prefix_tokens_saved",
+    "engine/queue_wait_s",
     "memory/kv_cache_bytes",
+})
+
+# Canonical cross-rank telemetry gauges (observability/distributed.py,
+# docs/OBSERVABILITY.md "Distributed telemetry"): published every step
+# boundary from the packed allgather matrix — min/mean/max/skew of the
+# per-rank scalars plus the straggler verdict. All literal set_gauge sites.
+CLUSTER_KEYS = frozenset({
+    "cluster/size",
+    "cluster/step_time_min_s",
+    "cluster/step_time_mean_s",
+    "cluster/step_time_max_s",
+    "cluster/step_skew_s",
+    "cluster/host_wait_mean_s",
+    "cluster/host_wait_max_s",
+    "cluster/tokens_per_sec_min",
+    "cluster/tokens_per_sec_sum",
+    "cluster/device_bytes_in_use_max",
+    "cluster/straggler_rank",
+})
+
+# Crash flight recorder accounting (observability/flightrec.py,
+# docs/OBSERVABILITY.md "Flight recorder").
+FLIGHTREC_KEYS = frozenset({
+    "flightrec/dumps",
+    "flightrec/records",
+})
+
+# Observability self-accounting (docs/OBSERVABILITY.md): the span tracer's
+# silent drop counter surfaced as a gauge.
+OBS_KEYS = frozenset({
+    "obs/spans_dropped",
 })
 
 
@@ -166,6 +207,75 @@ class MetricNamesPass(LintPass):
                         message=f'metric key "{key}" violates the '
                         "namespace/name convention "
                         "(docs/OBSERVABILITY.md; LEGACY_KEYS is frozen)",
+                    )
+                )
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# span names
+# ---------------------------------------------------------------------------
+
+# call names whose first literal-string argument is a span/track name:
+# Tracer.span / Observability.span / module-level span(), Tracer.instant,
+# Tracer.add_complete_event, and the engine's injected `self._span` seam
+_SPAN_FUNCS = frozenset({"span", "_span", "instant", "add_complete_event"})
+
+# Pre-convention trainer span names, kept for trace/dashboard continuity
+# (they predate the namespace rule and appear in every committed trace).
+# FROZEN — new spans must be namespaced.
+LEGACY_SPAN_NAMES = frozenset({
+    "rollout",
+    "generate",
+    "score",
+    "reward",
+    "train_step",
+})
+
+
+def _span_name_violation(name: str) -> bool:
+    return name not in LEGACY_SPAN_NAMES and not _CONVENTION_RE.match(name)
+
+
+@register_pass
+class SpanNamesPass(LintPass):
+    name = "span-names"
+    codes = ("GL502",)
+    description = "span names must follow the namespace/name convention"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        graph = ctx.callgraph
+        for mod in ctx.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute):
+                    fname = func.attr
+                elif isinstance(func, ast.Name):
+                    fname = func.id
+                else:
+                    continue
+                if fname not in _SPAN_FUNCS:
+                    continue
+                arg = node.args[0]
+                if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                    continue  # dynamic names are out of static scope
+                name = arg.value
+                if not _span_name_violation(name):
+                    continue
+                scope = graph.enclosing_function(mod, node)
+                findings.append(
+                    Finding(
+                        code="GL502",
+                        path=mod.relpath,
+                        line=node.lineno,
+                        symbol=scope.qualname if scope else "-",
+                        detail=name,
+                        message=f'span name "{name}" violates the '
+                        "namespace/name convention (docs/OBSERVABILITY.md; "
+                        "LEGACY_SPAN_NAMES is frozen)",
                     )
                 )
         return findings

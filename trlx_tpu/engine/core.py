@@ -85,6 +85,12 @@ class _Request:
     attention_mask: np.ndarray  # [P]
     key: np.ndarray  # [2] per-row RNG chain start
     meta: Any = None
+    # lifecycle timestamps (perf_counter) for the per-request trace spans:
+    # queue wait = enqueue → refill start, prefill = the refill program
+    # call, decode = refill end → harvest
+    t_enqueue: float = 0.0
+    t_refill0: float = 0.0
+    t_refill1: float = 0.0
 
 
 @dataclass
@@ -100,6 +106,7 @@ class EngineStats:
     harvested: int = 0
     decode_s: float = 0.0  # wall time inside decode segments
     refill_s: float = 0.0  # wall time inside refill prefills
+    queue_wait_s: float = 0.0  # summed enqueue→refill wait over requests
     # KV memory (docs/PERFORMANCE.md): the persistent cache allocation, and
     # for the paged backend the live-token-scaled high-water
     kv_cache_bytes: int = 0  # dense cache / paged pool allocation
@@ -141,6 +148,7 @@ class EngineStats:
         stats["rollout/refill_prefills"] = float(self.refill_prefills)
         stats["rollout/refilled_rows"] = float(self.refilled_rows)
         stats["rollout/segments"] = float(self.segments)
+        stats["engine/queue_wait_s"] = float(self.queue_wait_s)
         stats["memory/kv_cache_bytes"] = float(self.kv_cache_bytes)
         if self.kv_blocks_total:
             stats["engine/kv_blocks_in_use"] = float(self.kv_blocks_in_use)
@@ -277,8 +285,11 @@ class ContinuousEngine(Engine):
     ``paged`` field selects the KV backend; ``span`` is an optional
     ``Observability.span``-shaped callable — each segment runs under a
     fenced ``rollout/segment`` span so the trace shows device-true decode
-    time per segment. ``prefix_cache`` (paged backend only) turns on
-    shared-prefix prefill skipping.
+    time per segment. ``tracer`` (an ``Observability.tracer``) additionally
+    emits per-request lifecycle spans at harvest — ``engine/queue_wait`` →
+    ``engine/prefill`` → ``engine/decode`` on a per-slot track — so a stall
+    is attributable to one row, not smeared over the batch. ``prefix_cache``
+    (paged backend only) turns on shared-prefix prefill skipping.
     """
 
     def __init__(
@@ -287,6 +298,7 @@ class ContinuousEngine(Engine):
         params: Any,
         pad_token_id: int,
         span: Optional[Callable[..., Any]] = None,
+        tracer: Any = None,
         prewarm: bool = True,
         prefix_cache: bool = False,
         prefix_capacity_blocks: int = 0,
@@ -298,6 +310,7 @@ class ContinuousEngine(Engine):
         self.params = params
         self.pad_token_id = int(pad_token_id)
         self._span = span
+        self._tracer = tracer
         self.state = fns.init_state()
         self.B = fns.batch_size
         self.P = fns.prompt_len
@@ -422,6 +435,7 @@ class ContinuousEngine(Engine):
                 [np.zeros((b, pad), np.int32), attention_mask], axis=1
             )
         keys = np.asarray(keys)
+        t_enqueue = time.perf_counter()
         for i in range(b):
             self._queue.append(
                 _Request(
@@ -430,6 +444,7 @@ class ContinuousEngine(Engine):
                     attention_mask=attention_mask[i],
                     key=keys[i],
                     meta=metas[i] if metas is not None else None,
+                    t_enqueue=t_enqueue,
                 )
             )
             self._submitted += 1
@@ -575,7 +590,14 @@ class ContinuousEngine(Engine):
             self.stats.prefill_tokens += self.P * len(rows)
         else:
             self._refill_paged(rows, slots)
-        self.stats.refill_s += time.perf_counter() - t0
+        t1 = time.perf_counter()
+        for req in rows:
+            # lifecycle bookkeeping: the whole refill event bounds each
+            # row's prefill window (per-bucket sub-calls are not split out)
+            req.t_refill0 = t0
+            req.t_refill1 = t1
+            self.stats.queue_wait_s += max(t0 - req.t_enqueue, 0.0)
+        self.stats.refill_s += t1 - t0
         self.stats.refilled_rows += len(rows)
 
     def _refill_paged(self, rows: List["_Request"], slots: List[int]) -> None:
@@ -635,10 +657,12 @@ class ContinuousEngine(Engine):
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()
         host = {k: np.asarray(v) for k, v in rows.items()}
+        t_harvest = time.perf_counter()
         completed = []
         for j, slot in enumerate(finished):  # slot order: deterministic
             req = self._slots[slot]
             self._slots[slot] = None
+            self._trace_request(req, slot, t_harvest)
             if self.spec is not None:
                 # free the row's block refs; blocks the prefix cache (or a
                 # sharing sibling) still holds stay allocated. The device
@@ -664,6 +688,27 @@ class ContinuousEngine(Engine):
             )
         self.stats.harvested += len(completed)
         return completed
+
+    def _trace_request(self, req: "_Request", slot: int, t_harvest: float) -> None:
+        """Emit the request's lifecycle spans (queue wait → prefill →
+        decode, closed by harvest) on this slot's track — a slot holds one
+        request at a time, so per-slot tracks never overlap and a stalled
+        generation is attributable to its exact row in the merged trace."""
+        if self._tracer is None or req.t_refill1 <= 0.0:
+            return
+        track = f"engine/slot{slot}"
+        self._tracer.add_complete_event(
+            "engine/queue_wait", req.t_enqueue, req.t_refill0,
+            track=track, index=req.index,
+        )
+        self._tracer.add_complete_event(
+            "engine/prefill", req.t_refill0, req.t_refill1,
+            track=track, index=req.index,
+        )
+        self._tracer.add_complete_event(
+            "engine/decode", req.t_refill1, t_harvest,
+            track=track, index=req.index,
+        )
 
     def step(self) -> List[CompletedSequence]:
         """One refill → segment → harvest turn; returns newly completed

@@ -14,7 +14,13 @@ that gap:
 - :mod:`watchdogs` — steady-state recompile detection and device-memory
   gauges with CPU fallback;
 - :mod:`profiling` — ``TRLX_TPU_PROFILE=steps:3-5,dir:...`` programmatic
-  ``jax.profiler`` windows and per-step ``StepTraceAnnotation``.
+  ``jax.profiler`` windows and per-step ``StepTraceAnnotation``;
+- :mod:`distributed` — cross-rank telemetry (``cluster/*`` gauges riding
+  the coordinated-preemption allgather), straggler/desync detection, and
+  merged multi-rank Perfetto traces on one aligned clock;
+- :mod:`flightrec` — a crash flight recorder: bounded ring of recent
+  spans, metric updates, and resilience events, dumped as
+  ``flightrec.json`` on any exception/NaN-halt/preemption.
 
 :class:`Observability` bundles one instance of each per trainer. See
 ``docs/OBSERVABILITY.md`` for the span API and metric naming convention.
@@ -23,6 +29,11 @@ that gap:
 import os
 from typing import Any, Dict, Optional
 
+from trlx_tpu.observability.distributed import (
+    ClusterDesyncError,
+    ClusterTelemetry,
+)
+from trlx_tpu.observability.flightrec import FlightRecorder
 from trlx_tpu.observability.metrics import (
     DEFAULT_PEAK_FLOPS,
     MetricsRegistry,
@@ -34,10 +45,16 @@ from trlx_tpu.observability.metrics import (
 from trlx_tpu.observability.profiling import ProfileWindow, parse_profile_spec
 from trlx_tpu.observability.tracing import Span, Tracer, get_tracer, span
 from trlx_tpu.observability.watchdogs import DeviceMemoryGauge, RecompileWatchdog
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
 
 __all__ = [
+    "ClusterDesyncError",
+    "ClusterTelemetry",
     "DEFAULT_PEAK_FLOPS",
     "DeviceMemoryGauge",
+    "FlightRecorder",
     "MetricsRegistry",
     "Observability",
     "ProfileWindow",
@@ -73,6 +90,26 @@ class Observability:
         self.memory = DeviceMemoryGauge()
         self.profile = ProfileWindow.from_env(config)
         self.throughput = ThroughputMeter()
+        # crash flight recorder (flightrec.py): taps every span and every
+        # metric write so the LAST window before a crash survives the crash
+        self.flightrec = FlightRecorder(
+            capacity=int(os.environ.get("TRLX_TPU_FLIGHTREC_CAP", "512"))
+        )
+        self.tracer.add_listener(self.flightrec.span_listener)
+        self.metrics.add_listener(self.flightrec.metric_listener)
+        # cross-rank telemetry (distributed.py): the trainer's step-boundary
+        # seam drives beat(); single-process it degenerates to local gauges
+        self.cluster = ClusterTelemetry(
+            self.tracer, self.metrics, flightrec=self.flightrec
+        )
+        self._warned_dropped = False
+        # wall-clock construction time: the merge's staleness floor — peer
+        # trace files older than this run are a previous incarnation's
+        # (same logging dir across a preempt/relaunch) and must not be
+        # merged as if they were this run's spans
+        import time as _time
+
+        self._t_start_wall = _time.time()
         self._trace_dir = trace_dir or os.environ.get("TRLX_TPU_TRACE_DIR")
         if self._trace_dir is None and config is not None:
             train = getattr(config, "train", None)
@@ -86,9 +123,30 @@ class Observability:
     def span(self, name: str, fence: Any = None, **args: Any):
         return self.tracer.span(name, fence=fence, **args)
 
+    def note_dropped_spans(self) -> None:
+        """Surface the tracer's silent drop counter as the
+        ``obs/spans_dropped`` gauge (warn once when nonzero — a capped
+        trace looks complete in the viewer but is lying about the tail)."""
+        dropped = self.tracer.dropped
+        self.metrics.set_gauge("obs/spans_dropped", float(dropped))
+        if dropped and not self._warned_dropped:
+            self._warned_dropped = True
+            logger.warning(
+                "span tracer dropped %d event(s) past its %d-event cap — "
+                "the exported trace is missing its tail (raise "
+                "Tracer(max_events=...) or export more often); the flight "
+                "recorder ring keeps rotating regardless",
+                dropped,
+                self.tracer.max_events,
+            )
+
     def export(self, directory: Optional[str] = None) -> Dict[str, str]:
         """Write ``trace.json`` (Chrome/Perfetto) and ``spans.jsonl``.
 
+        Multihost: non-zero ranks write ``trace_rank<k>.json`` into the
+        shared trace dir (and return {}); process 0 merges every rank's
+        events — shifted onto rank 0's clock via the beat-estimated offsets
+        — into ONE ``trace.json``. Single-process behavior is unchanged.
         Returns the written paths ({} when there is no directory, no
         events, or this is a non-zero process)."""
         directory = directory or self._trace_dir
@@ -96,13 +154,58 @@ class Observability:
             return {}
         import jax
 
+        from trlx_tpu.observability.distributed import (
+            merge_cluster_trace,
+            write_rank_trace,
+        )
+
+        count = jax.process_count()
         if jax.process_index() != 0:
+            if count > 1:
+                write_rank_trace(self.tracer, directory, jax.process_index())
             return {}
-        return {
-            "trace": self.tracer.export_chrome_trace(
+        if count > 1:
+            trace_path = merge_cluster_trace(
+                self.tracer,
+                directory,
+                process_count=count,
+                offsets=self.cluster.clock_offsets(),
+                # small slack absorbs wall-vs-filesystem clock skew without
+                # re-admitting a genuinely previous incarnation's files
+                min_mtime=self._t_start_wall - 5.0,
+            )
+        else:
+            trace_path = self.tracer.export_chrome_trace(
                 os.path.join(directory, "trace.json")
-            ),
+            )
+        return {
+            "trace": trace_path,
             "spans": self.tracer.export_jsonl(
                 os.path.join(directory, "spans.jsonl")
             ),
         }
+
+    def dump_flight_record(
+        self, reason: str, directory: Optional[str] = None
+    ) -> Optional[str]:
+        """Dump the flight-recorder ring as ``flightrec.json`` (per-rank
+        suffixed files off process 0) next to the trace exports. Returns
+        the path, or None without a directory — never raises (it runs on
+        crash paths)."""
+        directory = directory or self._trace_dir
+        if not directory:
+            return None
+        try:
+            import jax
+
+            rank = jax.process_index()
+        except Exception:  # pragma: no cover - defensive
+            rank = 0
+        name = "flightrec.json" if rank == 0 else f"flightrec_rank{rank}.json"
+        path = self.flightrec.dump(os.path.join(directory, name), reason=reason)
+        if path:
+            n_records = float(len(self.flightrec.snapshot()))
+            self.metrics.inc("flightrec/dumps")
+            self.metrics.set_gauge("flightrec/records", n_records)
+            logger.warning(f"flight recorder dumped to {path} ({reason})")
+        return path

@@ -84,15 +84,39 @@ class MetricsRegistry:
         self._counters: Dict[str, float] = {}  # guarded-by: _lock
         self._gauges: Dict[str, float] = {}  # guarded-by: _lock
         self._hists: Dict[str, List[float]] = {}  # guarded-by: _lock
+        # update listeners (the crash flight recorder): called on every
+        # inc/set_gauge so resilience counters and cluster gauges land in
+        # the forensic ring as they happen
+        self._listeners: List[Any] = []  # guarded-by: _lock
+
+    def add_listener(self, fn) -> None:
+        """Subscribe to every counter/gauge write as ``fn(op, name, value)``
+        — the crash flight recorder's tap."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def _notify(self, op: str, name: str, value: float) -> None:
+        # listeners run outside the lock and are never allowed to break
+        # metric recording
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(op, name, value)
+            except Exception:  # pragma: no cover - defensive
+                pass
 
     def inc(self, name: str, value: float = 1.0) -> float:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0.0) + value
-            return self._counters[name]
+            total = self._counters[name]
+        self._notify("inc", name, total)
+        return total
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = float(value)
+        self._notify("gauge", name, float(value))
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:

@@ -101,6 +101,11 @@ class Tracer:
         self._local = threading.local()
         self._epoch = time.perf_counter()
         self._last_duration: Dict[str, float] = {}  # guarded-by: _lock
+        # event listeners (the crash flight recorder): called for EVERY
+        # event, including ones the bounded buffer drops — the recorder's
+        # own ring keeps rotating after the tracer cap is hit, which is
+        # exactly when a long run crashes
+        self._listeners: List[Callable[[Dict[str, Any]], None]] = []  # guarded-by: _lock
 
     # -- recording ------------------------------------------------------
 
@@ -122,28 +127,7 @@ class Tracer:
         the Chrome/Perfetto export instead of scattering one near-empty row
         per incarnation. Emits the ``thread_name`` metadata event once per
         alias so the track is labeled in the viewer."""
-        import zlib
-
-        tid = zlib.crc32(alias.encode()) % 2**31 or 1
-        self._local.tid = tid
-        if not self.enabled:  # same gate as span()/instant() recording
-            return
-        with self._lock:
-            seen = getattr(self, "_aliased", None)
-            if seen is None:
-                seen = self._aliased = set()
-            if alias in seen:
-                return
-            seen.add(alias)
-        self._append(
-            {
-                "name": "thread_name",
-                "ph": "M",
-                "pid": _process_index(),
-                "tid": tid,
-                "args": {"name": alias},
-            }
-        )
+        self._local.tid = self._track_tid(alias)
 
     @contextmanager
     def span(
@@ -202,8 +186,72 @@ class Tracer:
         with self._lock:
             if len(self._events) >= self.max_events:
                 self.dropped += 1
-                return
-            self._events.append(event)
+            else:
+                self._events.append(event)
+            listeners = list(self._listeners)
+        # listeners run OUTSIDE the lock (a listener touching the tracer
+        # must not deadlock) and are never allowed to break recording
+        for fn in listeners:
+            try:
+                fn(event)
+            except Exception:  # pragma: no cover - defensive
+                pass
+
+    def add_listener(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        """Subscribe to every recorded (or cap-dropped) event — the crash
+        flight recorder's tap (``observability/flightrec.py``)."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def _track_tid(self, alias: str) -> int:
+        """Stable pseudo-tid for a named track, emitting the labeling
+        ``thread_name`` metadata event once per alias (shared by
+        :meth:`alias_current_thread` and :meth:`add_complete_event`)."""
+        import zlib
+
+        tid = zlib.crc32(alias.encode()) % 2**31 or 1
+        if not self.enabled:
+            return tid
+        with self._lock:
+            seen = getattr(self, "_aliased", None)
+            if seen is None:
+                seen = self._aliased = set()
+            if alias in seen:
+                return tid
+            seen.add(alias)
+        self._append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": _process_index(),
+                "tid": tid,
+                "args": {"name": alias},
+            }
+        )
+        return tid
+
+    def add_complete_event(
+        self, name: str, t0: float, t1: float, track: Optional[str] = None,
+        **args: Any,
+    ) -> None:
+        """Record a complete (``"ph": "X"``) event with *explicit*
+        ``time.perf_counter`` endpoints — for retrospective spans whose
+        boundaries were only known after the fact (the Engine's per-request
+        lifecycle: queue wait → prefill → decode, emitted at harvest).
+        ``track`` names a stable pseudo-thread row in the viewer."""
+        if not self.enabled:
+            return
+        event: Dict[str, Any] = {
+            "name": name,
+            "ph": "X",
+            "ts": (t0 - self._epoch) * 1e6,
+            "dur": max(t1 - t0, 0.0) * 1e6,
+            "pid": _process_index(),
+            "tid": self._track_tid(track) if track else self._tid(),
+        }
+        if args:
+            event["args"] = dict(args)
+        self._append(event)
 
     # -- reading / export ----------------------------------------------
 

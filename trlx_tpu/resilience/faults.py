@@ -13,7 +13,8 @@ Plan syntax (``;``-separated entries, whitespace ignored)::
     kind@trigger:N[*count]
 
     kind     one of: reward_raise | publish_raise | sigterm | sigint |
-             sigterm_one_proc | nan_loss | crash_save | topology_shrink
+             sigterm_one_proc | nan_loss | crash_save | topology_shrink |
+             sleep_one_proc | flightrec_dump
     trigger  call  — the Nth invocation of the consulting site (1-based;
                      for reward_raise/publish_raise every *attempt* counts,
                      so retries advance the counter)
@@ -33,6 +34,13 @@ Examples::
     crash_save@save:2            # the 2nd save_state dies before committing
     topology_shrink@resume:1     # the 1st restore takes the elastic reshard
                                  # path even on a matching mesh
+    sleep_one_proc@step:2*3      # the LAST process (highest rank) sleeps
+                                 # inside updates 3-5 — a deterministic
+                                 # straggler for the cluster-telemetry
+                                 # watchdog (cluster/straggler_rank)
+    flightrec_dump@step:4        # dump the crash flight recorder at the
+                                 # boundary before update 5 (deterministic
+                                 # flightrec.json exercise, no crash needed)
 
 Plans come from ``config.resilience.fault_plan`` or the
 ``TRLX_TPU_FAULT_PLAN`` env var (env wins — a relaunched run can drop the
@@ -49,8 +57,13 @@ from typing import Dict, List, Optional
 
 _KINDS = frozenset({
     "reward_raise", "publish_raise", "sigterm", "sigint", "sigterm_one_proc",
-    "nan_loss", "crash_save", "topology_shrink",
+    "nan_loss", "crash_save", "topology_shrink", "sleep_one_proc",
+    "flightrec_dump",
 })
+
+# how long a ``sleep_one_proc`` fault stalls the afflicted rank's train step
+# (env-overridable so tests can size the stall above the real step time)
+SLEEP_FAULT_S = float(os.environ.get("TRLX_TPU_FAULT_SLEEP_S", "0.5"))
 _TRIGGERS = frozenset({"call", "step", "save", "resume"})
 
 

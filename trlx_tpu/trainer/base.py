@@ -612,6 +612,24 @@ class TPUBaseTrainer(BaseRLTrainer):
         (``n_updates_per_batch``), and one host→device transfer serves all
         replays."""
         set_global_mesh(self.mesh)
+        plan = self.resilience.plan
+        if (
+            plan
+            and jax.process_index() == jax.process_count() - 1
+            and plan.poll("sleep_one_proc", step=self.iter_count)
+        ):
+            # deterministic straggler: stall the LAST rank's step so the
+            # cluster-telemetry watchdog has something real to flag
+            # (cluster/straggler_rank; docs/OBSERVABILITY.md)
+            from time import sleep as _sleep
+
+            from trlx_tpu.resilience.faults import SLEEP_FAULT_S
+
+            logger.warning(
+                f"fault plan: sleeping {SLEEP_FAULT_S}s inside update "
+                f"{self.iter_count} (injected straggler)"
+            )
+            _sleep(SLEEP_FAULT_S)
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         if batch is self._last_batch_host:
@@ -1209,19 +1227,30 @@ class TPUBaseTrainer(BaseRLTrainer):
         try:
             with self.resilience.preemption:
                 return self._learn_loop()
-        except BaseException:
+        except BaseException as e:
             # crash-safe shutdown: without this, an exception loses every
-            # buffered tracker record and the whole Perfetto trace
-            self._shutdown_observability()
+            # buffered tracker record and the whole Perfetto trace — and
+            # the flight recorder's last-moments ring (flightrec.json)
+            self._shutdown_observability(
+                reason=f"{type(e).__name__}: {e}"
+            )
             raise
 
-    def _shutdown_observability(self) -> None:
+    def _shutdown_observability(self, reason: Optional[str] = None) -> None:
         """Best-effort flush of profiler, span trace, and tracker — callable
-        from exception paths, never raising."""
+        from exception paths, never raising. A non-None ``reason`` marks a
+        crash path and additionally dumps the flight recorder
+        (``flightrec.json``): any exception, NaN-halt, and preemption all
+        funnel through here (docs/OBSERVABILITY.md "Flight recorder")."""
         try:
             self.obs.profile.stop()
         except Exception:  # pragma: no cover - defensive
             pass
+        if reason is not None:
+            try:
+                self.obs.dump_flight_record(reason=reason)
+            except Exception:  # pragma: no cover - defensive
+                pass
         self._export_observability()
         try:
             self.tracker.finish()
@@ -1251,9 +1280,28 @@ class TPUBaseTrainer(BaseRLTrainer):
                 and jax.process_index() == 0
             ):
                 _signal.raise_signal(_signal.SIGTERM)
+            if plan.poll("flightrec_dump", step=self.iter_count):
+                # deterministic flight-recorder exercise: same dump path as
+                # the crash/NaN-halt/preemption shutdown, no crash needed
+                self.obs.dump_flight_record(
+                    reason=f"fault plan: flightrec_dump@step:{self.iter_count}"
+                )
         preemption = self.resilience.preemption
         requested = preemption.requested
-        if self.resilience.config.coordinate_preemption:
+        coordinate = self.resilience.config.coordinate_preemption
+        if self.obs.cluster.enabled:
+            # cross-rank telemetry beat (docs/OBSERVABILITY.md "Distributed
+            # telemetry"): ONE allgather carries the preemption flag AND the
+            # per-rank scalars (step time, host wait, tokens/s, memory) —
+            # the coordinated-preemption collective, not a new sync point.
+            # With coordination disabled the beat stays local (no
+            # collective) and only this rank's gauges publish.
+            requested_any = self.obs.cluster.beat(
+                requested, step=self.iter_count, collective=coordinate
+            )
+            if coordinate:
+                requested = requested_any
+        elif coordinate:
             # multihost: ALL processes must agree on the checkpoint step —
             # a SIGTERM lands on one host while the others keep stepping.
             # The allgather runs every boundary (SPMD lockstep), so the
@@ -1266,6 +1314,14 @@ class TPUBaseTrainer(BaseRLTrainer):
         if not preemption.requested:
             # this process was not signaled itself; a peer was
             preemption.request("peer preemption (coordinated)")
+        self.obs.flightrec.record(
+            "resilience",
+            {
+                "event": "preemption",
+                "signal": preemption.signal_received,
+                "step": self.iter_count,
+            },
+        )
         subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
         path = os.path.join(self.config.train.checkpoint_dir, subfolder)
         logger.warning(
@@ -1375,7 +1431,26 @@ class TPUBaseTrainer(BaseRLTrainer):
                         )
                     )
                     stats.update(self.obs.memory.collect())
+                    # feed the NEXT boundary's cluster beat (distributed
+                    # telemetry) with this step's scalars, and surface the
+                    # tracer's drop counter before the snapshot below
+                    self.obs.cluster.note_step(
+                        step_time,
+                        tokens_per_sec=stats.get(
+                            "throughput/tokens_per_sec", 0.0
+                        ),
+                        device_bytes=stats.get(
+                            "memory/device_bytes_in_use",
+                            stats.get("memory/host_rss_bytes", 0.0),
+                        ),
+                    )
+                    self.obs.note_dropped_spans()
                     stats.update(self.obs.metrics.snapshot())
+                    # the flight recorder keeps the last N steps' stats for
+                    # the crash dump (docs/OBSERVABILITY.md)
+                    self.obs.flightrec.record(
+                        "step", {"iter": self.iter_count, "stats": stats}
+                    )
                     clock.tick(batch_size)
                     stats["time/per_1k_samples"] = clock.get_stat(1000)
                     profile.on_step_end(self.iter_count)
@@ -1643,6 +1718,10 @@ class TPUBaseTrainer(BaseRLTrainer):
         self.load(path, restore_payload=False)
         self.iter_count, self.best_reward = cur_iter, cur_best
         self._drop_batch_memo()
+        self.obs.flightrec.record(
+            "resilience",
+            {"event": "rollback", "checkpoint": path, "step": self.iter_count},
+        )
         logger.warning(f"rolled back train state to {path}")
 
     def save_pretrained(self, directory: Optional[str] = None, **kwargs) -> None:

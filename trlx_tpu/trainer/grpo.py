@@ -337,7 +337,11 @@ class GRPOTrainer(PPOTrainer):
         stats["exp_scores/std"] = float(pooled.std()) if pooled.size else 0.0
         engine_stats = agg.get("engine_stats")
         if engine_stats is not None:
-            stats.update(engine_stats.metrics())
+            engine_metrics = engine_stats.metrics()
+            stats.update(engine_metrics)
+            # EngineStats snapshot into the crash flight recorder (same as
+            # the PPO continuous path)
+            self.obs.flightrec.record("engine_stats", engine_metrics)
         elif agg["slot_steps"]:
             # mask-derived slot gauges on the serial path (the CB branch
             # reports the engine's exact counters above)

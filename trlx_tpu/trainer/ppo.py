@@ -701,6 +701,9 @@ class PPOTrainer(TPUBaseTrainer):
                 self.state.params,
                 self.tokenizer.pad_token_id,
                 span=self.obs.span,
+                # per-request lifecycle spans (engine/queue_wait → prefill →
+                # decode on per-slot tracks; docs/OBSERVABILITY.md)
+                tracer=self.obs.tracer,
                 prefix_cache=self._prefix_cache_enabled(),
                 prefix_capacity_blocks=int(self.config.engine.prefix_cache_blocks),
             )
@@ -816,9 +819,13 @@ class PPOTrainer(TPUBaseTrainer):
         engine = state["engine"]
         if engine is not None:
             # exact on-device counters replace the mask-derived estimates
-            stats.update(engine.stats.metrics())
+            engine_metrics = engine.stats.metrics()
+            stats.update(engine_metrics)
             stats["time/exp_generate"] = engine.stats.decode_s + engine.stats.refill_s
             stats["time/generate"] = engine.stats.decode_s
+            # EngineStats snapshot into the crash flight recorder: a run
+            # dying mid-collection keeps its last engine picture
+            self.obs.flightrec.record("engine_stats", engine_metrics)
 
     def _consume_skip_initial_experience(self) -> bool:
         """True exactly once after an emergency-payload restore: the store
