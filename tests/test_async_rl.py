@@ -1,0 +1,630 @@
+"""Disaggregated async RL (docs/ASYNC_RL.md): queue/channel semantics, the
+staleness gate, requeue-on-actor-death, and the bit-equivalence standing
+constraint extended to the new subsystem.
+
+Four contract groups:
+
+- **queue/channel units** — bounded back-pressure, drop-oldest eviction,
+  version gating, and the deterministic ``weight_sync_drop`` fault (no
+  trainer, no jax device work);
+- **bit-equivalence** — thread mode with ``max_staleness: 0`` and a single
+  actor produces a store bit-identical to the serial reference path under
+  a fixed seed — including across an injected actor crash (the requeued
+  chunk regenerates identically);
+- **staleness bound** — a full async ``trlx.train`` run never consumes a
+  chunk staler than ``max_staleness``, and the IW correction's behavior
+  logprobs ride into the store;
+- **process mode (slow)** — a learner process and a separate actor process
+  (own JAX runtime, filesystem transport) train PPO with in-flight weight
+  sync; an ``actor_crash`` kills the actor mid-run and a respawn completes
+  the run; the collection-1 store is bit-identical to serial.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from trlx_tpu.async_rl.channel import WeightChannel
+from trlx_tpu.async_rl.queue import (
+    ExperienceChunk,
+    ExperienceQueue,
+    FileExperienceQueue,
+    QueueClosed,
+)
+from trlx_tpu.resilience.faults import FaultPlan
+
+
+class _Metrics:
+    def __init__(self):
+        self.counts = {}
+
+    def inc(self, name, value=1.0):
+        self.counts[name] = self.counts.get(name, 0.0) + value
+
+    def observe(self, name, value):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# queue units
+# ---------------------------------------------------------------------------
+
+
+class TestExperienceQueue:
+    def test_fifo_and_depth(self):
+        q = ExperienceQueue(capacity=4)
+        for i in range(3):
+            q.put(ExperienceChunk(i, version=i))
+        assert q.depth == 3
+        assert [q.get().index for _ in range(3)] == [0, 1, 2]
+
+    def test_block_policy_backpressures_put(self):
+        q = ExperienceQueue(capacity=1, policy="block")
+        q.put(ExperienceChunk(0, 0))
+        landed = []
+
+        def producer():
+            q.put(ExperienceChunk(1, 0))
+            landed.append(True)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        time.sleep(0.15)
+        assert not landed  # blocked at capacity
+        assert q.get().index == 0
+        t.join(timeout=5)
+        assert landed and q.get().index == 1
+
+    def test_drop_oldest_evicts_counts_and_reports(self):
+        m = _Metrics()
+        dropped = []
+        q = ExperienceQueue(
+            capacity=2, policy="drop_oldest", metrics=m, on_drop=dropped.append
+        )
+        for i in range(4):
+            q.put(ExperienceChunk(i, 0))
+        assert m.counts["async/dropped_chunks"] == 2
+        # evicted chunks are handed back for regeneration — the learner's
+        # in-order drain depends on every index eventually arriving
+        assert [c.index for c in dropped] == [0, 1]
+        assert [q.get().index, q.get().index] == [2, 3]
+
+    def test_drop_oldest_requires_on_drop(self):
+        with pytest.raises(ValueError, match="on_drop"):
+            ExperienceQueue(capacity=2, policy="drop_oldest")
+
+    def test_close_wakes_blocked_consumer(self):
+        q = ExperienceQueue(capacity=1)
+        errs = []
+
+        def consumer():
+            try:
+                q.get()
+            except QueueClosed as e:
+                errs.append(e)
+
+        t = threading.Thread(target=consumer, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        q.close()
+        t.join(timeout=5)
+        assert errs
+
+    def test_file_queue_roundtrip_and_cursor(self, tmp_path):
+        q = FileExperienceQueue(str(tmp_path / "spool"), capacity=4)
+        payload = {
+            "tokens": np.arange(6, dtype=np.int32).reshape(2, 3),
+            "host": {"logprobs": np.ones((2, 3), np.float32)},
+            "host_s": 0.25,
+        }
+        q.put(ExperienceChunk(0, version=7, payload=payload))
+        assert q.committed_indices() == {0}
+        chunk = q.get(0, timeout=5)
+        assert chunk.version == 7
+        np.testing.assert_array_equal(chunk.payload["tokens"], payload["tokens"])
+        np.testing.assert_array_equal(
+            chunk.payload["host"]["logprobs"], payload["host"]["logprobs"]
+        )
+        assert chunk.payload["host_s"] == 0.25
+        # consumed: file deleted, cursor advanced — a respawned actor would
+        # skip index 0 entirely
+        assert q.committed_indices() == set()
+        assert q.cursor() == 1
+
+    def test_file_queue_get_timeout(self, tmp_path):
+        q = FileExperienceQueue(str(tmp_path / "spool"), poll_interval_s=0.01)
+        with pytest.raises(TimeoutError, match="actor dead or stalled"):
+            q.get(0, timeout=0.1)
+
+
+# ---------------------------------------------------------------------------
+# weight channel + staleness gate
+# ---------------------------------------------------------------------------
+
+
+class TestWeightChannel:
+    def test_publish_fetch_and_gate(self):
+        ch = WeightChannel()
+        ch.publish({"w": np.ones(2)}, version=1)
+        params, version = ch.fetch()
+        assert version == 1
+        # gate: target 3 with max_staleness 1 needs payload >= 2
+        ch.announce(3, collection=1)
+        assert not ch.ready(1)
+        ch.publish({"w": np.ones(2)}, version=2)
+        assert ch.ready(1)
+        assert not ch.ready(0)
+        ch.publish({"w": np.ones(2)}, version=3)
+        assert ch.ready(0)
+
+    def test_sync_every_thins_and_force_overrides(self):
+        m = _Metrics()
+        ch = WeightChannel(metrics=m, sync_every=2)
+        ch.publish({"w": 1}, version=1)  # thinned
+        assert ch._payload_version == -1
+        ch.publish({"w": 1}, version=1, force=True)
+        assert ch._payload_version == 1
+        ch.publish({"w": 1}, version=2)
+        assert ch._payload_version == 2
+        assert m.counts["async/weight_syncs"] == 2
+
+    def test_weight_sync_drop_fault_and_heal(self):
+        m = _Metrics()
+        plan = FaultPlan.parse("weight_sync_drop@version:2")
+        ch = WeightChannel(plan=plan, metrics=m)
+        ch.publish({"w": 1}, version=1)
+        ch.publish({"w": 2}, version=2)  # dropped deterministically
+        assert ch._payload_version == 1
+        assert m.counts["async/weight_sync_drops"] == 1
+        # the next publish heals — actors skip straight to version 3
+        ch.publish({"w": 3}, version=3)
+        assert ch.fetch()[1] == 3
+
+    def test_wait_ready_unblocks_on_publish(self):
+        ch = WeightChannel()
+        ch.publish({"w": 0}, version=0)
+        ch.announce(2, collection=1)
+        ready = []
+
+        def actor():
+            ready.append(ch.wait_ready(0, collection=1))
+
+        t = threading.Thread(target=actor, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        assert not ready  # gated: target 2, payload 0, staleness bound 0
+        ch.publish({"w": 2}, version=2)
+        t.join(timeout=5)
+        assert ready == [True]
+
+
+def test_fault_plan_new_triggers():
+    plan = FaultPlan.parse("actor_crash@collection:2; weight_sync_drop@version:3*2")
+    assert not plan.poll("actor_crash", collection=1)
+    assert plan.poll("actor_crash", collection=2)
+    assert not plan.poll("weight_sync_drop", version=2)
+    assert plan.poll("weight_sync_drop", version=3)
+    assert plan.poll("weight_sync_drop", version=4)  # *2 count
+    assert not plan.poll("weight_sync_drop", version=5)
+
+
+def test_engine_version_counter_memoization():
+    """The weight-sync path's per-segment swap check is one int compare: a
+    fresh copy of the SAME version must not flush; a new version must."""
+    from trlx_tpu.engine.core import ContinuousEngine
+
+    engine = ContinuousEngine.__new__(ContinuousEngine)  # counter logic only
+    engine.prefix = None
+    engine.spec = None
+    engine.allocator = None
+    params_a, params_b = {"w": 1}, {"w": 2}
+    engine.params = params_a
+    engine._kv_params = params_a
+    engine._params_version = 3
+    assert engine.swap_params(params_b, version=3) is False  # fresh copy, same version
+    assert engine.params is params_a
+    assert engine.swap_params(params_b, version=4) is True
+    assert engine.params is params_b and engine._params_version == 4
+    # unversioned path falls back to identity
+    engine._params_version = None
+    assert engine.swap_params(params_b) is False
+    assert engine.swap_params(params_a) is True
+
+
+# ---------------------------------------------------------------------------
+# trainer-level: bit-equivalence, crash requeue, staleness bound
+# ---------------------------------------------------------------------------
+
+PROMPTS = ["hello world", "the quick brown fox", "lorem ipsum", "foo bar"] * 4
+
+_STORE_FIELDS = ("query_tensor", "response_tensor", "logprobs", "values", "rewards")
+
+
+def _letter_reward(samples, prompts, outputs, **kwargs):
+    return [float(sum(c in "aeiou" for c in o)) for o in outputs]
+
+
+def _ppo_trainer(tmp_path, tag, cb=False, **overrides):
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401 (registration)
+    import trlx_tpu.trainer.ppo  # noqa: F401 (registration)
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+
+    cfg = default_ppo_config().evolve(
+        train=dict(
+            seq_length=48,
+            batch_size=8,
+            total_steps=4,
+            checkpoint_interval=1000,
+            eval_interval=1000,
+            checkpoint_dir=str(tmp_path / f"ckpts_{tag}"),
+            tracker=None,
+            rollout_pipeline_depth=0,
+            continuous_batching=cb,
+            continuous_batching_segment=4,
+        ),
+        model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+        method=dict(
+            num_rollouts=16,
+            chunk_size=4,
+            ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0, do_sample=True),
+        ),
+        **overrides,
+    )
+    trainer = get_trainer(cfg.train.trainer)(
+        config=cfg, reward_fn=_letter_reward, metric_fn=None, stop_sequences=[]
+    )
+    trainer.add_prompt_pipeline(
+        get_pipeline(cfg.train.pipeline)(PROMPTS, 40, trainer.tokenizer)
+    )
+    return trainer
+
+
+def _assert_stores_identical(store_a, store_b):
+    assert len(store_a) == len(store_b)
+    for a, b in zip(store_a.history, store_b.history):
+        for field in _STORE_FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+                err_msg=field,
+            )
+
+
+class TestAsyncThreadMode:
+    def test_max_staleness_zero_bit_identical_to_serial(self, tmp_path):
+        """The standing bit-equivalence constraint, extended to the new
+        subsystem: async thread mode, one actor, ``max_staleness: 0`` —
+        same store as the serial reference path, and no behavior-logprob
+        field leaks into the store while iw_correction is off."""
+        serial = _ppo_trainer(tmp_path, "serial")
+        asy = _ppo_trainer(
+            tmp_path, "async",
+            async_rl=dict(enabled=True, mode="thread", num_actors=1,
+                          max_staleness=0),
+        )
+        try:
+            serial.make_experience(16)
+            asy.make_experience(16)
+            _assert_stores_identical(serial.store, asy.store)
+            assert all(e.behavior_logprobs is None for e in asy.store.history)
+            stats = asy.make_experience_stats
+            assert stats["async/staleness_max"] == 0.0
+            assert stats["async/chunks"] == 4.0
+        finally:
+            asy._shutdown_collectors()
+
+    def test_actor_crash_requeued_respawned_still_bit_identical(self, tmp_path):
+        """``actor_crash@collection:1`` kills the actor on its first chunk:
+        the supervisor requeues the chunk, respawns the actor, and the
+        regenerated chunk is identical — the crash is invisible in the
+        store."""
+        serial = _ppo_trainer(tmp_path, "serial")
+        crash = _ppo_trainer(
+            tmp_path, "crash",
+            async_rl=dict(enabled=True, mode="thread", num_actors=1,
+                          max_staleness=0),
+            resilience=dict(fault_plan="actor_crash@collection:1"),
+        )
+        try:
+            serial.make_experience(16)
+            crash.make_experience(16)
+            snap = crash.obs.metrics.snapshot(reset_histograms=False)
+            assert snap.get("async/actor_restarts") == 1.0, snap
+            assert snap.get("async/requeued_chunks") == 1.0, snap
+            _assert_stores_identical(serial.store, crash.store)
+        finally:
+            crash._shutdown_collectors()
+
+    def test_learn_overlap_staleness_bounded_and_iw_recorded(self, tmp_path):
+        """Full async train run: the actor generates collection 2 DURING the
+        learn phase under in-flight published weights; staleness at
+        consumption never exceeds the bound; with ``iw_correction: clip``
+        the sampler's behavior logprobs ride into store and loss."""
+        import trlx_tpu.trlx as trlx
+        from trlx_tpu.data.default_configs import default_ppo_config
+
+        cfg = default_ppo_config().evolve(
+            train=dict(seq_length=48, batch_size=8, total_steps=4,
+                       checkpoint_interval=1000, eval_interval=1000,
+                       checkpoint_dir=str(tmp_path / "ckpt_learn"),
+                       tracker=None, epochs=2),
+            model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+            method=dict(num_rollouts=16, chunk_size=4, ppo_epochs=1,
+                        iw_correction="clip", iw_clip=2.0,
+                        gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                                        do_sample=True)),
+            async_rl=dict(enabled=True, mode="thread", num_actors=1,
+                          max_staleness=2),
+        )
+        trainer = trlx.train(
+            reward_fn=_letter_reward, prompts=PROMPTS, config=cfg
+        )
+        stats = trainer.make_experience_stats
+        assert stats["async/staleness_max"] <= 2.0, stats
+        snap = trainer.obs.metrics.snapshot(reset_histograms=False)
+        assert snap.get("async/weight_syncs", 0) >= 1, snap
+        # behavior logprobs recorded (iw on) and finite
+        assert all(e.behavior_logprobs is not None for e in trainer.store.history)
+        # actors were shut down by learn()'s finally
+        assert not any(
+            t.name.startswith("trlx-async-actor") and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+
+def test_drop_oldest_regenerates_evicted_chunks(tmp_path):
+    """drop_oldest under heavy overproduction (capacity 1, loose staleness):
+    evicted chunks must be REGENERATED — the run completes instead of the
+    learner waiting forever on an evicted index."""
+    import trlx_tpu.trlx as trlx
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    cfg = default_ppo_config().evolve(
+        train=dict(seq_length=48, batch_size=8, total_steps=4,
+                   checkpoint_interval=1000, eval_interval=1000,
+                   checkpoint_dir=str(tmp_path / "ckpt"), tracker=None,
+                   epochs=2),
+        model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+        method=dict(num_rollouts=16, chunk_size=4, ppo_epochs=1,
+                    gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                                    do_sample=True)),
+        async_rl=dict(enabled=True, mode="thread", num_actors=1,
+                      max_staleness=8, queue_capacity=1,
+                      queue_policy="drop_oldest"),
+    )
+    trainer = trlx.train(reward_fn=_letter_reward, prompts=PROMPTS, config=cfg)
+    assert len(trainer.store) == 16  # the run completed
+    snap = trainer.obs.metrics.snapshot(reset_histograms=False)
+    if snap.get("async/dropped_chunks", 0):
+        # every eviction was matched by a regeneration requeue
+        assert snap.get("async/requeued_chunks", 0) >= snap["async/dropped_chunks"]
+
+
+def test_grpo_async_thread_mode(tmp_path):
+    """GRPO rides the same collector: group fan-out happens on the actor,
+    group-relative advantages + elements on the learner, behavior logprobs
+    recorded for the IW loss."""
+    import trlx_tpu.trainer.grpo  # noqa: F401 (registration)
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+
+    cfg = default_ppo_config().evolve(
+        train=dict(seq_length=48, batch_size=8, total_steps=2,
+                   trainer="GRPOTrainer", checkpoint_interval=1000,
+                   eval_interval=1000,
+                   checkpoint_dir=str(tmp_path / "ckpt"), tracker=None),
+        model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+        method=dict(name="GRPOConfig", num_rollouts=16, chunk_size=8,
+                    group_size=4, ppo_epochs=1, iw_correction="clip",
+                    gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                                    do_sample=True)),
+        async_rl=dict(enabled=True, mode="thread", num_actors=1,
+                      max_staleness=1),
+    )
+    trainer = get_trainer(cfg.train.trainer)(
+        config=cfg, reward_fn=_letter_reward, metric_fn=None, stop_sequences=[]
+    )
+    trainer.add_prompt_pipeline(
+        get_pipeline(cfg.train.pipeline)(PROMPTS, 40, trainer.tokenizer)
+    )
+    try:
+        trainer.make_experience(16)
+        assert len(trainer.store) == 16
+        assert all(e.behavior_logprobs is not None for e in trainer.store.history)
+        # group-contiguous advantages: each group of 4 centers to ~0
+        adv = np.asarray([e.advantage for e in trainer.store.history])
+        np.testing.assert_allclose(adv.reshape(-1, 4).mean(axis=1), 0.0, atol=1e-5)
+        assert trainer.make_experience_stats["async/chunks"] == 2.0
+    finally:
+        trainer._shutdown_collectors()
+
+
+@pytest.mark.slow
+def test_ppo_async_continuous_batching_in_flight(tmp_path):
+    """Async actors over the slot-refill engine: two actor threads, each
+    with its own ContinuousEngine, adopting published params at segment
+    boundaries (swap_params) — the PipelineRL-style in-flight path."""
+    trainer = _ppo_trainer(
+        tmp_path, "cb_async",
+        async_rl=dict(enabled=True, mode="thread", num_actors=2,
+                      max_staleness=2),
+        cb=True,
+    )
+    try:
+        trainer.make_experience(16)
+        assert len(trainer.store) == 16
+        stats = trainer.make_experience_stats
+        assert stats["async/chunks"] == 4.0
+        assert stats["async/staleness_max"] <= 2.0
+        assert stats["throughput/slot_utilization"] > 0.0
+    finally:
+        trainer._shutdown_collectors()
+
+
+# ---------------------------------------------------------------------------
+# process mode: learner + remote actor, crash + respawn (the 2-process e2e)
+# ---------------------------------------------------------------------------
+
+_COMMON = textwrap.dedent(
+    """
+    import os, sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, {repo!r})
+    import hashlib
+    import numpy as np
+
+    PROMPTS = ["hello world", "the quick brown fox", "lorem ipsum", "foo bar"] * 4
+
+    def reward_fn(samples=None, prompts=None, outputs=None, **kw):
+        return [float(sum(c in "aeiou" for c in o)) for o in outputs]
+
+    def base_config(ckpt_dir, fault=None):
+        from trlx_tpu.data.default_configs import default_ppo_config
+        return default_ppo_config().evolve(
+            train=dict(seq_length=48, batch_size=8, total_steps=2,
+                       checkpoint_interval=1000, eval_interval=1000,
+                       checkpoint_dir=ckpt_dir, tracker=None, epochs=2),
+            model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+            method=dict(num_rollouts=16, chunk_size=4, ppo_epochs=1,
+                        iw_correction="clip",
+                        gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                                        do_sample=True)),
+            async_rl=dict(enabled=True, mode="process", max_staleness=2,
+                          root_dir={root!r}, actor_timeout_s=240.0),
+            resilience=dict(fault_plan=fault),
+        )
+
+    def store_hash(store):
+        h = hashlib.sha256()
+        for e in store.history:
+            for f in ("query_tensor", "response_tensor", "logprobs", "values",
+                      "rewards"):
+                h.update(np.ascontiguousarray(
+                    np.asarray(getattr(e, f), np.float64)).tobytes())
+        return h.hexdigest()
+    """
+)
+
+# The actor worker: crashes deterministically in collection 2 (rc != 0); the
+# test's supervisor loop relaunches it and the respawn fast-forwards past
+# committed chunks — requeue-on-actor-death, process flavor.
+ACTOR_WORKER = _COMMON + textwrap.dedent(
+    """
+    from trlx_tpu.async_rl.actor import run_actor
+
+    cfg = base_config({ckpt!r}, fault="actor_crash@collection:2")
+    n = run_actor(cfg, reward_fn=reward_fn, prompts=PROMPTS)
+    print("ACTOR_DONE", n, flush=True)
+    """
+)
+
+# The learner worker: hashes a serial reference collection first, then runs
+# the async learner end-to-end (collection 1 → learn phase with in-flight
+# publishes → collection 2 → learn) and checks bit-identity + staleness.
+LEARNER_WORKER = _COMMON + textwrap.dedent(
+    """
+    import trlx_tpu.trlx as trlx
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401
+    import trlx_tpu.trainer.ppo  # noqa: F401
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+
+    # serial reference for collection 1 (async off, same seed): with
+    # max_staleness such that collection 1 is consumed at version 0, the
+    # async store must match it bit-for-bit
+    ref_cfg = base_config({ckpt!r} + "_ref").evolve(
+        async_rl=dict(enabled=False), method=dict(iw_correction="off"))
+    ref = get_trainer(ref_cfg.train.trainer)(
+        config=ref_cfg, reward_fn=reward_fn, metric_fn=None, stop_sequences=[])
+    ref.add_prompt_pipeline(
+        get_pipeline(ref_cfg.train.pipeline)(PROMPTS, 40, ref.tokenizer))
+    ref.make_experience(16)
+    ref_hash = store_hash(ref.store)
+
+    cfg = base_config({ckpt!r})
+    captured = {{}}
+    orig = None
+    def hook(trainer):
+        global orig
+        orig = type(trainer).make_experience
+        def capture(self, num_rollouts=1024, iter_count=0):
+            orig(self, num_rollouts, iter_count)
+            captured.setdefault("first_hash", store_hash(self.store))
+            stales = captured.setdefault("staleness", [])
+            stales.append(self.make_experience_stats.get("async/staleness_max"))
+        type(trainer).make_experience = capture
+    t = trlx.train(reward_fn=reward_fn, prompts=PROMPTS, config=cfg,
+                   init_trainer_hook=hook)
+    type(t).make_experience = orig
+    assert captured["first_hash"] == ref_hash, (
+        "async collection-1 store diverged from the serial reference")
+    assert all(s is not None and s <= 2 for s in captured["staleness"]), captured
+    snap = t.obs.metrics.snapshot(reset_histograms=False)
+    assert snap.get("async/weight_syncs", 0) >= 1, snap
+    print("LEARNER_OK", captured["staleness"], flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_process_mode_learner_plus_remote_actor_with_crash(tmp_path):
+    """The disaggregated e2e acceptance: a learner process and ONE remote
+    actor process train PPO with in-flight weight sync over the filesystem
+    transport; staleness never exceeds ``max_staleness``; the injected
+    ``actor_crash@collection:2`` kills the actor mid-run, the supervisor
+    relaunch fast-forwards it past committed chunks (requeue) and the run
+    completes; the ``max_staleness``-0-equivalent first collection (consumed
+    at version 0) is bit-identical to the serial reference."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = str(tmp_path / "transport")
+    fmt = dict(repo=repo, root=root, ckpt=str(tmp_path / "ckpt"))
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def spawn(src):
+        return subprocess.Popen(
+            [sys.executable, "-c", src.format(**fmt)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+
+    learner = spawn(LEARNER_WORKER)
+    actor_logs = []
+    actor_rcs = []
+    try:
+        # actor supervisor: relaunch on nonzero exit (the injected crash) —
+        # the deployment-level respawn loop (k8s restartPolicy stand-in)
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            actor = spawn(ACTOR_WORKER)
+            out = actor.communicate(timeout=600)[0]
+            actor_logs.append(out)
+            actor_rcs.append(actor.returncode)
+            if actor.returncode == 0 or learner.poll() is not None:
+                break
+        learner_out = learner.communicate(timeout=600)[0]
+    finally:
+        if learner.poll() is None:
+            learner.kill()
+            learner.wait(timeout=30)
+        if learner.stdout is not None:
+            learner.stdout.close()
+    assert learner.returncode == 0, learner_out[-3000:]
+    assert "LEARNER_OK" in learner_out, learner_out[-3000:]
+    # the crash actually fired (first actor incarnation died nonzero) and a
+    # respawn completed cleanly
+    assert actor_rcs[0] != 0, (actor_rcs, actor_logs[0][-2000:])
+    assert actor_rcs[-1] == 0, (actor_rcs, actor_logs[-1][-2000:])
+    assert any("actor_crash@collection:2" in log for log in actor_logs), (
+        actor_logs[0][-2000:]
+    )

@@ -70,6 +70,12 @@ def test_scanner_sees_the_codebase():
     # flight-recorder + observability self-accounting keys
     assert "flightrec/dumps" in keys
     assert "obs/spans_dropped" in keys
+    # async actor/learner keys (docs/ASYNC_RL.md): the collector's
+    # collection gauges and the queue/channel/supervisor counters
+    assert "async/chunks" in keys
+    assert "async/staleness_mean" in keys
+    assert "async/actor_restarts" in keys
+    assert "async/weight_syncs" in keys
 
 
 def test_engine_keys_registered_and_namespaced():

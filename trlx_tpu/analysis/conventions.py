@@ -122,6 +122,31 @@ CLUSTER_KEYS = frozenset({
     "cluster/straggler_rank",
 })
 
+# Canonical async actor/learner keys (trlx_tpu/async_rl/, docs/ASYNC_RL.md):
+# the learner-side collection gauges (queue depth, staleness at consumption,
+# actor idle fraction) plus the counters the queue/channel/supervisor emit.
+# async/staleness is additionally observed as a histogram, so the tracker
+# stream carries async/staleness_mean|_max|_count summaries per window.
+ASYNC_KEYS = frozenset({
+    "async/chunks",
+    "async/queue_depth",
+    "async/staleness_mean",
+    "async/staleness_max",
+    "async/learner_wait_s",
+    "async/actor_idle_frac",
+    "async/dropped_chunks",
+    "async/requeued_chunks",
+    "async/actor_restarts",
+    "async/weight_syncs",
+    "async/weight_sync_drops",
+})
+
+# Canonical async span names (GL502-namespaced; the actor's per-chunk span
+# lands on its own thread track in the merged trace).
+ASYNC_SPAN_NAMES = frozenset({
+    "async/actor_chunk",
+})
+
 # Crash flight recorder accounting (observability/flightrec.py,
 # docs/OBSERVABILITY.md "Flight recorder").
 FLIGHTREC_KEYS = frozenset({

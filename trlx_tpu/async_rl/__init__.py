@@ -1,0 +1,72 @@
+"""Disaggregated async RL: actor/learner split with a staleness-bounded
+experience queue and in-flight weight sync (ROADMAP item 1).
+
+The single-program loop alternates ``make_experience`` and ``learn`` — the
+device is either generating or training, never both. This subsystem splits
+training into one **learner** and N **generation actors**:
+
+- **thread mode** (``async_rl.mode: thread``): actors are in-process
+  threads over the existing Engine paths; the learner is the main thread's
+  train loop. The split overlaps host-side rollout work (string decode,
+  ``reward_fn``, device→host fetches) *and* whole collections with
+  optimization — collection k+1 is generated while the learner optimizes
+  on collection k.
+- **process mode** (``async_rl.mode: process``): actors are separate
+  processes (their own JAX runtime, their own devices — on a pod, their
+  own slice) connected through a filesystem transport: an atomic
+  weight-dissemination directory (RLAX-style param path) and a chunk spool
+  the learner consumes. Provable on the 2-process CPU harness.
+
+The two halves meet at two seams:
+
+- :class:`~trlx_tpu.async_rl.queue.ExperienceQueue` — a bounded buffer of
+  version-tagged experience chunks. Capacity back-pressures actors
+  (``block``) or evicts the oldest chunk (``drop_oldest``).
+- :class:`~trlx_tpu.async_rl.channel.WeightChannel` — the learner
+  publishes params after each optimizer update (version = completed
+  update count) and announces the version at which the next collection
+  will be consumed; actors adopt the newest payload at chunk *and* segment
+  boundaries (PipelineRL-style in-flight updates, riding
+  ``ContinuousEngine.swap_params``'s version-counter check so unchanged
+  params never re-walk the tree and changed params flush the prefix
+  cache — stale shared KV is never reused).
+
+**Staleness bound.** A chunk's staleness is the number of learner updates
+between the params that *started* it and the learner's version when it is
+consumed. The learner announces ``target`` = the version at which the next
+collection drains; an actor may only start a chunk once the newest
+published payload satisfies ``target − version ≤ max_staleness``. With
+``max_staleness: 0`` the gate degenerates to the alternating loop — the
+store is bit-identical to the serial reference path under a fixed seed
+(``tests/test_async_rl.py``). Off-policy lag is corrected in the loss by
+the clipped per-token behavior-logprob ratio (``method.iw_correction``),
+off by default.
+
+Crash containment leans on the resilience subsystem: a dead actor's
+in-flight chunk spec (prompts + RNG) is requeued and a replacement actor
+respawned (thread mode), or the respawned actor process fast-forwards to
+the first uncommitted chunk (process mode) — deterministic either way,
+exercised by the ``actor_crash@collection:N`` fault.
+
+Semantics, knobs, and deployment notes: docs/ASYNC_RL.md.
+"""
+
+from trlx_tpu.async_rl.channel import FileWeightChannel, WeightChannel
+from trlx_tpu.async_rl.queue import (
+    ExperienceChunk,
+    ExperienceQueue,
+    FileExperienceQueue,
+    QueueClosed,
+)
+from trlx_tpu.async_rl.runtime import AsyncCollector, ChunkSpec
+
+__all__ = [
+    "AsyncCollector",
+    "ChunkSpec",
+    "ExperienceChunk",
+    "ExperienceQueue",
+    "FileExperienceQueue",
+    "FileWeightChannel",
+    "QueueClosed",
+    "WeightChannel",
+]

@@ -1,0 +1,166 @@
+"""Process-mode generation actor: a standalone process that builds the same
+trainer (its own JAX runtime, devices, and prompt stream), adopts learner
+weights from the file channel, and spools experience chunks for the learner.
+
+Launch one per actor slice alongside the learner::
+
+    # learner process
+    cfg = cfg.evolve(async_rl=dict(enabled=True, mode="process",
+                                   root_dir="/shared/async"))
+    trlx.train(reward_fn=reward_fn, prompts=prompts, config=cfg)
+
+    # actor process(es), same config + callbacks
+    from trlx_tpu.async_rl.actor import run_actor
+    run_actor(cfg, reward_fn=reward_fn, prompts=prompts)
+
+Determinism and crash recovery: the chunk stream (prompt batches + per-chunk
+RNG) is derived from ``train.seed`` exactly as the learner's serial path
+would derive it, so chunk ``i`` is reproducible by any actor incarnation. A
+respawned actor fast-forwards past chunks already committed to the spool
+(or consumed past the learner's cursor) and regenerates the one that died —
+requeue-on-actor-death without any coordination beyond the spool directory.
+The ``actor_crash@collection:N`` fault kills the process deterministically
+(once — a marker file stops a respawned actor from re-firing it); the
+supervisor relaunching the actor is deployment-specific (a shell loop in
+the tests, a k8s restart policy in production).
+
+The actor exits cleanly when the learner marks the spool DONE.
+"""
+
+import os
+import time
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from trlx_tpu.async_rl.channel import FileWeightChannel
+from trlx_tpu.async_rl.queue import ExperienceChunk, FileExperienceQueue, QueueClosed
+from trlx_tpu.async_rl.runtime import ChunkSpec
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+__all__ = ["run_actor"]
+
+
+def chunks_per_collection(config: Any) -> int:
+    """Chunks one collection consumes (deterministic collection tagging for
+    ``actor_crash@collection:N``): ``ceil(num_rollouts / chunk_size)``."""
+    rollouts = int(config.method.num_rollouts)
+    chunk = max(1, int(config.method.chunk_size))
+    return max(1, -(-rollouts // chunk))
+
+
+def run_actor(
+    config: Any,
+    reward_fn: Optional[Callable] = None,
+    prompts: Optional[List[str]] = None,
+    stop_sequences: Optional[List[str]] = None,
+    max_chunks: Optional[int] = None,
+) -> int:
+    """Run one generation actor until the learner marks the spool DONE (or
+    ``max_chunks`` commits). Returns the number of chunks produced."""
+    from trlx_tpu.trlx import initialize_runtime
+
+    initialize_runtime()
+    import importlib
+
+    for module in ("trlx_tpu.pipeline.offline_pipeline", "trlx_tpu.trainer.ppo",
+                   "trlx_tpu.trainer.grpo"):
+        importlib.import_module(module)
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+    from trlx_tpu.utils import set_seed
+
+    acfg = config.async_rl
+    if not acfg.root_dir:
+        raise ValueError("async_rl.root_dir is required in process mode")
+    set_seed(config.train.seed)
+    trainer = get_trainer(config.train.trainer)(
+        config=config,
+        reward_fn=reward_fn,
+        metric_fn=None,
+        stop_sequences=stop_sequences or [],
+        **config.train.trainer_kwargs,
+    )
+    batch_size = config.train.batch_size
+    max_prompt_length = (
+        config.train.seq_length - config.method.gen_kwargs["max_new_tokens"]
+    )
+    prompts = prompts or [trainer.tokenizer.bos_token] * batch_size
+    trainer.add_prompt_pipeline(
+        get_pipeline(config.train.pipeline)(prompts, max_prompt_length, trainer.tokenizer)
+    )
+
+    queue = FileExperienceQueue(
+        os.path.join(acfg.root_dir, "spool"),
+        capacity=trainer._async_queue_capacity(),
+        poll_interval_s=acfg.poll_interval_s,
+    )
+    channel = FileWeightChannel(
+        os.path.join(acfg.root_dir, "weights"),
+        poll_interval_s=acfg.poll_interval_s,
+    )
+    plan = trainer.resilience.plan
+    per_collection = chunks_per_collection(config)
+    max_staleness = max(0, int(acfg.max_staleness))
+
+    import jax
+
+    rng = trainer._rollout_rng
+    produced = 0
+    index = 0
+    while not queue.done and (max_chunks is None or produced < max_chunks):
+        # the draw stream advances for EVERY index — committed chunks are
+        # skipped but their prompt/RNG draws are burned, so a respawned
+        # actor's stream position matches the original's
+        batch = next(trainer.prompt_iterator)
+        rng, chunk_rng = jax.random.split(rng)
+        committed = queue.committed_indices()
+        cursor = queue.cursor()
+        if index < cursor or index in committed:
+            index += 1
+            continue
+        spec = ChunkSpec(
+            index=index,
+            collection=index // per_collection + 1,
+            prompt_ids=np.asarray(batch["input_ids"], np.int32),
+            prompt_mask=np.asarray(batch["attention_mask"], np.int32),
+            rng=chunk_rng,
+        )
+        # staleness gate: wait until starting this collection's chunk under
+        # the newest payload satisfies the bound, and never run more than
+        # one collection ahead of the learner's announcements (bail out if
+        # the learner finishes first)
+        while not channel.ready(max_staleness, spec.collection):
+            if queue.done:
+                return produced
+            time.sleep(channel.poll)
+        params, version = channel.fetch(template=trainer.state.params)
+        if plan:
+            marker = os.path.join(
+                acfg.root_dir, f"actor_crash_fired_{spec.collection}"
+            )
+            if not os.path.exists(marker) and plan.poll(
+                "actor_crash", collection=spec.collection
+            ):
+                with open(marker, "w") as f:
+                    f.write("fired\n")
+                from trlx_tpu.resilience.faults import InjectedFault
+
+                logger.warning(
+                    f"fault plan: actor crashing in collection {spec.collection} "
+                    f"(chunk {spec.index})"
+                )
+                raise InjectedFault(
+                    f"actor_crash@collection:{spec.collection} (chunk {spec.index})"
+                )
+        payload = trainer._async_produce_chunk(spec, params, version, channel)
+        try:
+            queue.put(ExperienceChunk(spec.index, version, payload))
+        except QueueClosed:
+            break
+        trainer.obs.metrics.inc("async/chunks")
+        produced += 1
+        index += 1
+    return produced

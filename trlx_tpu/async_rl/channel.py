@@ -1,0 +1,336 @@
+"""The weight-sync channel: learner → actors param dissemination with
+versioned publishes and a staleness gate.
+
+The learner publishes its params after each optimizer update (``version`` =
+completed update count; thinned by ``async_rl.sync_every``) and *announces*
+``(collection, target)``: the version at which collection ``collection``
+will be consumed. Actors gate each chunk on its own collection::
+
+    chunk.collection >  announced collection → wait (its consumption
+                                               version is unknown — running
+                                               further ahead could exceed
+                                               any bound)
+    chunk.collection == announced collection → wait until
+                                               target − newest_payload_version
+                                               ≤ max_staleness
+    chunk.collection <  announced collection → free (its consumption point
+                                               has already arrived)
+
+which bounds staleness at consumption structurally — no chunk can start
+under params older than the bound allows, production never runs more than
+one collection ahead of consumption, and the learner re-publishes +
+re-announces at drain start so an over-estimated target (or a dropped
+publish) can never deadlock the gate.
+
+Publishes deep-copy the param tree: the train step donates its input
+state, so a published reference into ``state.params`` would be invalidated
+by the next update while an actor is mid-generation under it.
+
+The deterministic ``weight_sync_drop@version:N`` fault drops the payload of
+publish N (actors keep version N−1's params until the next publish) — the
+reproducible exercise of the staleness/IW-correction path.
+
+Two transports: :class:`WeightChannel` (in-process, thread mode) and
+:class:`FileWeightChannel` (atomic weights file + manifest, process mode —
+the filesystem stand-in for RLAX's param-dissemination tree).
+"""
+
+import json
+import os
+import threading
+import time
+import zipfile
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["WeightChannel", "FileWeightChannel"]
+
+
+def _copy_params(params: Any) -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(jnp.copy, params)
+
+
+class WeightChannel:
+    """In-process learner→actor param channel (thread mode)."""
+
+    def __init__(self, plan: Any = None, metrics: Any = None, sync_every: int = 1):
+        self._plan = plan
+        self.metrics = metrics
+        self.sync_every = max(1, int(sync_every))
+        self._cond = threading.Condition()
+        self._params: Any = None  # guarded-by: _cond
+        self._payload_version = -1  # guarded-by: _cond
+        self._target = 0  # guarded-by: _cond
+        self._announced_col = 0  # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
+
+    def publish(self, params: Any, version: int, force: bool = False) -> None:
+        """Publish ``params`` as ``version``. Thinned by ``sync_every``
+        unless ``force`` (the learner forces at phase boundaries so actors
+        always see the consumption-time params). The ``weight_sync_drop``
+        fault drops this publish's payload deterministically."""
+        if not force and version % self.sync_every != 0:
+            return
+        with self._cond:
+            if version <= self._payload_version:
+                return  # already-published version (boundary force republish)
+                # — checked BEFORE the full-tree copy below, which is a real
+                # allocation at model scale
+        if self._plan is not None and self._plan.poll("weight_sync_drop", version=version):
+            if self.metrics is not None:
+                self.metrics.inc("async/weight_sync_drops")
+            return
+        copied = _copy_params(params)
+        with self._cond:
+            if version <= self._payload_version:
+                return  # lost a publish race while copying
+            self._params = copied
+            self._payload_version = version
+            self._cond.notify_all()
+        if self.metrics is not None:
+            self.metrics.inc("async/weight_syncs")
+
+    def announce(self, target: int, collection: int) -> None:
+        """Record that collection ``collection`` will be consumed at version
+        ``target``. The collection index is monotonic; a LATER announcement
+        for the SAME collection may lower the target — the drain-start
+        announce carries the true consumption version, which heals an
+        over-estimated phase-end target (a learn phase that ran fewer
+        updates than predicted must not gate actors forever)."""
+        with self._cond:
+            if int(collection) > self._announced_col:
+                self._announced_col = int(collection)
+                self._target = int(target)
+            elif int(collection) == self._announced_col:
+                self._target = min(self._target, int(target))
+            self._cond.notify_all()
+
+    def fetch(self, template: Any = None) -> Tuple[Any, int]:
+        """Newest published (params, version); blocks until the first
+        publish lands. ``template`` is accepted for transport symmetry with
+        :class:`FileWeightChannel` (in-process payloads need no restore)."""
+        with self._cond:
+            while self._params is None:
+                if self._closed:
+                    raise RuntimeError("weight channel closed before first publish")
+                self._cond.wait(timeout=0.1)
+            return self._params, self._payload_version
+
+    def _gate(self, max_staleness: int, collection: int) -> bool:
+        # caller holds _cond
+        if self._params is None or collection > self._announced_col:
+            return False
+        if collection < self._announced_col:
+            return True  # its consumption point has already arrived
+        return self._target - self._payload_version <= max_staleness
+
+    def ready(self, max_staleness: int, collection: int = 1) -> bool:
+        """Non-blocking gate check: may a chunk of ``collection`` start
+        under the newest payload without violating the staleness bound?"""
+        with self._cond:
+            return self._gate(max_staleness, collection)
+
+    def wait_ready(
+        self,
+        max_staleness: int,
+        collection: int = 1,
+        stop: Optional[threading.Event] = None,
+    ) -> bool:
+        """Block until starting a chunk of ``collection`` under the newest
+        payload satisfies the staleness bound. Returns False when
+        closed/stopped."""
+        with self._cond:
+            while True:
+                if self._closed or (stop is not None and stop.is_set()):
+                    return False
+                if self._gate(max_staleness, collection):
+                    return True
+                self._cond.wait(timeout=0.05)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+class FileWeightChannel:
+    """Atomic file-backed param channel (process mode).
+
+    Layout under ``root``: ``weights.npz`` (flattened leaf list, tmp+rename
+    committed) and ``MANIFEST.json`` (``{"version": payload version,
+    "target": phase-end target}``). The manifest is written after the
+    weights file; the version stamped inside the npz lets a reader detect a
+    racing overwrite and retry. Readers cache the last adopted version, so
+    polling is one small JSON read until something actually changes.
+    """
+
+    MANIFEST = "MANIFEST.json"
+    WEIGHTS = "weights.npz"
+
+    def __init__(
+        self,
+        root: str,
+        plan: Any = None,
+        metrics: Any = None,
+        sync_every: int = 1,
+        poll_interval_s: float = 0.02,
+    ):
+        self.root = root
+        self._plan = plan
+        self.metrics = metrics
+        self.sync_every = max(1, int(sync_every))
+        self.poll = float(poll_interval_s)
+        os.makedirs(root, exist_ok=True)
+        self._cache: Tuple[Any, int] = (None, -1)
+        self._closed = False
+
+    # -- learner side ----------------------------------------------------
+
+    def _read_manifest(self) -> dict:
+        try:
+            with open(os.path.join(self.root, self.MANIFEST)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {"version": -1, "target": 0}
+
+    def _write_manifest(self, manifest: dict) -> None:
+        path = os.path.join(self.root, self.MANIFEST)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, path)
+
+    def publish(self, params: Any, version: int, force: bool = False) -> None:
+        if not force and version % self.sync_every != 0:
+            return
+        if self._plan is not None and self._plan.poll("weight_sync_drop", version=version):
+            if self.metrics is not None:
+                self.metrics.inc("async/weight_sync_drops")
+            return
+        manifest = self._read_manifest()
+        if version <= int(manifest.get("version", -1)):
+            return
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(jax.device_get(params))
+        arrays = {"__version__": np.asarray(version, np.int64)}
+        for i, leaf in enumerate(leaves):
+            arr = np.asarray(leaf)
+            if arr.dtype.kind == "V":  # bf16 → f32 is exact; cast back on load
+                arr = arr.astype(np.float32)
+            arrays[f"leaf_{i:05d}"] = arr
+        path = os.path.join(self.root, self.WEIGHTS)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+        manifest["version"] = version
+        self._write_manifest(manifest)
+        if self.metrics is not None:
+            self.metrics.inc("async/weight_syncs")
+
+    def announce(self, target: int, collection: int) -> None:
+        """Same semantics as :meth:`WeightChannel.announce`: monotonic
+        collection, same-collection announcements may LOWER the target
+        (the drain-start heal)."""
+        manifest = self._read_manifest()
+        old_target = int(manifest.get("target", 0))
+        old_col = int(manifest.get("collection", 0))
+        if int(collection) > old_col:
+            new_col, new_target = int(collection), int(target)
+        elif int(collection) == old_col:
+            new_col, new_target = old_col, min(old_target, int(target))
+        else:
+            return
+        if new_target == old_target and new_col == old_col:
+            return  # no-op announce (the drain-time heal path) — skip the write
+        manifest["target"] = new_target
+        manifest["collection"] = new_col
+        self._write_manifest(manifest)
+
+    # -- actor side ------------------------------------------------------
+
+    def fetch(self, template: Any = None) -> Tuple[Any, int]:
+        """Newest published (params, version), restored into ``template``'s
+        tree structure/dtypes (the actor's own built params). Blocks until
+        the first publish lands."""
+        manifest = self._read_manifest()
+        while int(manifest.get("version", -1)) < 0:
+            if self._closed:
+                raise RuntimeError("weight channel closed before first publish")
+            time.sleep(self.poll)
+            manifest = self._read_manifest()
+        version = int(manifest["version"])
+        if version == self._cache[1]:
+            return self._cache
+        import jax
+
+        path = os.path.join(self.root, self.WEIGHTS)
+        leaves = None
+        for _attempt in range(50):
+            try:
+                with np.load(path) as data:
+                    stamped = int(data["__version__"])
+                    read = [data[k] for k in sorted(data.files) if k.startswith("leaf_")]
+            except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+                time.sleep(self.poll)  # mid-replace read; retry
+                continue
+            if stamped < version:
+                time.sleep(self.poll)  # manifest ahead of a racing writer
+                continue
+            # a payload at least as new as the manifest promised: adopt it
+            # under ITS stamped version (never mislabel old leaves new)
+            version = stamped
+            leaves = read
+            break
+        if leaves is None:
+            raise RuntimeError(
+                f"weight channel: no readable payload >= version {version} "
+                f"at {path} after 50 attempts — writer dead or directory "
+                "corrupted?"
+            )
+        if template is not None:
+            treedef = jax.tree_util.tree_structure(template)
+            tleaves = jax.tree_util.tree_leaves(template)
+            leaves = [
+                np.asarray(leaf).astype(t.dtype) if hasattr(t, "dtype") else leaf
+                for leaf, t in zip(leaves, tleaves)
+            ]
+            params = jax.tree_util.tree_unflatten(treedef, leaves)
+        else:
+            params = leaves
+        self._cache = (params, version)
+        return self._cache
+
+    def ready(self, max_staleness: int, collection: int = 1) -> bool:
+        """Non-blocking gate check: may a chunk of ``collection`` start
+        under the newest payload without violating the staleness bound?"""
+        manifest = self._read_manifest()
+        version = int(manifest.get("version", -1))
+        target = int(manifest.get("target", 0))
+        announced_col = int(manifest.get("collection", 0))
+        if version < 0 or collection > announced_col:
+            return False
+        if collection < announced_col:
+            return True
+        return target - version <= max_staleness
+
+    def wait_ready(
+        self,
+        max_staleness: int,
+        collection: int = 1,
+        stop: Optional[threading.Event] = None,
+    ) -> bool:
+        while True:
+            if self._closed or (stop is not None and stop.is_set()):
+                return False
+            if self.ready(max_staleness, collection):
+                return True
+            time.sleep(self.poll)
+
+    def close(self) -> None:
+        self._closed = True

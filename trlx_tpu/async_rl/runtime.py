@@ -1,0 +1,386 @@
+"""The async collection runtime: chunk dispatch, actor supervision, and the
+learner-side ordered drain.
+
+One :class:`AsyncCollector` per trainer (built lazily at the first async
+``make_experience``). Thread mode spawns ``num_actors`` actor threads that
+pull :class:`ChunkSpec`\\ s from a deterministic dispatcher (prompt batch +
+per-chunk RNG drawn in index order — exactly the serial path's draw
+sequence), gate on the weight channel's staleness bound, produce chunk
+payloads through the trainer's ``_async_produce_chunk``, and commit them to
+the experience queue. Process mode spawns nothing: remote actors (see
+``async_rl/actor.py``) feed a :class:`FileExperienceQueue` and the
+collector only consumes.
+
+Determinism and crash containment:
+
+- the learner finalizes chunks strictly in index order (a reorder buffer
+  absorbs multi-actor completion races), so order-sensitive learner state
+  (PPO's reward running moments) folds chunks exactly as the serial path
+  would;
+- a dying actor's in-flight spec is REQUEUED at the front of the dispatch
+  queue and a replacement actor thread is spawned — the respawned actor
+  regenerates the identical chunk (same prompts, same RNG), so with
+  ``max_staleness: 0`` a crash is invisible in the store
+  (``tests/test_async_rl.py``). The deterministic
+  ``actor_crash@collection:N`` fault drives this path on demand; it fires
+  at most once per matching collection (the requeue covers the retry).
+"""
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from trlx_tpu.async_rl.queue import ExperienceChunk, ExperienceQueue, QueueClosed
+
+__all__ = ["AsyncCollector", "ChunkSpec"]
+
+
+@dataclass
+class ChunkSpec:
+    """One unit of actor work, fully determined at dispatch: regenerating a
+    spec is bit-deterministic given the same params version."""
+
+    index: int  # global chunk position (finalize order)
+    collection: int  # 1-based collection this chunk is expected to feed
+    prompt_ids: np.ndarray  # [b, p] raw loader batch (pre group fan-out)
+    prompt_mask: np.ndarray
+    rng: Any  # this chunk's PRNG key (the serial path's per-chunk split)
+
+
+class _ActorDied(RuntimeError):
+    """Internal: an actor loop failed; its spec has been requeued."""
+
+
+class AsyncCollector:
+    """Actor supervision + ordered learner drain over one queue/channel pair.
+
+    ``trainer`` supplies ``_async_produce_chunk(spec, params, version,
+    channel)`` (the device+host half of one chunk) and the prompt iterator;
+    everything order- or state-sensitive stays on the learner thread.
+    """
+
+    def __init__(
+        self,
+        trainer: Any,
+        queue: Any,
+        channel: Any,
+        num_actors: int = 1,
+        max_staleness: int = 0,
+        updates_per_phase: int = 1,
+        chunks_per_collection: int = 1,
+        spawn_actors: bool = True,
+        chunk_timeout_s: float = 300.0,
+        max_actor_restarts: int = 3,
+        metrics: Any = None,
+        tracer: Any = None,
+        span: Any = None,
+    ):
+        self._trainer = trainer
+        self.queue = queue
+        self.channel = channel
+        self.num_actors = max(1, int(num_actors))
+        self.max_staleness = max(0, int(max_staleness))
+        self.updates_per_phase = max(1, int(updates_per_phase))
+        self.chunks_per_collection = max(1, int(chunks_per_collection))
+        self._spawn_actors = spawn_actors
+        self._chunk_timeout_s = float(chunk_timeout_s)
+        self._max_actor_restarts = int(max_actor_restarts)
+        self.metrics = metrics
+        self._tracer = tracer
+        self._span = span
+
+        # dispatcher state: prompt/RNG draws happen in spec-index order under
+        # this lock, so the draw stream is identical to the serial path's
+        self._dispatch_lock = threading.Lock()
+        self._retry: List[ChunkSpec] = []  # guarded-by: _dispatch_lock
+        # every dispatched-but-unfinalized chunk's spec, by index: the
+        # regeneration source for drop_oldest evictions and crash requeues
+        self._inflight_specs: Dict[int, ChunkSpec] = {}  # guarded-by: _dispatch_lock
+        self._next_index = 0  # guarded-by: _dispatch_lock
+        self._rng = trainer._rollout_rng  # guarded-by: _dispatch_lock
+        self._crash_fired: set = set()  # guarded-by: _dispatch_lock
+        self._restarts = 0  # guarded-by: _dispatch_lock
+        self._fatal: Optional[BaseException] = None  # guarded-by: _dispatch_lock
+        # actor busy/idle accounting (actor_idle_frac)
+        self._idle_s = 0.0  # guarded-by: _dispatch_lock
+        self._busy_s = 0.0  # guarded-by: _dispatch_lock
+
+        self._stop = threading.Event()
+        # respawns append from dying actor threads while close() snapshots
+        self._threads: List[threading.Thread] = []  # guarded-by: _dispatch_lock
+        self._started = False
+
+        # learner-side (single-threaded) state
+        self.version = 0  # completed learner updates (the version clock)
+        self._next_finalize = 0
+        self._reorder: Dict[int, ExperienceChunk] = {}
+        self._col_stats = {"chunks": 0, "staleness_sum": 0.0, "staleness_max": 0.0,
+                           "wait_s": 0.0}
+        # actor busy/idle window start: rolls at each collection_stats()
+        # call, so a collection's idle frac covers the whole production
+        # window — including chunks produced DURING the previous learn phase
+        self._win0 = (0.0, 0.0)
+
+    # ------------------------------------------------------------------
+    # dispatch (actor threads; index-ordered draws)
+    # ------------------------------------------------------------------
+
+    def _next_spec(self) -> ChunkSpec:
+        import jax
+
+        with self._dispatch_lock:
+            if self._retry:
+                return self._retry.pop(0)
+            batch = next(self._trainer.prompt_iterator)
+            ids = np.asarray(batch["input_ids"], np.int32)
+            mask = np.asarray(batch["attention_mask"], np.int32)
+            self._rng, chunk_rng = jax.random.split(self._rng)
+            spec = ChunkSpec(
+                index=self._next_index,
+                collection=self._next_index // self.chunks_per_collection + 1,
+                prompt_ids=ids,
+                prompt_mask=mask,
+                rng=chunk_rng,
+            )
+            self._inflight_specs[spec.index] = spec
+            self._next_index += 1
+            return spec
+
+    def _requeue(self, spec: ChunkSpec) -> None:
+        with self._dispatch_lock:
+            self._retry.insert(0, spec)
+        if self.metrics is not None:
+            self.metrics.inc("async/requeued_chunks")
+
+    def requeue_dropped(self, chunk: ExperienceChunk) -> None:
+        """A drop_oldest eviction lost this chunk's DATA; its spec is still
+        in flight, so the next free actor regenerates the index under
+        fresher params — the learner's in-order drain depends on every
+        index eventually arriving."""
+        with self._dispatch_lock:
+            spec = self._inflight_specs.get(chunk.index)
+            if spec is not None:
+                self._retry.insert(0, spec)
+        if spec is not None and self.metrics is not None:
+            self.metrics.inc("async/requeued_chunks")
+
+    def _maybe_inject_crash(self, spec: ChunkSpec) -> None:
+        plan = getattr(self._trainer.resilience, "plan", None)
+        if not plan:
+            return
+        with self._dispatch_lock:
+            if spec.collection in self._crash_fired:
+                return
+            if not plan.poll("actor_crash", collection=spec.collection):
+                return
+            self._crash_fired.add(spec.collection)
+        from trlx_tpu.resilience.faults import InjectedFault
+
+        raise InjectedFault(
+            f"fault plan: actor crash in collection {spec.collection} "
+            f"(chunk {spec.index})"
+        )
+
+    # ------------------------------------------------------------------
+    # actor threads
+    # ------------------------------------------------------------------
+
+    def _actor_loop(self, actor_id: int) -> None:
+        if self._tracer is not None and hasattr(self._tracer, "alias_current_thread"):
+            self._tracer.alias_current_thread(f"async actor {actor_id}")
+        while not self._stop.is_set():
+            spec = self._next_spec()
+            t_gate = time.perf_counter()
+            if not self.channel.wait_ready(
+                self.max_staleness, spec.collection, stop=self._stop
+            ):
+                self._requeue(spec)  # shutdown: leave the spec for nobody
+                return
+            params, version = self.channel.fetch()
+            gate_s = time.perf_counter() - t_gate
+            try:
+                self._maybe_inject_crash(spec)
+                t0 = time.perf_counter()
+                if self._span is not None:
+                    with self._span(
+                        "async/actor_chunk", index=spec.index, version=version
+                    ):
+                        payload = self._trainer._async_produce_chunk(
+                            spec, params, version, self.channel
+                        )
+                else:
+                    payload = self._trainer._async_produce_chunk(
+                        spec, params, version, self.channel
+                    )
+                busy_s = time.perf_counter() - t0
+            except BaseException as e:
+                self._requeue(spec)
+                raise _ActorDied(f"actor {actor_id} died on chunk {spec.index}") from e
+            t_put = time.perf_counter()
+            try:
+                self.queue.put(ExperienceChunk(spec.index, version, payload))
+            except QueueClosed:
+                return
+            with self._dispatch_lock:
+                self._idle_s += gate_s + (time.perf_counter() - t_put)
+                self._busy_s += busy_s
+            if self.metrics is not None:
+                self.metrics.inc("async/chunks")
+
+    def _actor_main(self, actor_id: int) -> None:
+        try:
+            self._actor_loop(actor_id)
+        except _ActorDied as e:
+            if self._stop.is_set():
+                return
+            if self.metrics is not None:
+                self.metrics.inc("async/actor_restarts")
+            with self._dispatch_lock:
+                self._restarts += 1
+                too_many = self._restarts > self._max_actor_restarts
+                if too_many:
+                    self._fatal = e.__cause__ or e
+            if not too_many:
+                self._spawn(actor_id)
+        except QueueClosed:
+            return
+
+    def _spawn(self, actor_id: int) -> None:
+        thread = threading.Thread(
+            target=self._actor_main,
+            args=(actor_id,),
+            name=f"trlx-async-actor-{actor_id}",
+            daemon=True,
+        )
+        with self._dispatch_lock:
+            self._threads.append(thread)
+        thread.start()
+
+    def _ensure_started(self) -> None:
+        if self._started or not self._spawn_actors:
+            return
+        self._started = True
+        for actor_id in range(self.num_actors):
+            self._spawn(actor_id)
+
+    # ------------------------------------------------------------------
+    # learner side (single thread)
+    # ------------------------------------------------------------------
+
+    def on_update(self, params: Any, version: int) -> None:
+        """Called by the trainer after every optimizer update: advance the
+        version clock and publish (thinned by the channel's sync_every)."""
+        self.version = int(version)
+        self.channel.publish(params, version)
+
+    def _consuming_collection(self) -> int:
+        """The collection index the NEXT consumed chunk belongs to — drives
+        the gate's collection-scoped announcements."""
+        return self._next_finalize // self.chunks_per_collection + 1
+
+    def begin_collection(self) -> None:
+        """Drain is about to start: force-publish the CURRENT params at the
+        current version and announce that this collection is being consumed
+        NOW. This heals dropped publishes and over-estimated phase targets
+        (the gate can never deadlock), and in the ``max_staleness: 0`` case
+        hands actors exactly the params this collection will be consumed
+        under."""
+        self.channel.publish(self._trainer.state.params, self.version, force=True)
+        self.channel.announce(self.version, self._consuming_collection())
+        self._col_stats = {"chunks": 0, "staleness_sum": 0.0, "staleness_max": 0.0,
+                           "wait_s": 0.0}
+        self._ensure_started()
+
+    def end_collection(self) -> None:
+        """Drain finished: announce the NEXT collection's consumption point
+        — the end of the upcoming learn phase. Actors may not start that
+        collection's chunks any earlier (production never runs more than
+        one collection ahead), and its chunks gate on this target."""
+        self.channel.announce(
+            self.version + self.updates_per_phase, self._consuming_collection()
+        )
+
+    def _check_fatal(self) -> None:
+        with self._dispatch_lock:
+            fatal = self._fatal
+        if fatal is not None:
+            self.close()
+            raise fatal
+
+    def next_chunk(self) -> ExperienceChunk:
+        """The next chunk in strict index order (blocks; reorder buffer
+        absorbs multi-actor completion races). Records staleness at
+        consumption."""
+        indexed_get = hasattr(self.queue, "committed_indices")  # file spool
+        t0 = time.perf_counter()
+        while self._next_finalize not in self._reorder:
+            self._check_fatal()
+            # top-up heal: empty-response rows can push a drain past its
+            # estimated chunk count into the next collection's index range —
+            # announce that consumption has reached that collection at the
+            # CURRENT version so the gate frees the needed chunk (a no-op
+            # whenever the normal begin/end announcements already cover it)
+            self.channel.announce(self.version, self._consuming_collection())
+            try:
+                if indexed_get:
+                    chunk = self.queue.get(
+                        self._next_finalize, timeout=self._chunk_timeout_s
+                    )
+                else:
+                    chunk = self.queue.get(timeout=1.0)
+            except TimeoutError:
+                if indexed_get:
+                    raise
+                continue  # thread mode: loop to re-check actor failures
+            self._reorder[chunk.index] = chunk
+        self._col_stats["wait_s"] += time.perf_counter() - t0
+        chunk = self._reorder.pop(self._next_finalize)
+        with self._dispatch_lock:
+            self._inflight_specs.pop(self._next_finalize, None)
+        self._next_finalize += 1
+        staleness = float(max(self.version - chunk.version, 0))
+        self._col_stats["chunks"] += 1
+        self._col_stats["staleness_sum"] += staleness
+        self._col_stats["staleness_max"] = max(
+            self._col_stats["staleness_max"], staleness
+        )
+        if self.metrics is not None:
+            self.metrics.observe("async/staleness", staleness)
+        return chunk
+
+    def collection_stats(self) -> Dict[str, float]:
+        """The async/* gauges of the collection just drained."""
+        col = self._col_stats
+        n = max(col["chunks"], 1)
+        with self._dispatch_lock:
+            idle = self._idle_s - self._win0[0]
+            busy = self._busy_s - self._win0[1]
+            self._win0 = (self._idle_s, self._busy_s)
+        stats: Dict[str, float] = {}
+        stats["async/chunks"] = float(col["chunks"])
+        stats["async/staleness_mean"] = col["staleness_sum"] / n
+        stats["async/staleness_max"] = col["staleness_max"]
+        stats["async/learner_wait_s"] = col["wait_s"]
+        stats["async/queue_depth"] = float(self.queue.depth)
+        if idle + busy > 0:
+            stats["async/actor_idle_frac"] = idle / (idle + busy)
+        return stats
+
+    def close(self) -> None:
+        """Stop actors, wake anything blocked, join threads. Idempotent."""
+        self._stop.set()
+        try:
+            self.channel.close()
+        except Exception:  # pragma: no cover - defensive
+            pass
+        try:
+            self.queue.close()
+        except Exception:  # pragma: no cover - defensive
+            pass
+        with self._dispatch_lock:
+            threads = list(self._threads)
+        for thread in threads:
+            thread.join(timeout=10)

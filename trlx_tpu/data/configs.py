@@ -314,6 +314,64 @@ class EngineConfig:
 
 
 @dataclass
+class AsyncRLConfig:
+    """Disaggregated async RL knobs (``trlx_tpu/async_rl/``,
+    docs/ASYNC_RL.md).
+
+    Splits training into one learner and N generation actors connected by a
+    staleness-bounded experience queue and an in-flight weight-sync channel
+    — collection k+1 is generated while the learner optimizes on
+    collection k, instead of the alternating single-program loop.
+
+    :param enabled: route PPO/GRPO experience collection through the
+        actor/learner split. False = the alternating reference loop,
+        byte-for-byte unchanged.
+    :param mode: ``"thread"`` (actors are in-process threads over the
+        existing Engine paths — single host) or ``"process"`` (actors are
+        separate processes with their own JAX runtime, connected through
+        the ``root_dir`` filesystem transport — launch them with
+        ``trlx_tpu.async_rl.actor.run_actor``).
+    :param num_actors: actor threads (thread mode). Process-mode fleet size
+        is however many ``run_actor`` processes you launch.
+    :param max_staleness: how many learner updates a chunk's producing
+        params may lag its consumption. 0 = fully synchronous — the actor
+        gate degenerates to the alternating loop and the store is
+        bit-identical to the serial reference under a fixed seed. Larger
+        values buy generation/optimization overlap at bounded
+        off-policyness (pair with ``method.iw_correction``).
+    :param queue_capacity: experience-queue bound in chunks. 0 = auto
+        (2 × the chunks one collection consumes).
+    :param queue_policy: ``"block"`` back-pressures actors at capacity;
+        ``"drop_oldest"`` evicts the stalest queued chunk instead (counted
+        as ``async/dropped_chunks``; trades rollouts for freshness).
+    :param sync_every: publish learner params every N optimizer updates
+        (1 = every update; phase boundaries always force a publish).
+    :param root_dir: process-mode transport root (weight files + chunk
+        spool) — a directory shared between learner and actors.
+    :param actor_timeout_s: process mode — how long the learner waits for
+        the next chunk before declaring the actor fleet dead.
+    :param poll_interval_s: process-mode file polling interval.
+    :param max_actor_restarts: thread mode — dead actors are respawned
+        (their in-flight chunk requeued) up to this many times before the
+        underlying error propagates to the learner.
+    """
+
+    enabled: bool = False
+    mode: str = "thread"
+    num_actors: int = 1
+    max_staleness: int = 0
+    queue_capacity: int = 0
+    queue_policy: str = "block"
+    sync_every: int = 1
+    root_dir: Optional[str] = None
+    actor_timeout_s: float = 300.0
+    poll_interval_s: float = 0.02
+    max_actor_restarts: int = 3
+
+    from_dict = classmethod(_strict_from_dict)
+
+
+@dataclass
 class TrainConfig:
     """Run-level knobs for the shared learn loop
     (reference: ``trlx/data/configs.py:142-230``)."""
@@ -420,6 +478,7 @@ class TRLConfig:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
+    async_rl: AsyncRLConfig = field(default_factory=AsyncRLConfig)
 
     @classmethod
     def load_yaml(cls, yml_fp: str) -> "TRLConfig":
@@ -446,6 +505,7 @@ class TRLConfig:
             "parallel": asdict(self.parallel),
             "resilience": asdict(self.resilience),
             "engine": asdict(self.engine),
+            "async_rl": asdict(self.async_rl),
         })
 
     @classmethod
@@ -460,6 +520,7 @@ class TRLConfig:
             parallel=ParallelConfig.from_dict(config.get("parallel", {})),
             resilience=ResilienceConfig.from_dict(config.get("resilience", {})),
             engine=EngineConfig.from_dict(config.get("engine", {})),
+            async_rl=AsyncRLConfig.from_dict(config.get("async_rl", {})),
         )
 
     def evolve(self, **kwargs) -> "TRLConfig":

@@ -4,7 +4,7 @@ group-relative advantage per sequence and the frozen-reference logprobs for
 the in-loss KL)."""
 
 from dataclasses import dataclass
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import numpy as np
@@ -16,9 +16,12 @@ class GRPORLElement:
 
     query_tensor: np.ndarray  # [Q]
     response_tensor: np.ndarray  # [R]
-    logprobs: np.ndarray  # [R] behavior logprobs
+    logprobs: np.ndarray  # [R] proximal-anchor logprobs (scoring forward)
     ref_logprobs: np.ndarray  # [R] frozen-reference logprobs
     advantage: float  # group-relative, per sequence
+    # sampler's exact behavior logprobs — async collection with
+    # method.iw_correction on only (docs/ASYNC_RL.md); None elsewhere
+    behavior_logprobs: Optional[np.ndarray] = None
 
 
 class GRPORLBatch(NamedTuple):
@@ -31,3 +34,5 @@ class GRPORLBatch(NamedTuple):
     advantages: jax.Array  # [B] float32
     query_mask: jax.Array  # [B, Q]
     response_mask: jax.Array  # [B, R]
+    # None unless async collection recorded distinct behavior logprobs
+    behavior_logprobs: Optional[jax.Array] = None

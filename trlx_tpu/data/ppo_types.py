@@ -6,7 +6,7 @@ TPU redesign of the reference's ragged tensors (static shapes for jit).
 """
 
 from dataclasses import dataclass
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import numpy as np
@@ -18,9 +18,14 @@ class PPORLElement:
 
     :param query_tensor: prompt token ids [Q]
     :param response_tensor: sampled response ids [R]
-    :param logprobs: behavior-policy logprobs per response token [R]
+    :param logprobs: proximal-anchor logprobs per response token [R] (the
+        scoring forward; on the serial path these ARE the behavior policy's)
     :param values: value predictions per response token [R]
     :param rewards: per-token rewards (KL penalty + score at end) [R]
+    :param behavior_logprobs: the sampler's exact per-token logprobs [R] —
+        only populated by async collection with ``method.iw_correction``
+        on, where in-flight weight sync makes them a param-version mixture
+        distinct from ``logprobs`` (docs/ASYNC_RL.md). None elsewhere.
     """
 
     query_tensor: np.ndarray
@@ -28,6 +33,7 @@ class PPORLElement:
     logprobs: np.ndarray
     values: np.ndarray
     rewards: np.ndarray
+    behavior_logprobs: Optional[np.ndarray] = None
 
 
 class PPORLBatch(NamedTuple):
@@ -45,3 +51,6 @@ class PPORLBatch(NamedTuple):
     rewards: jax.Array  # [B, R] float32
     query_mask: jax.Array  # [B, Q] 1 on real prompt tokens
     response_mask: jax.Array  # [B, R] 1 on real response tokens
+    # None unless async collection recorded distinct behavior logprobs
+    # (train_step's array filter drops a None transparently)
+    behavior_logprobs: Optional[jax.Array] = None

@@ -353,13 +353,18 @@ class ContinuousEngine(Engine):
         # prefix-cache entry) was computed under — a different params tree
         # invalidates all cached KV (begin_collection flushes)
         self._kv_params = params
+        # memoized version counter for the weight-sync path: per-segment
+        # swap checks compare one int instead of adopting/flushing on every
+        # fresh params object (each publish is a new copy, so the identity
+        # test alone would false-negative and flush a still-valid cache)
+        self._params_version: Optional[int] = None
         if prewarm:
             # once per SlotRefillFns (the fns — and their compiled bucket
             # programs — outlive this engine via the trainer's program
             # cache; later engines skip straight through)
             self.state = self.fns.prewarm(self.params, self.state)
 
-    def begin_collection(self, params: Any) -> None:
+    def begin_collection(self, params: Any, version: Optional[int] = None) -> None:
         """Reuse this engine for a fresh collection: reset the
         per-collection stats, adopt the (possibly updated) policy params,
         and drop any leftovers of an aborted run. Cached prefix KV is
@@ -367,7 +372,9 @@ class ContinuousEngine(Engine):
         tree (the policy trained in between) flushes the prefix cache;
         identical params (repeated eval, back-to-back collections without
         an update) keep it warm, which is where cross-collection prefill
-        savings come from."""
+        savings come from. ``version`` (the weight-sync path) memoizes a
+        cheap counter: a matching version skips the flush even when the
+        params object is a fresh copy of the same weights."""
         self._queue.clear()
         for slot in range(self.B):
             if self._slots[slot] is None:
@@ -387,11 +394,7 @@ class ContinuousEngine(Engine):
             self.state = self.state._replace(
                 done=self._jnp.ones((self.B,), bool)
             )
-        if params is not self._kv_params:
-            if self.prefix is not None:
-                self.prefix.clear(self.allocator)
-            self._kv_params = params
-        self.params = params
+        self._adopt_params(params, version)
         kv_cache_bytes = self.stats.kv_cache_bytes
         prefix_enabled = self.stats.prefix_enabled
         kv_blocks_total = self.stats.kv_blocks_total
@@ -403,6 +406,36 @@ class ContinuousEngine(Engine):
         if self.allocator is not None:
             # per-collection high-water, not lifetime
             self.allocator.high_water = self.allocator.blocks_in_use
+
+    def _params_changed(self, params: Any, version: Optional[int]) -> bool:
+        """One int compare on the versioned weight-sync path, identity on
+        the unversioned path — never a tree walk."""
+        if version is not None and self._params_version is not None:
+            return version != self._params_version
+        return params is not self._kv_params
+
+    def _adopt_params(self, params: Any, version: Optional[int]) -> None:
+        if self._params_changed(params, version):
+            if self.prefix is not None:
+                self.prefix.clear(self.allocator)
+            self._kv_params = params
+        self._params_version = version
+        self.params = params
+
+    def swap_params(self, params: Any, version: Optional[int] = None) -> bool:
+        """In-flight weight sync (docs/ASYNC_RL.md): adopt updated params
+        MID-COLLECTION at a segment boundary. Live rows keep their KV (the
+        sequence becomes a bounded param-version mixture — the behavior
+        logprobs the sampler records stay exact), but cached *shared*
+        prefix KV under the old params must never seed a future row's
+        prefill: a changed version flushes the prefix cache, exactly like
+        ``begin_collection``. Returns True when the params actually
+        changed; a matching memoized version is a cheap no-op."""
+        if not self._params_changed(params, version):
+            self._params_version = version if version is not None else self._params_version
+            return False
+        self._adopt_params(params, version)
+        return True
 
     # -- feeding ---------------------------------------------------------
 

@@ -100,9 +100,15 @@ class GRPOConfig(PPOConfig):
         ref_logprobs: jax.Array,  # [B, R] frozen-reference logprobs
         advantages: jax.Array,  # [B] per-sequence group-relative advantages
         mask: jax.Array,  # [B, R] response mask
+        behavior_logprobs: jax.Array = None,  # [B, R] sampler logprobs (async)
     ) -> Tuple[jax.Array, Dict[str, Any]]:
         """Clipped ratio objective with sequence-level advantages and an
-        in-loss KL penalty; token-mean normalization (masked)."""
+        in-loss KL penalty; token-mean normalization (masked).
+        ``behavior_logprobs`` (async collection, ``iw_correction: clip``)
+        applies the truncated proximal/behavior importance weight to the pg
+        term — ``None`` keeps the serial objective byte-for-byte."""
+        from trlx_tpu.models.ppo import iw_weights
+
         mask = mask.astype(jnp.float32)
         n = jnp.maximum(mask.sum(), 1.0)
         adv = advantages.astype(jnp.float32)[:, None]
@@ -111,6 +117,13 @@ class GRPOConfig(PPOConfig):
         ratio = jnp.exp(log_ratio)
         pg_loss1 = -adv * ratio
         pg_loss2 = -adv * jnp.clip(ratio, 1.0 - self.cliprange, 1.0 + self.cliprange)
+        iw_stats = {}
+        if behavior_logprobs is not None and self.iw_correction != "off":
+            rho, iw_stats = iw_weights(
+                old_logprobs, behavior_logprobs, mask, self.iw_clip, n
+            )
+            pg_loss1 = pg_loss1 * rho
+            pg_loss2 = pg_loss2 * rho
         pg_loss = jnp.sum(jnp.maximum(pg_loss1, pg_loss2) * mask) / n
 
         # k3 KL estimator vs the frozen reference (Schulman 2020): unbiased,
@@ -123,6 +136,7 @@ class GRPOConfig(PPOConfig):
         approx_kl_old = 0.5 * jnp.sum(log_ratio**2) / n  # vs behavior policy
         clipfrac = jnp.sum((pg_loss2 > pg_loss1).astype(jnp.float32) * mask) / n
         stats = dict(
+            **iw_stats,
             losses=dict(
                 total_loss=loss,
                 policy_loss=pg_loss,

@@ -70,6 +70,19 @@ class PPOConfig(MethodConfig):
     :param scale_reward: "running" | "ref" | None/"ignored"
     :param ref_mean/ref_std: fixed scaling moments for ``scale_reward="ref"``
     :param cliprange_reward: clip of environment reward
+    :param iw_correction: off-policy importance-weight correction for
+        async/disaggregated collection (docs/ASYNC_RL.md). ``"off"``
+        (default — the loss is byte-for-byte the serial objective) or
+        ``"clip"``: the policy-gradient term is multiplied per token by the
+        truncated behavior ratio ``min(exp(old_logprobs −
+        behavior_logprobs), iw_clip)``. ``old_logprobs`` are the proximal
+        anchor (the scoring forward under the actor's newest params at
+        chunk completion); ``behavior_logprobs`` are the sampler's exact
+        per-token logprobs, which with in-flight mid-rollout weight sync
+        come from a *mixture* of param versions — the ratio corrects the
+        proximal/behavior mismatch, truncation bounds its variance
+        (V-trace/TIS-style; PipelineRL arxiv 2509.19128).
+    :param iw_clip: truncation bound of the behavior ratio.
     :param gen_kwargs: sampling kwargs for rollouts/eval
     :param gen_experience_kwargs: optional distinct sampling kwargs for rollouts
     """
@@ -90,6 +103,8 @@ class PPOConfig(MethodConfig):
     ref_mean: Optional[float] = None
     ref_std: Optional[float] = None
     cliprange_reward: float = 10.0
+    iw_correction: str = "off"
+    iw_clip: float = 2.0
     gen_kwargs: Dict[str, Any] = field(default_factory=dict)
     gen_experience_kwargs: Optional[Dict[str, Any]] = None
 
@@ -146,8 +161,13 @@ class PPOConfig(MethodConfig):
         advantages: jax.Array,  # [B, R]
         returns: jax.Array,  # [B, R]
         mask: jax.Array,  # [B, R] 1 on real response tokens
+        behavior_logprobs: Optional[jax.Array] = None,  # [B, R] sampler logprobs
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-        """Clipped-ratio policy loss + clipped value loss; masked sums / n."""
+        """Clipped-ratio policy loss + clipped value loss; masked sums / n.
+
+        ``behavior_logprobs`` (async collection, ``iw_correction: clip``
+        only) multiplies the pg term by the truncated proximal/behavior
+        ratio — ``None`` keeps the serial objective byte-for-byte."""
         mask = mask.astype(jnp.float32)
         logprobs = logprobs.astype(jnp.float32)
         values = values.astype(jnp.float32)
@@ -168,12 +188,20 @@ class PPOConfig(MethodConfig):
 
         pg_loss1 = -advantages * ratio
         pg_loss2 = -advantages * jnp.clip(ratio, 1.0 - self.cliprange, 1.0 + self.cliprange)
+        iw_stats = {}
+        if behavior_logprobs is not None and self.iw_correction != "off":
+            rho, iw_stats = iw_weights(
+                old_logprobs, behavior_logprobs, mask, self.iw_clip, n
+            )
+            pg_loss1 = pg_loss1 * rho
+            pg_loss2 = pg_loss2 * rho
         pg_loss = jnp.sum(jnp.maximum(pg_loss1, pg_loss2) * mask) / n
         pg_clipfrac = jnp.sum((pg_loss2 > pg_loss1).astype(jnp.float32) * mask) / n
 
         loss = pg_loss + self.vf_coef * vf_loss
 
         stats = dict(
+            **iw_stats,
             losses=dict(total_loss=loss, policy_loss=pg_loss, value_loss=vf_loss),
             values=dict(
                 get_tensor_stats(values, mask, n),
@@ -187,6 +215,30 @@ class PPOConfig(MethodConfig):
             padding_percentage=1.0 - n / mask.size,
         )
         return loss, flatten_dict(stats)
+
+
+def iw_weights(
+    old_logprobs: jax.Array,  # [B, R] proximal-anchor logprobs (scoring fwd)
+    behavior_logprobs: jax.Array,  # [B, R] sampler's exact behavior logprobs
+    mask: jax.Array,  # [B, R] float response mask
+    clip: float,
+    n: jax.Array,  # masked token count
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Truncated per-token importance weights ``min(exp(old − behavior),
+    clip)`` for off-policy (async/stale) samples, with their diagnostics.
+    Shared by the PPO and GRPO losses (docs/ASYNC_RL.md "IW correction")."""
+    log_rho = (
+        old_logprobs.astype(jnp.float32) - behavior_logprobs.astype(jnp.float32)
+    ) * mask
+    raw = jnp.exp(log_rho)
+    rho = jax.lax.stop_gradient(jnp.minimum(raw, clip))
+    stats = {
+        "iw": dict(
+            rho_mean=jnp.sum(rho * mask) / n,
+            rho_clipfrac=jnp.sum((raw > clip).astype(jnp.float32) * mask) / n,
+        )
+    }
+    return rho, stats
 
 
 def kl_penalty_rewards(

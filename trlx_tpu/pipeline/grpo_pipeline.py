@@ -57,6 +57,11 @@ class GRPORolloutStorage(PPORolloutStorage):
         r_len = responses.shape[1]
         logprobs, _ = pad_rows([e.logprobs for e in elems], 0.0, "right", 1, r_len, np.float32)
         ref_logprobs, _ = pad_rows([e.ref_logprobs for e in elems], 0.0, "right", 1, r_len, np.float32)
+        behavior = None
+        if all(e.behavior_logprobs is not None for e in elems):
+            behavior, _ = pad_rows(
+                [e.behavior_logprobs for e in elems], 0.0, "right", 1, r_len, np.float32
+            )
         return GRPORLBatch(
             query_tensors=queries,
             response_tensors=responses,
@@ -65,4 +70,5 @@ class GRPORolloutStorage(PPORolloutStorage):
             advantages=np.asarray([e.advantage for e in elems], np.float32),
             query_mask=query_mask,
             response_mask=response_mask,
+            behavior_logprobs=behavior,
         )

@@ -66,6 +66,13 @@ class PPORolloutStorage(BaseRolloutStore):
         logprobs, _ = pad_rows([e.logprobs for e in elems], 0.0, "right", 1, r_len, np.float32)
         values, _ = pad_rows([e.values for e in elems], 0.0, "right", 1, r_len, np.float32)
         rewards, _ = pad_rows([e.rewards for e in elems], 0.0, "right", 1, r_len, np.float32)
+        # async-collection behavior logprobs ride only when EVERY element
+        # carries them (mixed stores train without the IW correction)
+        behavior = None
+        if all(e.behavior_logprobs is not None for e in elems):
+            behavior, _ = pad_rows(
+                [e.behavior_logprobs for e in elems], 0.0, "right", 1, r_len, np.float32
+            )
         return PPORLBatch(
             query_tensors=queries,
             response_tensors=responses,
@@ -74,6 +81,7 @@ class PPORolloutStorage(BaseRolloutStore):
             rewards=rewards,
             query_mask=query_mask,
             response_mask=response_mask,
+            behavior_logprobs=behavior,
         )
 
     def create_loader(

@@ -14,13 +14,18 @@ Plan syntax (``;``-separated entries, whitespace ignored)::
 
     kind     one of: reward_raise | publish_raise | sigterm | sigint |
              sigterm_one_proc | nan_loss | crash_save | topology_shrink |
-             sleep_one_proc | flightrec_dump
+             sleep_one_proc | flightrec_dump | actor_crash |
+             weight_sync_drop
     trigger  call  — the Nth invocation of the consulting site (1-based;
                      for reward_raise/publish_raise every *attempt* counts,
                      so retries advance the counter)
              step  — fires when the trainer's completed-update count == N
              save  — the Nth ``save_state`` call (1-based)
              resume — the Nth checkpoint restore (1-based)
+             collection — fires when the async actor's collection index
+                     == N (1-based; docs/ASYNC_RL.md)
+             version — fires when the weight channel publishes params
+                     version N
     count    consecutive firings (default 1)
 
 Examples::
@@ -41,6 +46,15 @@ Examples::
     flightrec_dump@step:4        # dump the crash flight recorder at the
                                  # boundary before update 5 (deterministic
                                  # flightrec.json exercise, no crash needed)
+    actor_crash@collection:2     # an async generation actor dies at the
+                                 # start of its collection-2 chunk — the
+                                 # supervisor must requeue the chunk and
+                                 # respawn the actor (docs/ASYNC_RL.md)
+    weight_sync_drop@version:3   # the weight channel drops the payload of
+                                 # params-version-3's publish; actors keep
+                                 # the previous params until the next
+                                 # publish (deterministic staleness/IW
+                                 # exercise)
 
 Plans come from ``config.resilience.fault_plan`` or the
 ``TRLX_TPU_FAULT_PLAN`` env var (env wins — a relaunched run can drop the
@@ -58,13 +72,13 @@ from typing import Dict, List, Optional
 _KINDS = frozenset({
     "reward_raise", "publish_raise", "sigterm", "sigint", "sigterm_one_proc",
     "nan_loss", "crash_save", "topology_shrink", "sleep_one_proc",
-    "flightrec_dump",
+    "flightrec_dump", "actor_crash", "weight_sync_drop",
 })
 
 # how long a ``sleep_one_proc`` fault stalls the afflicted rank's train step
 # (env-overridable so tests can size the stall above the real step time)
 SLEEP_FAULT_S = float(os.environ.get("TRLX_TPU_FAULT_SLEEP_S", "0.5"))
-_TRIGGERS = frozenset({"call", "step", "save", "resume"})
+_TRIGGERS = frozenset({"call", "step", "save", "resume", "collection", "version"})
 
 
 class InjectedFault(RuntimeError):
@@ -143,23 +157,34 @@ class FaultPlan:
     def __bool__(self) -> bool:
         return bool(self.specs)
 
-    def poll(self, kind: str, step: Optional[int] = None) -> bool:
+    def poll(
+        self,
+        kind: str,
+        step: Optional[int] = None,
+        collection: Optional[int] = None,
+        version: Optional[int] = None,
+    ) -> bool:
         """Should the consulting site fault now?
 
-        With ``step=None`` this is an *invocation* poll: the per-kind call
-        counter advances by one and call/save/resume-triggered entries
-        match against it. With ``step=s`` only step-triggered entries are
-        checked (idempotent — the trainer polls once per update)."""
+        With no caller counter this is an *invocation* poll: the per-kind
+        call counter advances by one and call/save/resume-triggered entries
+        match against it. With ``step=s`` / ``collection=c`` / ``version=v``
+        only the matching trigger's entries are checked against the
+        caller's own counter (idempotent — the caller polls once per
+        update / collection / publish)."""
         if not self.specs:
             return False
         with self._lock:
-            if step is None:
+            if step is not None:
+                value, triggers = step, ("step",)
+            elif collection is not None:
+                value, triggers = collection, ("collection",)
+            elif version is not None:
+                value, triggers = version, ("version",)
+            else:
                 value = self._counters.get(kind, 0) + 1
                 self._counters[kind] = value
                 triggers = ("call", "save", "resume")
-            else:
-                value = step
-                triggers = ("step",)
             hit = any(
                 s.kind == kind and s.trigger in triggers and s.matches(value)
                 for s in self.specs

@@ -978,6 +978,8 @@ class TPUBaseTrainer(BaseRLTrainer):
         input_ids: np.ndarray,
         attention_mask: Optional[np.ndarray] = None,
         eval_mode: bool = False,
+        params: Optional[Any] = None,
+        rng: Optional[jax.Array] = None,
         **kwargs,
     ) -> GenerationOutput:
         """Sample continuations for a left-padded prompt batch.
@@ -985,18 +987,38 @@ class TPUBaseTrainer(BaseRLTrainer):
         Rollout generation uses ``gen_experience_kwargs`` when configured
         (reference ``generate`` vs ``generate_eval``,
         ``accelerate_base_trainer.py:228-253``).
+
+        ``params``/``rng`` default to the trainer's own state — the async
+        actor path (docs/ASYNC_RL.md) passes both explicitly: actors sample
+        under channel-published param copies (never ``state.params``, whose
+        buffers the donated train step invalidates), and a requeued chunk
+        regenerates under its dispatched RNG.
         """
         set_global_mesh(self.mesh)
         gen_config, extra_kwargs = self._resolve_gen_config(eval_mode, **kwargs)
         input_ids = np.asarray(input_ids, np.int32)
         if attention_mask is None:
             attention_mask = (input_ids != self.tokenizer.pad_token_id).astype(np.int32)
-        self._rollout_rng, rng = jax.random.split(self._rollout_rng)
+        if rng is None:
+            self._rollout_rng, rng = jax.random.split(self._rollout_rng)
         # the serial dense path behind the unified Engine interface
         # (trlx_tpu/engine/core.py) — the wrapped jitted program is
         # unchanged: it stays the bit-equivalence reference for the
-        # continuous-batching and paged backends
-        engine = self._get_serial_engine(gen_config, extra_kwargs)
+        # continuous-batching and paged backends. The params-override path
+        # (async actor threads) gets a PER-THREAD engine wrapper: engines
+        # carry mutable `params`, and an actor generating concurrently with
+        # the learner's eval on one shared wrapper would clobber each
+        # other's params mid-call (the compiled program underneath is still
+        # shared via _get_generate_fn's cache — wrappers are thin).
+        if params is not None:
+            import threading as _threading
+
+            engine = self._get_serial_engine(
+                gen_config, extra_kwargs, tag=_threading.get_ident()
+            )
+            engine.params = params
+        else:
+            engine = self._get_serial_engine(gen_config, extra_kwargs)
         batch = shard_batch(
             {"input_ids": input_ids, "attention_mask": np.asarray(attention_mask, np.int32)},
             self.mesh,
@@ -1029,11 +1051,12 @@ class TPUBaseTrainer(BaseRLTrainer):
         self.obs.recompile.observe("generate", engine._fn)
         return out
 
-    def _get_serial_engine(self, gen_config, extra_kwargs):
+    def _get_serial_engine(self, gen_config, extra_kwargs, tag=None):
         """The SerialEngine wrapping this (config, kwargs)'s jitted rollout
         program — cached alongside the programs themselves; params are
-        refreshed per call (the policy trains between collections)."""
-        key = ("serial_engine", gen_config, extra_kwargs)
+        refreshed per call (the policy trains between collections).
+        ``tag`` isolates wrappers per caller thread (async actors)."""
+        key = ("serial_engine", gen_config, extra_kwargs, tag)
         if key not in self._generate_fns:
             from trlx_tpu.engine.core import SerialEngine
 
@@ -1235,6 +1258,15 @@ class TPUBaseTrainer(BaseRLTrainer):
                 reason=f"{type(e).__name__}: {e}"
             )
             raise
+        finally:
+            # async actors (threads or a remote fleet waiting on the weight
+            # channel) must not outlive the learn loop — on a clean finish
+            # AND on every crash/preemption path (docs/ASYNC_RL.md)
+            self._shutdown_collectors()
+
+    def _shutdown_collectors(self) -> None:
+        """Stop any background experience collectors (PPO's async
+        actor/learner split overrides). Never raises."""
 
     def _shutdown_observability(self, reason: Optional[str] = None) -> None:
         """Best-effort flush of profiler, span trace, and tracker — callable

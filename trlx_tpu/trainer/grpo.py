@@ -54,6 +54,16 @@ class GRPOTrainer(PPOTrainer):
             raise ValueError(
                 f"unknown method.baseline '{method.baseline}'; known: {BASELINES}"
             )
+        if bool(config.async_rl.enabled) and bool(
+            getattr(config.train, "continuous_batching", False)
+        ):
+            # fail at construction, not on the Nth actor thread after
+            # max_actor_restarts respawn cycles
+            raise NotImplementedError(
+                "async_rl + train.continuous_batching is implemented for the "
+                "PPO trainer only: GRPO's group-aware harvest keeps the "
+                "single-program CB loop. Drop one of the two."
+            )
         if method.baseline == "rloo":
             if method.group_size < 2:
                 raise ValueError("baseline=rloo needs group_size >= 2")
@@ -109,12 +119,30 @@ class GRPOTrainer(PPOTrainer):
         agg: Dict[str, Any],
         score_out=None,  # pre-dispatched scoring outputs (serial path)
     ) -> None:
-        """Score + store one group-contiguous batch: scoring forward (policy
-        + hydra ref, async copies), host reward, clipping, group-relative
-        advantages, KL logging, element construction — the shared tail of
-        the serial chunk loop and the continuous-batching group flush."""
-        method: GRPOConfig = self.config.method
-        G = method.group_size
+        """Score + store one group-contiguous batch — the shared tail of the
+        serial chunk loop and the continuous-batching group flush, composed
+        from the produce/finalize halves the async actor/learner split also
+        uses (produce runs on the actor, finalize on the learner)."""
+        chunk = self._grpo_chunk_produce(
+            prompt_ids, prompt_mask, response_tokens, response_mask,
+            score_out=score_out,
+        )
+        agg["score_time_sum"] += chunk["score_s"]
+        self._grpo_chunk_finalize(chunk, elements, agg)
+
+    def _grpo_chunk_produce(
+        self,
+        prompt_ids: np.ndarray,
+        prompt_mask: np.ndarray,
+        response_tokens: np.ndarray,
+        response_mask: np.ndarray,
+        score_out=None,
+        params=None,
+    ) -> Dict[str, Any]:
+        """Device+host half of one group-contiguous batch: scoring forward
+        (policy + hydra ref, async copies), string decode, host reward —
+        everything that needs no learner state. Pure w.r.t. its inputs, so
+        it can run on an actor thread/process."""
         B, P = prompt_ids.shape
         N = int(response_tokens.shape[1])
         if score_out is None:
@@ -124,8 +152,8 @@ class GRPOTrainer(PPOTrainer):
                 prompt_mask,
                 response_tokens,
                 response_mask,
+                params=params,
             )
-
         samples, prompts, outputs = self.decode(
             prompt_ids, response_tokens, append_eos_token=True
         )
@@ -134,8 +162,32 @@ class GRPOTrainer(PPOTrainer):
             self.reward_fn(samples=samples, prompts=prompts, outputs=outputs),
             dtype=np.float32,
         )
-        agg["score_time_sum"] += time() - score_time
+        score_s = time() - score_time
         host = to_host(score_out)
+        return {
+            "prompt_ids": prompt_ids,
+            "prompt_mask": prompt_mask,
+            "response_tokens": response_tokens,
+            "response_mask": response_mask,
+            "scores": scores,
+            "host": host,
+            "score_s": score_s,
+        }
+
+    def _grpo_chunk_finalize(
+        self, chunk: Dict[str, Any], elements: list, agg: Dict[str, Any]
+    ) -> None:
+        """Learner-side ordered tail: reward clipping, running moments,
+        group-relative advantages, KL logging, element construction."""
+        method: GRPOConfig = self.config.method
+        G = method.group_size
+        prompt_ids = chunk["prompt_ids"]
+        prompt_mask = chunk["prompt_mask"]
+        response_tokens = chunk["response_tokens"]
+        response_mask = chunk["response_mask"]
+        scores = chunk["scores"]
+        host = chunk["host"]
+        B = prompt_ids.shape[0]
 
         clip = method.cliprange_reward
         if clip:
@@ -156,6 +208,9 @@ class GRPOTrainer(PPOTrainer):
         agg["kl_sum"] += mean_kl
         agg["kl_batches"] += 1
 
+        behavior = chunk.get("behavior_logprobs")
+        if method.iw_correction == "off":
+            behavior = None
         for i in range(B):
             n_i = int(response_mask[i].sum())
             if n_i == 0:
@@ -167,6 +222,11 @@ class GRPOTrainer(PPOTrainer):
                     logprobs=lp[i, :n_i],
                     ref_logprobs=rlp[i, :n_i],
                     advantage=float(advantages[i]),
+                    behavior_logprobs=(
+                        np.asarray(behavior[i, :n_i], np.float32)
+                        if behavior is not None
+                        else None
+                    ),
                 )
             )
 
@@ -304,6 +364,73 @@ class GRPOTrainer(PPOTrainer):
         # serialize through the same field-generic code path
         return GRPORLElement
 
+    # -- async actor/learner split (docs/ASYNC_RL.md) -------------------
+
+    def _async_produce_chunk(self, spec, params, version, channel) -> Dict[str, Any]:
+        """GRPO actor chunk: the spec's prompt batch fans out into
+        ``group_size`` group-contiguous rows, generates serially under the
+        adopted params, and produces the score batch. (Async GRPO keeps the
+        serial generation path; the CB group-aware harvest stays on the
+        single-program loop.)"""
+        if bool(getattr(self.config.train, "continuous_batching", False)):
+            raise NotImplementedError(
+                "async_rl + train.continuous_batching is implemented for the "
+                "PPO trainer only: GRPO's group-aware harvest keeps the "
+                "single-program CB loop. Drop one of the two."
+            )
+        G = self.config.method.group_size
+        prompt_ids = np.repeat(spec.prompt_ids, G, axis=0)
+        prompt_mask = np.repeat(spec.prompt_mask, G, axis=0)
+        gen_out = self.generate(prompt_ids, prompt_mask, params=params, rng=spec.rng)
+        B, P = prompt_ids.shape
+        N = int(gen_out.response_tokens.shape[1])
+        score_out = self._dispatch_score(
+            (B, P, N),
+            gen_out.sequences,
+            prompt_mask,
+            gen_out.response_tokens,
+            gen_out.response_mask,
+            params=params,
+        )
+        host_gen = to_host(
+            {
+                "response_tokens": gen_out.response_tokens,
+                "response_mask": gen_out.response_mask,
+                "behavior_logprobs": gen_out.response_logprobs,
+            }
+        )
+        chunk = self._grpo_chunk_produce(
+            prompt_ids,
+            prompt_mask,
+            host_gen["response_tokens"],
+            host_gen["response_mask"],
+            score_out=score_out,
+        )
+        chunk["behavior_logprobs"] = np.asarray(
+            host_gen["behavior_logprobs"], np.float32
+        )
+        return chunk
+
+    def _collect_async_grpo(
+        self, num_rollouts: int, elements: list, agg: Dict[str, Any]
+    ) -> None:
+        """Learner-side drain for GRPO: same ordered-finalize contract as
+        the PPO collector path, with the GRPO finalize tail."""
+        collector = self._ensure_async_collector()
+        collector.begin_collection()
+        while len(elements) < num_rollouts:
+            chunk = collector.next_chunk()
+            agg["score_time_sum"] += chunk.payload["score_s"]
+            self._grpo_chunk_finalize(chunk.payload, elements, agg)
+            mask = chunk.payload["response_mask"]
+            n_per_row = mask.sum(axis=1)
+            agg["slot_steps"] += int(mask.shape[0]) * (
+                int(n_per_row.max()) if n_per_row.size else 0
+            )
+            agg["live_slot_steps"] += int(n_per_row.sum())
+        collector.end_collection()
+        agg["async_stats"] = collector.collection_stats()
+
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:
         """Collect grouped rollouts with group-relative advantages."""
         if self._consume_skip_initial_experience():
@@ -321,7 +448,9 @@ class GRPOTrainer(PPOTrainer):
         }
         exp_time = time()
 
-        if bool(getattr(self.config.train, "continuous_batching", False)):
+        if bool(self.config.async_rl.enabled):
+            self._collect_async_grpo(num_rollouts, elements, agg)
+        elif bool(getattr(self.config.train, "continuous_batching", False)):
             self._grpo_collect_continuous(num_rollouts, elements, agg)
         else:
             self._grpo_collect_serial(num_rollouts, elements, agg)
@@ -335,6 +464,8 @@ class GRPOTrainer(PPOTrainer):
         pooled = np.concatenate(all_scores) if all_scores else np.zeros((0,), np.float32)
         stats["exp_scores/mean"] = float(pooled.mean()) if pooled.size else 0.0
         stats["exp_scores/std"] = float(pooled.std()) if pooled.size else 0.0
+        if "async_stats" in agg:
+            stats.update(agg["async_stats"])
         engine_stats = agg.get("engine_stats")
         if engine_stats is not None:
             engine_metrics = engine_stats.metrics()
@@ -383,6 +514,7 @@ class GRPOTrainer(PPOTrainer):
                 ref_logprobs=batch["ref_logprobs"],
                 advantages=batch["advantages"],
                 mask=batch["response_mask"],
+                behavior_logprobs=batch.get("behavior_logprobs"),
             ),
             out,
         )

@@ -100,6 +100,13 @@ class PPOTrainer(TPUBaseTrainer):
         self._score_fns: Dict[Tuple[int, int, int], Any] = {}
         self.make_experience_stats: Dict[str, float] = {}
 
+        # disaggregated async collection (trlx_tpu/async_rl/,
+        # docs/ASYNC_RL.md): the collector is built lazily at the first
+        # async make_experience; _async_version is the learner's update
+        # clock (the weight-channel version)
+        self._async = None
+        self._async_version = 0
+
         if config.train.rollout_logging_dir is not None:
             self.log_rollouts = True
             self.setup_rollout_logging(config)
@@ -179,7 +186,10 @@ class PPOTrainer(TPUBaseTrainer):
         arrays: Dict[str, np.ndarray] = {"count": np.asarray(len(self.store.history))}
         for i, elem in enumerate(self.store.history):
             for f in _dc.fields(elem):
-                value = np.asarray(getattr(elem, f.name))
+                raw = getattr(elem, f.name)
+                if raw is None:  # optional fields (behavior_logprobs) skip
+                    continue
+                value = np.asarray(raw)
                 if value.dtype.kind == "V":
                     # custom float dtypes (bfloat16) round-trip through npz
                     # as raw void bytes; widen to f32 — exact, and collation
@@ -201,7 +211,10 @@ class PPOTrainer(TPUBaseTrainer):
             for i in range(int(data["count"])):
                 fields = {}
                 for name in names:
-                    value = data[f"{i}.{name}"]
+                    key = f"{i}.{name}"
+                    if key not in data:  # optional field saved as absent
+                        continue
+                    value = data[key]
                     fields[name] = value.item() if value.ndim == 0 else value
                 elements.append(cls(**fields))
         self.store.clear_history()
@@ -366,6 +379,7 @@ class PPOTrainer(TPUBaseTrainer):
         prompt_mask,
         response_tokens,
         response_mask,
+        params=None,  # async actors score under their adopted param copy
     ):
         """Dispatch the scoring forward and start its async device→host
         copies — the single home of the dispatch tail (recompile watchdog,
@@ -384,7 +398,7 @@ class PPOTrainer(TPUBaseTrainer):
             self.mesh,
         )
         score_out = score_fn(
-            self.state.params,
+            self.state.params if params is None else params,
             self.ref_params,
             batch["sequences"],
             batch["prompt_mask"],
@@ -406,11 +420,24 @@ class PPOTrainer(TPUBaseTrainer):
         batch = next(self.prompt_iterator)
         prompt_ids = np.asarray(batch["input_ids"], np.int32)
         prompt_mask = np.asarray(batch["attention_mask"], np.int32)
+        return self._chunk_device(prompt_ids, prompt_mask, stats)
 
+    def _chunk_device(
+        self,
+        prompt_ids: np.ndarray,
+        prompt_mask: np.ndarray,
+        stats: Dict[str, float],
+        params=None,
+        rng=None,
+    ) -> Dict[str, Any]:
+        """Device side of one prompt chunk, prompt batch supplied by the
+        caller — shared verbatim between the serial reference path (trainer
+        state params/RNG) and the async actor path (channel-published
+        params, dispatched per-chunk RNG)."""
         gen_time = time()
         # generate() opens its own fenced "generate" span, nested under the
         # caller's "rollout" span in the Chrome/Perfetto export
-        gen_out = self.generate(prompt_ids, prompt_mask)
+        gen_out = self.generate(prompt_ids, prompt_mask, params=params, rng=rng)
         stats["time/exp_generate"] = time() - gen_time
         stats["time/generate"] = self.last_generate_time
         stats.update(self.last_spec_stats)
@@ -426,6 +453,7 @@ class PPOTrainer(TPUBaseTrainer):
             prompt_mask,
             gen_out.response_tokens,
             gen_out.response_mask,
+            params=params,
         )
         return {
             "prompt_ids": prompt_ids,
@@ -537,6 +565,13 @@ class PPOTrainer(TPUBaseTrainer):
         acc["live_slot_steps"] += int(n_per_row.sum())
 
         prompt_ids, prompt_mask = chunk["prompt_ids"], chunk["prompt_mask"]
+        # async chunks ship the sampler's exact behavior logprobs; they ride
+        # into elements only when the IW correction will consume them — the
+        # default-off path keeps the store's field set (and bytes) identical
+        # to the serial reference
+        behavior = chunk.get("behavior_logprobs")
+        if self.config.method.iw_correction == "off":
+            behavior = None
         for i in range(prompt_ids.shape[0]):
             n_i = int(response_mask[i].sum())
             if n_i == 0:
@@ -551,6 +586,11 @@ class PPOTrainer(TPUBaseTrainer):
                     logprobs=host["logprobs"][i, :n_i],
                     values=host["values"][i, :n_i],
                     rewards=rewards[i, :n_i],
+                    behavior_logprobs=(
+                        np.asarray(behavior[i, :n_i], np.float32)
+                        if behavior is not None
+                        else None
+                    ),
                 )
             )
 
@@ -629,7 +669,7 @@ class PPOTrainer(TPUBaseTrainer):
     # continuous batching (train.continuous_batching)
     # ------------------------------------------------------------------
 
-    def _cb_group_device(self, group: list) -> Dict[str, Any]:
+    def _cb_group_device(self, group: list, params=None) -> Dict[str, Any]:
         """Device side of one harvested group: assemble the score batch from
         individually completed sequences and dispatch the scoring forward
         with async device→host copies — the same ``dev`` contract as
@@ -655,6 +695,7 @@ class PPOTrainer(TPUBaseTrainer):
             prompt_mask,
             response_tokens,
             response_mask,
+            params=params,
         )
         return {
             "prompt_ids": prompt_ids,
@@ -663,7 +704,10 @@ class PPOTrainer(TPUBaseTrainer):
             "score_out": score_out,
         }
 
-    def _cb_make_engine(self, gen_config, extra_kwargs, rows: int, chunk_width: int):
+    def _cb_make_engine(
+        self, gen_config, extra_kwargs, rows: int, chunk_width: int,
+        tag: Any = None, params: Any = None, version: Any = None,
+    ):
         """Build the rollout engine for this trainer — the single home of
         the engine-width invariant (PPO and GRPO must agree): the trainer-
         level prompt budget ``seq_length − max_new_tokens``, bumped to the
@@ -690,7 +734,7 @@ class PPOTrainer(TPUBaseTrainer):
             int(self.config.train.seq_length) - gen_config.max_new_tokens,
             chunk_width,
         )
-        key = ("cb_engine", gen_config, extra_kwargs, rows, engine_p, seg)
+        key = ("cb_engine", gen_config, extra_kwargs, rows, engine_p, seg, tag)
         engine = self._generate_fns.get(key)
         if engine is None:
             fns = self._get_slot_refill_fns(
@@ -698,7 +742,7 @@ class PPOTrainer(TPUBaseTrainer):
             )
             engine = ContinuousEngine(
                 fns,
-                self.state.params,
+                self.state.params if params is None else params,
                 self.tokenizer.pad_token_id,
                 span=self.obs.span,
                 # per-request lifecycle spans (engine/queue_wait → prefill →
@@ -708,7 +752,9 @@ class PPOTrainer(TPUBaseTrainer):
                 prefix_capacity_blocks=int(self.config.engine.prefix_cache_blocks),
             )
             self._generate_fns[key] = engine
-        engine.begin_collection(self.state.params)
+        engine.begin_collection(
+            self.state.params if params is None else params, version=version
+        )
         return engine
 
     def _cb_chunk_keys(self, rows: int) -> np.ndarray:
@@ -827,6 +873,202 @@ class PPOTrainer(TPUBaseTrainer):
             # dying mid-collection keeps its last engine picture
             self.obs.flightrec.record("engine_stats", engine_metrics)
 
+    # ------------------------------------------------------------------
+    # disaggregated async collection (async_rl.enabled; docs/ASYNC_RL.md)
+    # ------------------------------------------------------------------
+    #
+    # The actor/learner split: N actors (threads here, or run_actor
+    # processes) produce experience chunks continuously — gated by the
+    # weight channel's staleness bound — while the learner drains chunks in
+    # index order and trains. The learner publishes params after every
+    # update (in-flight weight sync), so collection k+1 is generated under
+    # params at most max_staleness updates behind its consumption.
+
+    def _async_chunks_per_collection(self) -> int:
+        from trlx_tpu.async_rl.actor import chunks_per_collection
+
+        return chunks_per_collection(self.config)
+
+    def _async_queue_capacity(self) -> int:
+        cap = int(self.config.async_rl.queue_capacity)
+        return cap if cap > 0 else 2 * self._async_chunks_per_collection()
+
+    def _async_updates_per_phase(self) -> int:
+        """Optimizer updates between two collections: one learn-loop epoch
+        (the gate target the learner announces at drain end)."""
+        method = self.config.method
+        batches = max(1, int(method.num_rollouts) // int(self.config.train.batch_size))
+        return int(method.ppo_epochs) * batches
+
+    def _ensure_async_collector(self):
+        if self._async is not None:
+            return self._async
+        import os as _os
+
+        from trlx_tpu.async_rl.channel import FileWeightChannel, WeightChannel
+        from trlx_tpu.async_rl.queue import ExperienceQueue, FileExperienceQueue
+        from trlx_tpu.async_rl.runtime import AsyncCollector
+
+        acfg = self.config.async_rl
+        capacity = self._async_queue_capacity()
+        if acfg.mode == "process":
+            if not acfg.root_dir:
+                raise ValueError(
+                    "async_rl.mode: process requires async_rl.root_dir (a "
+                    "directory shared with the run_actor processes)"
+                )
+            queue = FileExperienceQueue(
+                _os.path.join(acfg.root_dir, "spool"),
+                capacity=capacity,
+                poll_interval_s=acfg.poll_interval_s,
+                metrics=self.obs.metrics,
+            )
+            channel = FileWeightChannel(
+                _os.path.join(acfg.root_dir, "weights"),
+                plan=self.resilience.plan,
+                metrics=self.obs.metrics,
+                sync_every=acfg.sync_every,
+                poll_interval_s=acfg.poll_interval_s,
+            )
+            spawn = False  # actors are external run_actor processes
+        elif acfg.mode == "thread":
+            queue = ExperienceQueue(
+                capacity,
+                policy=acfg.queue_policy,
+                metrics=self.obs.metrics,
+                # late-bound through self._async: evicted chunks regenerate
+                on_drop=(
+                    self._async_on_drop
+                    if acfg.queue_policy == "drop_oldest" else None
+                ),
+            )
+            channel = WeightChannel(
+                plan=self.resilience.plan,
+                metrics=self.obs.metrics,
+                sync_every=acfg.sync_every,
+            )
+            spawn = True
+        else:
+            raise ValueError(
+                f"unknown async_rl.mode '{acfg.mode}' (thread | process)"
+            )
+        self._async = AsyncCollector(
+            trainer=self,
+            queue=queue,
+            channel=channel,
+            num_actors=acfg.num_actors,
+            max_staleness=acfg.max_staleness,
+            updates_per_phase=self._async_updates_per_phase(),
+            chunks_per_collection=self._async_chunks_per_collection(),
+            spawn_actors=spawn,
+            chunk_timeout_s=acfg.actor_timeout_s,
+            max_actor_restarts=acfg.max_actor_restarts,
+            metrics=self.obs.metrics,
+            tracer=self.obs.tracer,
+            span=self.obs.span,
+        )
+        self._async.version = self._async_version
+        return self._async
+
+    def _async_on_drop(self, chunk) -> None:
+        """drop_oldest eviction callback: hand the evicted chunk back to the
+        collector for regeneration under fresher params."""
+        if self._async is not None:
+            self._async.requeue_dropped(chunk)
+
+    def _async_produce_chunk(self, spec, params, version, channel) -> Dict[str, Any]:
+        """One actor chunk, device + host halves, under the actor's adopted
+        ``params`` (a channel copy — NEVER ``state.params``, whose buffers
+        the donated train step invalidates). Serial generation by default;
+        with ``train.continuous_batching`` the chunk decodes on the
+        slot-refill engine with PipelineRL-style in-flight weight swaps at
+        segment boundaries. The payload always carries the sampler's exact
+        behavior logprobs — under in-flight swaps they are the only honest
+        record of the (mixed-version) behavior policy."""
+        stats: Dict[str, float] = {}
+        if bool(getattr(self.config.train, "continuous_batching", False)):
+            dev = self._async_produce_cb(spec, params, version, channel, stats)
+        else:
+            dev = self._chunk_device(
+                spec.prompt_ids, spec.prompt_mask, stats, params=params,
+                rng=spec.rng,
+            )
+        chunk = self._rollout_chunk_host(dev)
+        chunk["stats"].update(stats)
+        chunk["behavior_logprobs"] = np.asarray(
+            dev["gen_out"].response_logprobs, np.float32
+        )
+        return chunk
+
+    def _async_produce_cb(
+        self, spec, params, version, channel, stats: Dict[str, float]
+    ) -> Dict[str, Any]:
+        """Continuous-batching actor chunk: slot-refill segment decode over
+        the chunk's prompts with per-row RNG, adopting newly published
+        params at every segment boundary (``ContinuousEngine.swap_params``'s
+        memoized version counter makes the per-segment check one int
+        compare; a real change flushes the prefix cache so stale shared KV
+        is never reused). Live rows keep decoding across a swap — their
+        recorded logprobs remain the exact behavior distribution."""
+        import threading as _threading
+
+        from trlx_tpu.ops.sampling import per_row_keys
+
+        gen_config, extra_kwargs = self._resolve_gen_config(eval_mode=False)
+        ids, mask = spec.prompt_ids, spec.prompt_mask
+        engine = self._cb_make_engine(
+            gen_config, extra_kwargs, ids.shape[0], ids.shape[1],
+            tag=("async", _threading.get_ident()),
+            params=params, version=version,
+        )
+        keys = np.asarray(per_row_keys(spec.rng, ids.shape[0]))
+        engine.enqueue_prompts(ids, mask, keys)
+        completed = []
+        while engine.busy:
+            completed.extend(engine.step())
+            if channel is not None and engine.busy:
+                fresh, fresh_version = channel.fetch(template=self.state.params)
+                engine.swap_params(fresh, fresh_version)
+        completed.sort(key=lambda c: c.index)
+        stats["time/exp_generate"] = engine.stats.decode_s + engine.stats.refill_s
+        stats["time/generate"] = engine.stats.decode_s
+        return self._cb_group_device(completed, params=engine.params)
+
+    def _collect_async(
+        self, num_rollouts: int, elements: list, stats: Dict[str, float],
+        acc: Dict[str, float],
+    ) -> None:
+        """Learner-side drain: consume actor chunks in strict index order
+        (running moments fold exactly as the serial path's) and finalize on
+        this thread. ``begin_collection`` force-publishes the params this
+        collection is consumed under; ``end_collection`` announces the
+        upcoming phase's end version — the staleness gate for the chunks
+        feeding the NEXT collection."""
+        collector = self._ensure_async_collector()
+        collector.begin_collection()
+        while len(elements) < num_rollouts:
+            chunk = collector.next_chunk()
+            self._rollout_chunk_finalize(chunk.payload, elements, stats, acc)
+        collector.end_collection()
+        stats.update(collector.collection_stats())
+
+    def train_step(self, batch):
+        stats = super().train_step(batch)
+        if self._async is not None:
+            # the learner's update clock IS the weight-channel version:
+            # publish after every optimizer update (in-flight sync; thinned
+            # by async_rl.sync_every inside the channel)
+            self._async_version += 1
+            self._async.on_update(self.state.params, self._async_version)
+        return stats
+
+    def _shutdown_collectors(self) -> None:
+        if self._async is not None:
+            try:
+                self._async.close()
+            except Exception:  # pragma: no cover - defensive
+                pass
+
     def _consume_skip_initial_experience(self) -> bool:
         """True exactly once after an emergency-payload restore: the store
         already holds the rollouts this collection would replace."""
@@ -860,7 +1102,14 @@ class PPOTrainer(TPUBaseTrainer):
         }
         exp_time = time()
 
-        if continuous:
+        if bool(self.config.async_rl.enabled):
+            # the actor/learner split (docs/ASYNC_RL.md): actors generate —
+            # continuously, across collections — and this thread only drains
+            # and finalizes. rollout_pipeline_depth is moot here (host work
+            # already runs on actor threads/processes); continuous_batching
+            # selects the actors' engine path.
+            self._collect_async(num_rollouts, elements, stats, acc)
+        elif continuous:
             self._collect_continuous(num_rollouts, depth, elements, stats, acc)
         elif depth > 0:
             self._collect_pipelined(num_rollouts, depth, elements, stats, acc)
@@ -950,6 +1199,7 @@ class PPOTrainer(TPUBaseTrainer):
                     advantages=advantages,
                     returns=returns,
                     mask=response_mask,
+                    behavior_logprobs=batch.get("behavior_logprobs"),
                 ),
                 out,
             )
@@ -974,6 +1224,7 @@ class PPOTrainer(TPUBaseTrainer):
                 advantages=advantages,
                 returns=returns,
                 mask=response_mask,
+                behavior_logprobs=batch.get("behavior_logprobs"),
             ),
             out,
         )
