@@ -11,6 +11,20 @@ import jax.numpy as jnp
 from trlx_tpu.ops.flash_attention import attention_reference, flash_attention
 
 
+def _fwd(q, k, v, mask):
+    return flash_attention(q, k, v, mask, causal=True, interpret=False)
+
+
+def _sq_loss(q, k, v, mask):
+    return jnp.sum(_fwd(q, k, v, mask) ** 2)
+
+
+# jitted once at module scope: one executable per (T,) shape via the jit
+# cache, instead of a fresh lambda (= fresh cache entry) every iteration
+_jit_fwd = jax.jit(_fwd)
+_jit_grad = jax.jit(jax.grad(_sq_loss, argnums=(0, 1, 2)))
+
+
 def main():
     assert jax.default_backend() == "tpu", f"needs TPU, got {jax.default_backend()}"
     for T in (12, 24, 64, 96, 128, 200, 512):
@@ -18,19 +32,10 @@ def main():
         ks = jax.random.split(jax.random.PRNGKey(T), 3)
         q, k, v = (jax.random.normal(x, (B, T, H, D), jnp.float32) for x in ks)
         mask = jnp.ones((B, T), jnp.float32).at[0, : min(5, T - 1)].set(0)
-        out = jax.jit(
-            lambda q, k, v: flash_attention(q, k, v, mask, causal=True, interpret=False)
-        )(q, k, v)
+        out = _jit_fwd(q, k, v, mask)
         ref, _ = attention_reference(q, k, v, mask, causal=True)
         err = float(jnp.max(jnp.abs(out - ref)))
-        g = jax.jit(
-            jax.grad(
-                lambda q, k, v: jnp.sum(
-                    flash_attention(q, k, v, mask, causal=True, interpret=False) ** 2
-                ),
-                argnums=(0, 1, 2),
-            )
-        )(q, k, v)
+        g = _jit_grad(q, k, v, mask)
         gr = jax.grad(
             lambda q, k, v: jnp.sum(attention_reference(q, k, v, mask, causal=True)[0] ** 2),
             argnums=(0, 1, 2),

@@ -53,6 +53,87 @@ def trlx_log_records():
         logger.removeHandler(handler)
 
 
+# ---------------------------------------------------------------------------
+# leaked-thread / leaked-process sentinel
+# ---------------------------------------------------------------------------
+
+# Threads allowed to outlast a test:
+# - trlx-tpu-flops: the prewarmed MFU flops analysis is a one-shot daemon
+#   deliberately left to finish in the background (trainer/base.py);
+# - the persistent Orbax AsyncCheckpointer singleton's worker/executor
+#   threads (utils/checkpoint.py keeps ONE checkpointer alive across saves
+#   by design — its pool threads live with the process).
+_SENTINEL_ALLOWED_THREADS = {"trlx-tpu-flops"}
+_SENTINEL_ALLOWED_PREFIXES = (
+    "ThreadPoolExecutor",
+    # orbax AsyncCheckpointer internals (the persistent singleton's pools)
+    "orbax",
+    "async_save",
+    "metadata_store",
+    "base_pytree_ch",
+    "array_ch",
+)
+
+
+@pytest.fixture(autouse=True)
+def _leak_sentinel(request):
+    """Fail any test that leaks a thread or child process — the dynamic
+    complement of graftlint's GL403 thread-escape pass: an actor/worker
+    thread the shutdown path forgot to join is invisible to a green
+    assertion but races every test that follows it.
+
+    Checked: non-daemon threads (nothing in this repo should ever create
+    one outside the allowlisted pools), daemon threads named ``trlx-*``
+    (every repo-spawned worker is name-tagged: pipeline workers, prefetch,
+    async actors — all have owning close()/join() paths), and
+    ``multiprocessing`` children. A short join grace absorbs shutdown
+    paths that signal first and exit within milliseconds."""
+    import threading
+
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    import multiprocessing
+    import time as _time
+
+    def _leaked():
+        threads = []
+        for t in threading.enumerate():
+            if not t.is_alive() or t.ident in before:
+                continue
+            name = t.name or ""
+            if name in _SENTINEL_ALLOWED_THREADS:
+                continue
+            if any(name.startswith(p) for p in _SENTINEL_ALLOWED_PREFIXES):
+                continue
+            if t.daemon and name.endswith("-guard"):
+                # HostCallGuard's timed-out worker: deliberately abandoned
+                # (Python can't kill a thread stuck in a dead endpoint);
+                # daemon by design so it dies with the process
+                continue
+            if t.daemon and not name.startswith("trlx-"):
+                continue  # runtime-internal daemons (jax, grpc, tqdm...)
+            threads.append(t)
+        procs = [p for p in multiprocessing.active_children() if p.is_alive()]
+        return threads, procs
+
+    threads, procs = _leaked()
+    deadline = _time.monotonic() + 2.0
+    while (threads or procs) and _time.monotonic() < deadline:
+        for t in threads:
+            t.join(timeout=0.2)
+        for p in procs:
+            p.join(timeout=0.2)
+        threads, procs = _leaked()
+    if threads or procs:
+        names = [f"thread {t.name!r} (daemon={t.daemon})" for t in threads]
+        names += [f"process pid={p.pid}" for p in procs]
+        pytest.fail(
+            f"leaked concurrency outlasts the test: {', '.join(names)} — "
+            "join/close it in the owning shutdown path "
+            "(docs/STATIC_ANALYSIS.md 'Thread escape')"
+        )
+
+
 def pytest_collection_modifyitems(config, items):
     """Fast tier: tests measured >= 8s (tests/slow_tests.txt) are auto-marked
     ``slow``, so ``pytest -m "not slow"`` is a <5-min inner loop while plain

@@ -22,6 +22,7 @@ from trlx_tpu.analysis import (
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TREE = os.path.join(REPO_ROOT, "trlx_tpu")
+SCRIPTS = os.path.join(REPO_ROOT, "scripts")
 BASELINE = os.path.join(REPO_ROOT, "GRAFTLINT_BASELINE.txt")
 
 
@@ -423,6 +424,465 @@ def test_lock_discipline_deep_chain_and_augassign(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# thread-escape (GL403/404) and the thread-root set
+# ---------------------------------------------------------------------------
+
+
+def test_thread_roots_discovered_through_self_method_submit_and_partial(tmp_path):
+    """Thread(target=self._loop), executor.submit(partial(f, x)), and a
+    respawn path (a thread root that re-spawns itself, the async_rl actor
+    shape) all land in the callgraph's thread-root set."""
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "__init__.py").write_text("")
+    (root / "mod.py").write_text(textwrap.dedent("""
+        import threading
+        from functools import partial
+
+        def job(x):
+            return x + 1
+
+        class Engine:
+            def start(self, executor):
+                t = threading.Thread(target=self._loop)
+                t.start()
+                executor.submit(partial(job, 2))
+
+            def _loop(self):
+                while True:
+                    self._respawn()
+
+            def _respawn(self):
+                threading.Thread(target=self._loop).start()
+        """))
+    ctx = AnalysisContext(str(root))
+    roots = {(r.fn.qualname, r.via) for r in ctx.callgraph.thread_roots}
+    assert ("Engine._loop", "Thread") in roots
+    assert ("job", "submit") in roots
+    membership = ctx.callgraph.thread_membership()
+    # the respawn helper is reachable from the _loop root (labels are the
+    # root FunctionInfo.full, so same-named roots in different modules
+    # stay distinct)
+    full = next(
+        f.full for f in ctx.callgraph.functions if f.qualname == "Engine._respawn"
+    )
+    assert any(label.endswith("Engine._loop") for label in membership[full])
+
+
+def test_thread_roots_on_real_tree_cover_async_and_pipeline():
+    """The real tree's actor/worker spawn points stay discovered (guards
+    against the escape analysis going vacuous after a refactor)."""
+    ctx = AnalysisContext(TREE)
+    roots = {r.fn.qualname for r in ctx.callgraph.thread_roots}
+    assert "AsyncCollector._actor_main" in roots  # incl. the respawn path
+    assert "RolloutPipeline._worker_loop" in roots
+    assert any("work" in r for r in roots)  # the PPO pipeline submit closures
+    membership = ctx.callgraph.thread_membership()
+    # the dispatcher helpers run on the actor root, not main
+    spec_fn = next(
+        f.full for f in ctx.callgraph.functions
+        if f.qualname == "AsyncCollector._next_spec"
+    )
+    assert any("_actor_main" in r for r in membership[spec_fn])
+
+
+_ESCAPE_PKG = {
+    "esc.py": """
+    import threading
+
+    class Pipe:
+        def __init__(self):
+            self.total = 0.0
+            self.started = False
+
+        def start(self):
+            self.started = True
+            threading.Thread(target=self._worker).start()
+
+        def _worker(self):
+            self.total += 1.0      # unguarded cross-thread write
+
+        def read(self):
+            return self.total      # main-thread read of the same attr
+    """
+}
+
+
+def test_thread_escape_unguarded_cross_thread_write(tmp_path):
+    findings = lint_pkg(tmp_path, _ESCAPE_PKG, passes=["thread-escape"])
+    assert codes(findings) == ["GL403"]
+    assert findings[0].detail == "total"
+    assert findings[0].symbol == "Pipe"
+
+
+def test_thread_escape_negatives(tmp_path):
+    # locked both sides (annotated), init-only writes, single-root attrs,
+    # and sync-primitive method calls are all clean
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "good.py": """
+            import threading
+
+            class Pipe:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._stop = threading.Event()
+                    self.total = 0.0  # guarded-by: _lock
+                    self.config = {"depth": 2}
+
+                def start(self):
+                    threading.Thread(target=self._worker).start()
+
+                def _worker(self):
+                    while not self._stop.is_set():
+                        with self._lock:
+                            self.total += 1.0
+
+                def read(self):
+                    with self._lock:
+                        return self.total + self.config["depth"]
+
+                def close(self):
+                    self._stop.set()
+
+                def main_only(self):
+                    self.tally = 1.0     # written+read on main only
+                    return self.tally
+            """
+        },
+        passes=["thread-escape"],
+    )
+    assert findings == []
+
+
+def test_thread_escape_annotated_attr_unlocked_read(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "read.py": """
+            import threading
+
+            class Pipe:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.total = 0.0  # guarded-by: _lock
+
+                def start(self):
+                    threading.Thread(target=self._worker).start()
+
+                def _worker(self):
+                    with self._lock:
+                        self.total += 1.0
+
+                def read(self):
+                    return self.total       # cross-thread read, no lock
+            """
+        },
+        passes=["thread-escape"],
+    )
+    assert [(f.code, f.detail) for f in findings] == [("GL403", "total:read")]
+    assert findings[0].symbol == "Pipe.read"
+
+
+def test_thread_escape_closure_rebind(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "rebind.py": """
+            import threading
+
+            def collect(executor, items):
+                total = 0.0
+                def work():
+                    nonlocal total
+                    total += 1.0        # races the submitting frame
+                for _ in items:
+                    executor.submit(work)
+                return total
+            """
+        },
+        passes=["thread-escape"],
+    )
+    assert ("GL404", "total") in [(f.code, f.detail) for f in findings]
+
+
+def test_thread_escape_shared_helper_keeps_main_membership(tmp_path):
+    """A helper reachable from a thread root AND called by main-side code
+    carries both labels — the race through the shared helper is a finding,
+    not worker-private state."""
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "shared.py": """
+            import threading
+
+            class Acc:
+                def start(self):
+                    threading.Thread(target=self._worker).start()
+
+                def _worker(self):
+                    self._bump()
+
+                def _bump(self):
+                    self.count = 1.0
+
+                def main_loop(self):
+                    self._bump()
+                    return self.count
+            """
+        },
+        passes=["thread-escape"],
+    )
+    assert ("GL403", "count") in [(f.code, f.detail) for f in findings]
+
+
+def test_thread_escape_worker_private_state_is_clean(tmp_path):
+    """The spawn-site reference (`Thread(target=...)` / `submit(work)`)
+    must NOT give the root function main membership: state touched only
+    inside the worker body is single-root."""
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "private.py": """
+            import threading
+
+            class Counter:
+                def start(self):
+                    def work():
+                        self.ticks = 1.0
+                        return self.ticks       # worker-private
+                    threading.Thread(target=work).start()
+            """
+        },
+        passes=["thread-escape"],
+    )
+    assert findings == []
+
+
+def test_thread_escape_default_args_belong_to_spawner(tmp_path):
+    # `def work(fn=self._x)` evaluates on the MAIN thread at def time:
+    # not a cross-thread read (the real flops-thread pattern)
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "defaults.py": """
+            import threading
+
+            class T:
+                def setup(self):
+                    self._fn = lambda: 1
+
+                def go(self):
+                    def work(fn=self._fn):
+                        return fn()
+                    threading.Thread(target=work).start()
+            """
+        },
+        passes=["thread-escape"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# collective-discipline (GL701–GL704)
+# ---------------------------------------------------------------------------
+
+
+def test_gl701_rank_guarded_collective(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "bad.py": """
+            import jax
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            def exchange(flag):
+                if jax.process_index() == 0:
+                    # only rank 0 posts: every other rank hangs it
+                    return multihost_utils.process_allgather(np.asarray(flag))
+                return None
+            """
+        },
+        passes=["collective-discipline"],
+    )
+    assert codes(findings) == ["GL701"]
+    assert findings[0].detail == "process_allgather"
+
+
+def test_gl701_through_predicate_local_and_early_return(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "deep.py": """
+            import jax
+            from jax.experimental import multihost_utils
+
+            def _is_primary():
+                return jax.process_index() == 0
+
+            def barrier(name):
+                multihost_utils.sync_global_devices(name)
+
+            def commit_guarded():
+                primary = _is_primary()
+                if primary:
+                    barrier("inside_guard")   # bearing call under rank guard
+
+            def commit_early_exit():
+                if _is_primary():
+                    return
+                barrier("after_exit")         # only non-primary ranks arrive
+            """
+        },
+        passes=["collective-discipline"],
+    )
+    assert codes(findings) == ["GL701", "GL701"]
+    assert {f.symbol for f in findings} == {"commit_guarded", "commit_early_exit"}
+
+
+def test_gl701_negative_barrier_paired_primary_commit(tmp_path):
+    """The legitimate checkpoint-commit shape: rank 0 authors host-side
+    files INSIDE the guard, the barrier stays OUTSIDE — every rank posts
+    the collective, so nothing fires."""
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "good.py": """
+            import json
+            import jax
+            from jax.experimental import multihost_utils
+
+            def _is_primary():
+                return jax.process_index() == 0
+
+            def commit(directory):
+                if _is_primary():
+                    with open(directory + "/marker", "w") as f:
+                        json.dump({"ok": True}, f)
+                multihost_utils.sync_global_devices(directory)
+
+            def uniform_gate(x):
+                # process_count is identical on every rank: not a rank guard
+                if jax.process_count() > 1:
+                    return multihost_utils.process_allgather(x)
+                return x
+            """
+        },
+        passes=["collective-discipline"],
+    )
+    assert findings == []
+
+
+def test_gl702_per_rank_loop_trip_count(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "loops.py": """
+            import os
+            import jax
+            from jax.experimental import multihost_utils
+
+            def bad(spool):
+                for name in os.listdir(spool):     # per-rank directory state
+                    multihost_utils.sync_global_devices(name)
+
+            def bad_local(reqs, x):
+                # a bare local hides its per-rank provenance: not uniform
+                pending = [r for r in reqs if r.rank == jax.process_index()]
+                for p in pending:
+                    multihost_utils.process_allgather(p)
+
+            def good(config, x):
+                for _ in range(config.train.epochs):   # uniform by contract
+                    multihost_utils.process_allgather(x)
+            """
+        },
+        passes=["collective-discipline"],
+    )
+    assert codes(findings) == ["GL702", "GL702"]
+    assert {f.symbol for f in findings} == {"bad", "bad_local"}
+
+
+def test_gl703_duplicated_barrier_literal(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "names.py": """
+            from jax.experimental import multihost_utils
+
+            def save():
+                multihost_utils.sync_global_devices("ckpt_edge")
+
+            def restore():
+                multihost_utils.sync_global_devices("ckpt_edge")
+
+            def unique():
+                multihost_utils.sync_global_devices("only_here")
+            """
+        },
+        passes=["collective-discipline"],
+    )
+    assert codes(findings) == ["GL703", "GL703"]
+    assert all(f.detail == "ckpt_edge" for f in findings)
+    # ...including through a parameter-forwarding wrapper
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "wrap.py": """
+            from jax.experimental import multihost_utils
+
+            def barrier(name):
+                multihost_utils.sync_global_devices(f"pkg_{name}")
+
+            def one():
+                barrier("edge")
+
+            def two():
+                barrier("edge")
+            """
+        },
+        passes=["collective-discipline"],
+        name="pkg2",
+    )
+    assert codes(findings) == ["GL703", "GL703"]
+
+
+def test_gl704_config_gated_collective(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "gated.py": """
+            from jax.experimental import multihost_utils
+
+            def boundary(config, flag):
+                if config.resilience.exchange_flags:   # unregistered field
+                    multihost_utils.process_allgather(flag)
+                if config.resilience.coordinate_preemption:  # registered
+                    multihost_utils.process_allgather(flag)
+            """
+        },
+        passes=["collective-discipline"],
+    )
+    assert [(f.code, f.detail) for f in findings] == [
+        ("GL704", "exchange_flags->process_allgather")
+    ]
+
+
+def test_rank_uniform_registry_matches_real_gates():
+    """The registered contract fields stay declared on the real config
+    dataclasses (a renamed knob must re-justify its registry entry)."""
+    from trlx_tpu.analysis.collectives import RANK_UNIFORM_FIELDS
+    from trlx_tpu.analysis.conventions import ConfigKeysPass
+
+    sections = ConfigKeysPass()._collect_sections(AnalysisContext(TREE))
+    declared = set().union(*sections.values())
+    missing = RANK_UNIFORM_FIELDS - declared
+    assert not missing, f"registered rank-uniform fields not on any config: {missing}"
+
+
+# ---------------------------------------------------------------------------
 # metric-names (GL501) and config-keys (GL601)
 # ---------------------------------------------------------------------------
 
@@ -662,6 +1122,66 @@ def test_cli_select_on_real_tree_exits_zero():
     assert main([TREE, "--select", "host-sync", "--baseline", BASELINE]) == 0
 
 
+def test_cli_format_json_and_sarif(tmp_path, capsys):
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "__init__.py").write_text("")
+    (root / "bad.py").write_text(textwrap.dedent(_VIOLATION_PKG["bad.py"]))
+
+    import json
+
+    assert main([str(root), "--no-baseline", "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["findings"]) == 1
+    assert doc["findings"][0]["code"] == "GL101"
+    assert doc["baselined"] == 0 and doc["stale_baseline_entries"] == []
+
+    # sarif to stdout: a valid 2.1.0 doc with one result per finding
+    assert main([str(root), "--no-baseline", "--format", "sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    assert [r["ruleId"] for r in results] == ["GL101"]
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "pkg/bad.py"
+    assert loc["region"]["startLine"] > 0
+    rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert rules == {"GL101"}
+
+    # --output: the doc lands in the file, human rendering stays on stdout
+    out_path = tmp_path / "lint.sarif"
+    assert main(
+        [str(root), "--no-baseline", "--format", "sarif", "--output",
+         str(out_path)]
+    ) == 1
+    human = capsys.readouterr().out
+    assert "GL101" in human and "graftlint:" in human
+    doc = json.loads(out_path.read_text())
+    assert doc["runs"][0]["results"]
+
+    # --output without a structured format is a usage error
+    assert main([str(root), "--output", str(out_path)]) == 2
+
+
+def test_cli_multi_root_single_run(tmp_path, capsys):
+    """Two roots share one run and one baseline: a clean root does not mark
+    the other root's baseline entries stale."""
+    a = tmp_path / "pkg_a"
+    b = tmp_path / "pkg_b"
+    for root in (a, b):
+        root.mkdir()
+        (root / "__init__.py").write_text("")
+    (a / "bad.py").write_text(textwrap.dedent(_VIOLATION_PKG["bad.py"]))
+
+    findings, ctxs = run_analysis([str(a), str(b)], passes=["host-sync"])
+    assert len(ctxs) == 2 and len(findings) == 1
+    bl = tmp_path / "bl.txt"
+    bl.write_text(f"{findings[0].key} :: fixture: intentional\n")
+    assert main([str(a), str(b), "--baseline", str(bl)]) == 0
+    out = capsys.readouterr().out
+    assert "stale" not in out
+
+
 def test_cli_rejects_no_baseline_with_update_baseline(tmp_path):
     # the combination would rewrite the baseline without loading it,
     # destroying every committed justification
@@ -727,8 +1247,12 @@ def test_default_baseline_is_scan_root_adjacent_not_cwd(tmp_path, monkeypatch):
 
 @pytest.fixture(scope="module")
 def tree_findings():
-    findings, ctx = run_analysis(TREE)
-    assert ctx.errors == [], f"unparseable sources: {ctx.errors}"
+    # the CI gate's exact scan surface: the package AND scripts/ (bench/
+    # evidence tooling spawns processes and writes spool files — linted
+    # with the same baseline, in the same run)
+    findings, ctxs = run_analysis([TREE, SCRIPTS])
+    for ctx in ctxs:
+        assert ctx.errors == [], f"unparseable sources: {ctx.errors}"
     return findings
 
 
@@ -767,6 +1291,34 @@ def test_self_run_detects_injected_violation(tree_findings, tmp_path):
     assert [f.key for f in new] == [findings[0].key]
 
 
+def test_self_run_detects_injected_concurrency_violations(tree_findings, tmp_path):
+    """The acceptance shapes: an unguarded cross-thread write and a
+    process_index()-guarded allgather each surface under their own code
+    through the committed baseline."""
+    escape = lint_pkg(tmp_path, _ESCAPE_PKG, passes=["thread-escape"])
+    guarded = lint_pkg(
+        tmp_path,
+        {
+            "rank.py": """
+            import jax
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            def exchange(flag):
+                if jax.process_index() == 0:
+                    return multihost_utils.process_allgather(np.asarray(flag))
+                return None
+            """
+        },
+        passes=["collective-discipline"],
+        name="pkg_rank",
+    )
+    assert codes(escape) == ["GL403"] and codes(guarded) == ["GL701"]
+    baseline = Baseline.load(BASELINE)
+    new, _ = baseline.apply(list(tree_findings) + escape + guarded)
+    assert sorted(f.code for f in new) == ["GL403", "GL701"]
+
+
 def test_lint_py_ci_entry():
     """scripts/lint.py (the CI entry point) exits 0 on the committed tree."""
     proc = subprocess.run(
@@ -785,7 +1337,8 @@ def test_pass_registry_and_codes():
     passes = all_passes()
     assert set(passes) == {
         "host-sync", "recompile-hazard", "donation-safety",
-        "lock-discipline", "metric-names", "span-names", "config-keys",
+        "lock-discipline", "thread-escape", "collective-discipline",
+        "metric-names", "span-names", "config-keys",
     }
     seen = set()
     for cls in passes.values():

@@ -441,13 +441,19 @@ def test_grpo_group_aware_equivalence(tmp_path):
 
     serial = make("s", False)
     continuous = make("c", True)
-    serial.make_experience(16)
-    continuous.make_experience(16)
-    assert len(serial.store) == len(continuous.store) == 16
-    a, b = _canonical(serial.store), _canonical(continuous.store)
-    assert set(a) == set(b)
-    for key in a:
-        np.testing.assert_array_equal(
-            np.asarray(a[key].logprobs), np.asarray(b[key].logprobs)
-        )
-        assert a[key].advantage == b[key].advantage
+    try:
+        serial.make_experience(16)
+        continuous.make_experience(16)
+        assert len(serial.store) == len(continuous.store) == 16
+        a, b = _canonical(serial.store), _canonical(continuous.store)
+        assert set(a) == set(b)
+        for key in a:
+            np.testing.assert_array_equal(
+                np.asarray(a[key].logprobs), np.asarray(b[key].logprobs)
+            )
+            assert a[key].advantage == b[key].advantage
+    finally:
+        # a mid-epoch stop leaves the prompt-prefetch worker parked
+        # otherwise — the conftest leak sentinel fails the test
+        serial._shutdown_collectors()
+        continuous._shutdown_collectors()

@@ -511,15 +511,21 @@ def test_grpo_paged_groups_match_serial(tmp_path):
         "p", True,
         engine_overrides=dict(backend="paged", kv_block_size=4, prefix_cache=True),
     )
-    serial.make_experience(16)
-    paged.make_experience(16)
-    assert len(serial.store) == len(paged.store) == 16
-    a, b = _canonical(serial.store), _canonical(paged.store)
-    assert set(a) == set(b)
-    for key in a:
-        np.testing.assert_array_equal(
-            np.asarray(a[key].logprobs), np.asarray(b[key].logprobs)
-        )
-        assert a[key].advantage == b[key].advantage
-    # identical group members share committed full prompt blocks
-    assert paged.make_experience_stats["engine/prefix_hit_rate"] > 0.0
+    try:
+        serial.make_experience(16)
+        paged.make_experience(16)
+        assert len(serial.store) == len(paged.store) == 16
+        a, b = _canonical(serial.store), _canonical(paged.store)
+        assert set(a) == set(b)
+        for key in a:
+            np.testing.assert_array_equal(
+                np.asarray(a[key].logprobs), np.asarray(b[key].logprobs)
+            )
+            assert a[key].advantage == b[key].advantage
+        # identical group members share committed full prompt blocks
+        assert paged.make_experience_stats["engine/prefix_hit_rate"] > 0.0
+    finally:
+        # a mid-epoch stop leaves the prompt-prefetch worker parked
+        # otherwise — the conftest leak sentinel fails the test
+        serial._shutdown_collectors()
+        paged._shutdown_collectors()

@@ -31,11 +31,18 @@ docs/STATIC_ANALYSIS.md.
 
 import ast
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Set, Tuple
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
 
 from trlx_tpu.analysis.core import AnalysisContext, SourceModule
 
-__all__ = ["CallGraph", "FunctionInfo", "ClassInfo", "JitRoot", "attr_chain"]
+__all__ = [
+    "CallGraph",
+    "FunctionInfo",
+    "ClassInfo",
+    "JitRoot",
+    "ThreadRoot",
+    "attr_chain",
+]
 
 # canonical dotted names that open a trace when called with a function
 JIT_WRAPPERS = {
@@ -47,6 +54,13 @@ JIT_WRAPPERS = {
     "jax.experimental.shard_map.shard_map",
 }
 PARTIAL_NAMES = {"functools.partial", "partial"}
+
+# canonical dotted names whose `target=` keyword starts a new thread of
+# control (the thread-root constructors the escape analysis keys on)
+THREAD_CONSTRUCTORS = {
+    "threading.Thread",
+    "multiprocessing.Process",
+}
 
 
 def attr_chain(node: ast.AST) -> Optional[List[str]]:
@@ -113,6 +127,23 @@ class JitRoot:
     line: int
     static_argnums: Tuple[int, ...] = ()
     donate_argnums: Tuple[int, ...] = ()
+
+
+@dataclass
+class ThreadRoot:
+    """One function that starts executing on its own thread of control:
+    the ``target=`` of a ``threading.Thread``/``multiprocessing.Process``
+    constructor, or the callable handed to an ``.submit(...)`` call
+    (``concurrent.futures`` executors AND the package's own
+    ``RolloutPipeline.submit`` — both run the callable on a worker
+    thread). Resolution reuses the jit-root machinery: closures, bound
+    ``self.m`` methods, ``partial(f, x)`` wrapping, factory returns, and
+    lambdas all resolve (``resolve_callable_deep``)."""
+
+    fn: FunctionInfo
+    via: str  # "Thread" | "Process" | "submit"
+    module: SourceModule
+    line: int
 
 
 def _int_tuple(node: Optional[ast.AST]) -> Tuple[int, ...]:
@@ -305,6 +336,8 @@ class CallGraph:
         self.jit_roots: List[JitRoot] = []
         self.traced: Set[str] = set()  # FunctionInfo.full
         self.traced_via: Dict[str, str] = {}  # full -> root qualname
+        self.thread_roots: List[ThreadRoot] = []
+        self._thread_membership: Optional[Dict[str, FrozenSet[str]]] = None
         self._build()
 
     # -- construction ----------------------------------------------------
@@ -319,6 +352,7 @@ class CallGraph:
         self._link_classes()
         self._collect_jit_roots()
         self._mark_traced()
+        self._collect_thread_roots()
 
     def _link_classes(self) -> None:
         self._supers: Dict[str, Set[str]] = {}
@@ -701,3 +735,106 @@ class CallGraph:
 
     def traced_functions(self) -> List[FunctionInfo]:
         return [fn for fn in self.functions if fn.full in self.traced]
+
+    # -- thread roots & per-root reachability -----------------------------
+
+    def _resolve_thread_target(
+        self, expr: ast.AST, scope: Optional[FunctionInfo], mod: SourceModule
+    ) -> List[FunctionInfo]:
+        if isinstance(expr, ast.Lambda):
+            return [fn for fn in self.functions if fn.module is mod and fn.node is expr]
+        return self.resolve_callable_deep(expr, scope, mod)
+
+    def _collect_thread_roots(self) -> None:
+        seen: Set[Tuple[str, str]] = set()  # (full, via): one root per pair
+        for mod in self.ctx.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                scope = self.enclosing_function(mod, node)
+                target: Optional[ast.AST] = None
+                via = None
+                name = self.external_name(node.func, scope, mod)
+                if name in THREAD_CONSTRUCTORS:
+                    via = name.rsplit(".", 1)[-1]
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = kw.value
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "submit"
+                    and node.args
+                ):
+                    # executor.submit(f, ...) / pipe.submit(work): the first
+                    # positional arg runs on a worker thread
+                    via = "submit"
+                    target = node.args[0]
+                if target is None:
+                    continue
+                for fn in self._resolve_thread_target(target, scope, mod):
+                    if (fn.full, via) in seen:
+                        continue
+                    seen.add((fn.full, via))
+                    self.thread_roots.append(
+                        ThreadRoot(fn=fn, via=via, module=mod, line=node.lineno)
+                    )
+
+    def thread_membership(self) -> Dict[str, FrozenSet[str]]:
+        """``FunctionInfo.full`` → the set of thread-root labels (root
+        ``FunctionInfo.full``\\ s, plus the implicit ``"main"``) whose
+        execution can reach the function. Functions not reachable from any
+        spawned-thread root belong to ``"main"`` alone; a thread-reachable
+        function that main-side code ALSO calls carries ``"main"`` *and*
+        its thread labels, so a shared helper's accesses count on both
+        sides of the escape check (a stats accumulator touched by the
+        trainer loop and an actor worker is cross-thread, not
+        worker-private).
+
+        Reachability follows the same edges as jit-root tracing (resolved
+        calls, bare function references, nested defs), so a thread target
+        that fans out through ``self.m()`` dispatch or factory closures is
+        followed the same way a jitted root is.
+        """
+        if self._thread_membership is not None:
+            return self._thread_membership
+
+        def reach(fn: FunctionInfo, seen: Set[str], skip: Set[str]) -> None:
+            work = [fn]
+            seen.add(fn.full)
+            while work:
+                cur = work.pop()
+                callees = list(self.edges(cur))
+                for group in cur.nested.values():
+                    callees.extend(group)
+                for callee in callees:
+                    if callee.full not in seen and callee.full not in skip:
+                        seen.add(callee.full)
+                        work.append(callee)
+
+        membership: Dict[str, Set[str]] = {}
+        thread_reachable: Set[str] = set()
+        root_fulls = {r.fn.full for r in self.thread_roots}
+        for root in self.thread_roots:
+            seen: Set[str] = set()
+            reach(root.fn, seen, set())
+            thread_reachable |= seen
+            for full in seen:
+                membership.setdefault(full, set()).add(root.fn.full)
+        # main reaches everything not exclusively behind a spawn point:
+        # BFS from every function outside the thread-reachable set re-adds
+        # "main" to shared helpers main-side code also calls. The BFS never
+        # descends INTO a thread-root function: the spawning frame holds a
+        # bare reference to its target (`Thread(target=work)` is a Name
+        # edge), and a spawn is not a main-side execution of the body.
+        main_seen: Set[str] = set()
+        for fn in self.functions:
+            if fn.full not in thread_reachable and fn.full not in main_seen:
+                reach(fn, main_seen, root_fulls)
+        out: Dict[str, FrozenSet[str]] = {}
+        for fn in self.functions:
+            roots = set(membership.get(fn.full, ()))
+            if fn.full in main_seen or not roots:
+                roots.add("main")
+            out[fn.full] = frozenset(roots)
+        self._thread_membership = out
+        return out

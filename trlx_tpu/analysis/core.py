@@ -190,7 +190,12 @@ def register_pass(cls: Type[LintPass]) -> Type[LintPass]:
 
 def _ensure_builtin_passes() -> None:
     # importing the pass modules populates the registry
-    from trlx_tpu.analysis import conventions, jax_passes, locks  # noqa: F401
+    from trlx_tpu.analysis import (  # noqa: F401
+        collectives,
+        conventions,
+        jax_passes,
+        locks,
+    )
 
 
 def all_passes() -> Dict[str, Type[LintPass]]:
@@ -209,19 +214,165 @@ def get_pass(name: str) -> Type[LintPass]:
 
 
 def run_analysis(
-    root: str,
+    root,
     passes: Optional[Iterable[str]] = None,
     ctx: Optional[AnalysisContext] = None,
-) -> Tuple[List[Finding], AnalysisContext]:
+):
     """Run ``passes`` (default: all registered) over ``root``; findings are
-    sorted by (path, line, code) for stable output."""
-    ctx = ctx or AnalysisContext(root)
+    sorted by (path, line, code) for stable output.
+
+    ``root`` may be one package directory or a list of them (the CI gate
+    scans ``trlx_tpu/`` and ``scripts/`` in ONE run so a single baseline
+    covers both without cross-root staleness). Single root returns
+    ``(findings, ctx)``; a list returns ``(findings, [ctx, ...])``.
+    """
+    single = isinstance(root, (str, os.PathLike))
+    roots = [root] if single else list(root)
+    if ctx is not None:
+        ctxs = [ctx]
+    else:
+        ctxs = [AnalysisContext(os.fspath(r)) for r in roots]
     names = list(passes) if passes is not None else sorted(all_passes())
     findings: List[Finding] = []
-    for name in names:
-        findings.extend(get_pass(name)().run(ctx))
+    for c in ctxs:
+        for name in names:
+            findings.extend(get_pass(name)().run(c))
     findings.sort(key=lambda f: (f.path, f.line, f.code, f.detail))
-    return findings, ctx
+    return findings, (ctxs[0] if single else ctxs)
+
+
+# ---------------------------------------------------------------------------
+# structured output (--format json|sarif)
+# ---------------------------------------------------------------------------
+
+
+def _finding_dict(f: Finding) -> Dict:
+    return {
+        "code": f.code,
+        "path": f.path,
+        "line": f.line,
+        "symbol": f.symbol,
+        "detail": f.detail,
+        "key": f.key,
+        "message": f.message,
+    }
+
+
+def _json_doc(new, stale, suppressed: int, errors) -> Dict:
+    return {
+        "findings": [_finding_dict(f) for f in new],
+        "stale_baseline_entries": [e.key for e in stale],
+        "baselined": suppressed,
+        "parse_errors": [{"path": p, "error": e} for p, e in errors],
+    }
+
+
+def _code_descriptions() -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for cls in all_passes().values():
+        for code in cls.codes:
+            out[code] = cls.description
+    return out
+
+
+def _sarif_doc(new, stale, errors) -> Dict:
+    """SARIF 2.1.0: one run, one result per non-baselined finding (plus one
+    per stale baseline entry under the synthetic ``GL000`` rule), so CI can
+    annotate findings inline on the PR diff."""
+    desc = _code_descriptions()
+    rules_seen: Dict[str, Dict] = {}
+    results = []
+    for f in new:
+        rules_seen.setdefault(
+            f.code,
+            {
+                "id": f.code,
+                "shortDescription": {"text": desc.get(f.code, f.code)},
+                "helpUri": "docs/STATIC_ANALYSIS.md",
+            },
+        )
+        results.append(
+            {
+                "ruleId": f.code,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {"startLine": max(1, f.line)},
+                        }
+                    }
+                ],
+                "partialFingerprints": {"graftlintKey": f.key},
+            }
+        )
+    for entry in stale:
+        rules_seen.setdefault(
+            "GL000",
+            {
+                "id": "GL000",
+                "shortDescription": {
+                    "text": "stale baseline entry (fix shipped? delete it)"
+                },
+            },
+        )
+        results.append(
+            {
+                "ruleId": "GL000",
+                "level": "error",
+                "message": {
+                    "text": "stale baseline entry no longer matches any "
+                    f"finding: {entry.key}"
+                },
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": "GRAFTLINT_BASELINE.txt"},
+                            "region": {"startLine": max(1, entry.line)},
+                        }
+                    }
+                ],
+            }
+        )
+    for path, err in errors:
+        results.append(
+            {
+                "ruleId": "GL000",
+                "level": "error",
+                "message": {"text": f"unparseable source: {err}"},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": path},
+                            "region": {"startLine": 1},
+                        }
+                    }
+                ],
+            }
+        )
+    if errors and "GL000" not in rules_seen:
+        rules_seen["GL000"] = {
+            "id": "GL000",
+            "shortDescription": {"text": "graftlint gate integrity"},
+        }
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+        "master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "informationUri": "docs/STATIC_ANALYSIS.md",
+                        "rules": [rules_seen[k] for k in sorted(rules_seen)],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -256,9 +407,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument(
         "root",
-        nargs="?",
+        nargs="*",
         default=None,
-        help="package directory to lint (default: the installed trlx_tpu)",
+        help="package director(y/ies) to lint (default: the installed "
+        "trlx_tpu). Multiple roots share one run — and one baseline, "
+        "resolved next to the FIRST root",
     )
     parser.add_argument(
         "--baseline",
@@ -286,6 +439,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--list-passes", action="store_true", help="list passes and exit"
     )
+    parser.add_argument(
+        "--format",
+        choices=("human", "json", "sarif"),
+        default="human",
+        help="output format (default human). json/sarif print the "
+        "structured document to stdout — or to --output, keeping the "
+        "human rendering on stdout for the terminal",
+    )
+    parser.add_argument(
+        "--output",
+        default=None,
+        help="write the --format json|sarif document to this path instead "
+        "of stdout (human output still prints; CI annotates from the file)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_passes:
@@ -294,10 +461,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{name:18s} {codes:22s} {cls.description}")
         return 0
 
-    root = args.root or _default_root()
-    if not os.path.isdir(root):
-        print(f"graftlint: not a directory: {root}", file=sys.stderr)
-        return 2
+    roots = list(args.root) if args.root else [_default_root()]
+    for root in roots:
+        if not os.path.isdir(root):
+            print(f"graftlint: not a directory: {root}", file=sys.stderr)
+            return 2
     if args.no_baseline and args.update_baseline:
         print(
             "graftlint: --no-baseline with --update-baseline would rewrite "
@@ -306,25 +474,37 @@ def main(argv: Optional[List[str]] = None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.output and args.format == "human":
+        print(
+            "graftlint: --output needs --format json|sarif (human output "
+            "already goes to stdout)",
+            file=sys.stderr,
+        )
+        return 2
     passes = args.select.split(",") if args.select else None
     try:
-        findings, ctx = run_analysis(root, passes=passes)
+        findings, ctxs = run_analysis(roots, passes=passes)
         selected_codes = set()
         for name in passes if passes is not None else sorted(all_passes()):
             selected_codes.update(get_pass(name).codes)
     except KeyError as e:
         print(f"graftlint: {e.args[0]}", file=sys.stderr)
         return 2
-    for relpath, err in ctx.errors:
+    errors: List[Tuple[str, str]] = [e for c in ctxs for e in c.errors]
+    n_modules = sum(len(c.modules) for c in ctxs)
+    for relpath, err in errors:
         print(f"graftlint: syntax error in {relpath}: {err}", file=sys.stderr)
 
-    baseline_path = args.baseline or _default_baseline(root)
+    baseline_path = args.baseline or _default_baseline(roots[0])
     baseline = Baseline()
     if baseline_path and not args.no_baseline:
         try:
             baseline = Baseline.load(baseline_path)
         except BaselineError as e:
             print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+        except OSError as e:
+            print(f"graftlint: cannot read baseline: {e}", file=sys.stderr)
             return 2
     # entries for passes NOT selected this run are out of scope: they are
     # neither stale (their pass didn't look) nor rewritable by
@@ -339,15 +519,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
 
     if args.update_baseline:
-        if ctx.errors:
+        if errors:
             print(
                 "graftlint: refusing --update-baseline with unparseable "
                 "sources — their findings would silently drop out",
                 file=sys.stderr,
             )
             return 2
-        path = baseline_path or _default_baseline(root) or os.path.join(
-            os.path.dirname(os.path.abspath(root)), "GRAFTLINT_BASELINE.txt"
+        path = baseline_path or _default_baseline(roots[0]) or os.path.join(
+            os.path.dirname(os.path.abspath(roots[0])), "GRAFTLINT_BASELINE.txt"
         )
         baseline.update(findings)
         baseline.entries.update(out_of_scope)
@@ -363,35 +543,55 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     new, stale = baseline.apply(findings)
     suppressed = len(findings) - len(new)
-    for f in new:
-        print(f.render())
-    for entry in stale:
-        print(
-            f"{baseline_path}: stale baseline entry no longer matches any "
-            f"finding (fix shipped? delete the entry): {entry.key}"
+
+    import json as _json
+
+    if args.format != "human":
+        doc = (
+            _json_doc(new, stale, suppressed, errors)
+            if args.format == "json"
+            else _sarif_doc(new, stale, errors)
         )
+        if args.output:
+            with open(args.output, "w", encoding="utf-8") as f:
+                _json.dump(doc, f, indent=2)
+                f.write("\n")
+            print(f"graftlint: wrote {args.format} to {args.output}")
+        else:
+            print(_json.dumps(doc, indent=2))
+    emit_human = args.format == "human" or bool(args.output)
+    if emit_human:
+        for f in new:
+            print(f.render())
+        for entry in stale:
+            print(
+                f"{baseline_path}: stale baseline entry no longer matches any "
+                f"finding (fix shipped? delete the entry): {entry.key}"
+            )
     counts: Dict[str, int] = {}
     for f in new:
         counts[f.code] = counts.get(f.code, 0) + 1
     summary = ", ".join(f"{c}×{n}" for c, n in sorted(counts.items()))
     if new or stale:
-        print(
-            f"\ngraftlint: {len(new)} finding(s)"
-            + (f" ({summary})" if summary else "")
-            + (f", {len(stale)} stale baseline entr(y/ies)" if stale else "")
-            + (f"; {suppressed} baselined" if suppressed else "")
-            + " — see docs/STATIC_ANALYSIS.md"
-        )
+        if emit_human:
+            print(
+                f"\ngraftlint: {len(new)} finding(s)"
+                + (f" ({summary})" if summary else "")
+                + (f", {len(stale)} stale baseline entr(y/ies)" if stale else "")
+                + (f"; {suppressed} baselined" if suppressed else "")
+                + " — see docs/STATIC_ANALYSIS.md"
+            )
         return 1
-    if ctx.errors:
-        print(
-            f"graftlint: FAILED — {len(ctx.errors)} unparseable file(s) "
-            "(see stderr); their findings are unknown"
-        )
+    if errors:
+        if emit_human:
+            print(
+                f"graftlint: FAILED — {len(errors)} unparseable file(s) "
+                "(see stderr); their findings are unknown"
+            )
         return 1
-    n_mod = len(ctx.modules)
-    print(
-        f"graftlint: OK ({n_mod} modules, "
-        f"{suppressed} baselined finding(s), 0 new)"
-    )
+    if emit_human:
+        print(
+            f"graftlint: OK ({n_modules} modules, "
+            f"{suppressed} baselined finding(s), 0 new)"
+        )
     return 0

@@ -1,8 +1,9 @@
-"""Lock-discipline pass (GL4xx): attributes annotated ``# guarded-by:
-<lock>`` must only be mutated inside ``with self.<lock>:``.
+"""Lock-discipline (GL401/402) and thread-escape (GL403/404) passes.
 
-The annotation lives as a trailing comment on the attribute's assignment
-line (typically in ``__init__``)::
+**GL401/402 — annotation checking.** Attributes annotated ``# guarded-by:
+<lock>`` must only be mutated inside ``with self.<lock>:``. The annotation
+lives as a trailing comment on the attribute's assignment line (typically
+in ``__init__``)::
 
     self._stats_lock = threading.Lock()
     self.stats = PipelineStats()  # guarded-by: _stats_lock
@@ -19,16 +20,38 @@ the object escapes to another thread):
 - GL402 — the annotation names a lock attribute never assigned in the
   class (a typo'd lock name silently guards nothing).
 
-Scope: annotation-driven, so any module can opt in; the threaded pipeline
-modules (``pipeline/rollout_pipeline.py``) and the tracer
-(``observability/tracing.py``) carry annotations today.
+**GL403/404 — escape detection.** GL401 only fires where an annotation
+exists; the scarier bug is shared state *nobody annotated*. The escape
+pass builds the **thread-root set** (``callgraph.ThreadRoot``: every
+``threading.Thread(target=...)`` / ``multiprocessing.Process`` /
+``.submit(...)`` target, resolved through closures, ``partial``, bound
+methods, and factories) and computes which thread root(s) reach each
+function. An instance attribute **written under one root and read or
+written under another** is a data race unless a ``# guarded-by:`` lock is
+held on both sides:
+
+- GL403 — cross-thread shared attribute with **no** guarded-by annotation
+  (one finding per class+attr, at the escaping write), or an annotated
+  attribute **read** outside its lock in a function another root also
+  reaches (unlocked cross-thread writes stay GL401's);
+- GL404 — a thread-target closure rebinding an enclosing-scope local via
+  ``nonlocal``/``global`` (`total += dt` from a worker races the
+  submitting frame non-atomically).
+
+Exemptions (kept deliberately narrow): ``__init__``/declaring-method
+construction (pre-escape); attributes that *are* synchronization or
+thread-safe-queue objects (``threading.Lock``/``Condition``/``Event``,
+``queue.Queue`` — method calls on them are their contract, though
+re-*assigning* one post-init still counts); methods/callables (not
+state); attributes never written outside construction (immutable config).
 """
 
 import ast
 import re
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
 
-from trlx_tpu.analysis.callgraph import attr_chain
+from trlx_tpu.analysis.callgraph import CallGraph, FunctionInfo, attr_chain
 from trlx_tpu.analysis.core import (
     AnalysisContext,
     Finding,
@@ -94,6 +117,65 @@ def _method_of(cls: ast.ClassDef, node: ast.AST, mod: SourceModule) -> Optional[
     return None
 
 
+def _mutated_chain(node: ast.AST) -> Optional[Tuple[List[str], str]]:
+    """(chain, verb) when ``node`` mutates a self.* chain — shared by the
+    annotation check (GL401) and the escape analysis (GL403)."""
+    if isinstance(node, (ast.Assign, ast.AnnAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            base = t
+            # subscript store mutates the container: self.d[k] = v
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            chain = attr_chain(base)
+            if chain and chain[0] == "self":
+                return chain, "assign"
+        return None
+    if isinstance(node, ast.AugAssign):
+        base = node.target
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        chain = attr_chain(base)
+        if chain and chain[0] == "self":
+            return chain, "augassign"
+        return None
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if node.func.attr in _MUTATORS:
+            base = node.func.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            chain = attr_chain(base)
+            if chain and chain[0] == "self":
+                return chain, node.func.attr
+    return None
+
+
+def _guarded_attr_map(
+    mod: SourceModule,
+) -> Dict[ast.ClassDef, Dict[str, Tuple[str, Optional[str]]]]:
+    """class node → {attr: (lockname, declaring method)} for every
+    ``# guarded-by:`` annotation in ``mod``."""
+    annotations = _find_annotations(mod)
+    out: Dict[ast.ClassDef, Dict[str, Tuple[str, Optional[str]]]] = {}
+    if not annotations:
+        return out
+    mod.build_parents()
+    for lineno, attr, lock in annotations:
+        cls = _enclosing_class(mod, lineno)
+        if cls is None:
+            continue
+        decl_method = None
+        for node in ast.walk(cls):
+            if (
+                isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+                and node.lineno == lineno
+            ):
+                decl_method = _method_of(cls, node, mod)
+                break
+        out.setdefault(cls, {})[attr] = (lock, decl_method)
+    return out
+
+
 @register_pass
 class LockDisciplinePass(LintPass):
     name = "lock-discipline"
@@ -103,26 +185,7 @@ class LockDisciplinePass(LintPass):
     def run(self, ctx: AnalysisContext) -> List[Finding]:
         findings: List[Finding] = []
         for mod in ctx.modules:
-            annotations = _find_annotations(mod)
-            if not annotations:
-                continue
-            mod.build_parents()
-            # class → {attr: (lockname, declaring method)}
-            guarded: Dict[ast.ClassDef, Dict[str, Tuple[str, Optional[str]]]] = {}
-            for lineno, attr, lock in annotations:
-                cls = _enclosing_class(mod, lineno)
-                if cls is None:
-                    continue
-                decl_method = None
-                for node in ast.walk(cls):
-                    if (
-                        isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign))
-                        and node.lineno == lineno
-                    ):
-                        decl_method = _method_of(cls, node, mod)
-                        break
-                guarded.setdefault(cls, {})[attr] = (lock, decl_method)
-            for cls, attrs in guarded.items():
+            for cls, attrs in _guarded_attr_map(mod).items():
                 findings.extend(self._check_class(mod, cls, attrs))
         return findings
 
@@ -160,7 +223,7 @@ class LockDisciplinePass(LintPass):
                 )
 
         for node in ast.walk(cls):
-            mutated = self._mutated_chain(node)
+            mutated = _mutated_chain(node)
             if mutated is None:
                 continue
             chain, verb = mutated
@@ -188,35 +251,282 @@ class LockDisciplinePass(LintPass):
             )
         return findings
 
-    def _mutated_chain(self, node: ast.AST) -> Optional[Tuple[List[str], str]]:
-        """(chain, verb) when ``node`` mutates a self.* chain."""
-        if isinstance(node, (ast.Assign, ast.AnnAssign)):
-            targets = (
-                node.targets if isinstance(node, ast.Assign) else [node.target]
-            )
-            for t in targets:
-                base = t
-                # subscript store mutates the container: self.d[k] = v
-                while isinstance(base, ast.Subscript):
-                    base = base.value
-                chain = attr_chain(base)
-                if chain and chain[0] == "self":
-                    return chain, "assign"
-            return None
-        if isinstance(node, ast.AugAssign):
-            base = node.target
-            while isinstance(base, ast.Subscript):
-                base = base.value
-            chain = attr_chain(base)
-            if chain and chain[0] == "self":
-                return chain, "augassign"
-            return None
-        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
-            if node.func.attr in _MUTATORS:
-                base = node.func.value
-                while isinstance(base, ast.Subscript):
-                    base = base.value
-                chain = attr_chain(base)
-                if chain and chain[0] == "self":
-                    return chain, node.func.attr
+    # _mutated_chain is module-level (shared with ThreadEscapePass)
+
+
+# ---------------------------------------------------------------------------
+# thread-escape analysis (GL403/404)
+# ---------------------------------------------------------------------------
+
+# attribute values that are themselves synchronization primitives or
+# thread-safe channels: method calls on them are their contract, not a race
+# (re-ASSIGNING one after construction still counts as a write)
+_SYNC_TYPES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Event",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+    "threading.Barrier",
+    "queue.Queue",
+    "queue.SimpleQueue",
+    "queue.LifoQueue",
+    "queue.PriorityQueue",
+}
+
+
+@dataclass
+class _Access:
+    fn: "FunctionInfo"
+    method: Optional[str]  # enclosing method name on the class (or None)
+    node: ast.AST
+    line: int
+    kind: str  # "read" | verb from _mutated_chain
+    roots: frozenset
+
+
+@register_pass
+class ThreadEscapePass(LintPass):
+    name = "thread-escape"
+    codes = ("GL403", "GL404")
+    description = "cross-thread shared state without a lock held on both sides"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        graph = ctx.callgraph
+        if not graph.thread_roots:
+            return []
+        findings: List[Finding] = []
+        findings.extend(self._closure_rebinds(graph))
+        findings.extend(self._attr_escapes(graph))
+        return findings
+
+    # -- GL404: thread closures rebinding enclosing-scope locals ---------
+
+    def _closure_rebinds(self, graph: CallGraph) -> List[Finding]:
+        out: List[Finding] = []
+        seen: Set[str] = set()
+        for root in graph.thread_roots:
+            fn = root.fn
+            if fn.parent is None or root.via == "Process":
+                # module-level targets share no frame; a child *process*
+                # shares no memory at all — rebinds there are local
+                continue
+            shared: Set[str] = set()
+            for node in fn.body_nodes():
+                if isinstance(node, (ast.Nonlocal, ast.Global)):
+                    shared.update(node.names)
+            if not shared:
+                continue
+            for node in fn.body_nodes():
+                names: List[str] = []
+                if isinstance(node, ast.Assign):
+                    names = [
+                        t.id for t in node.targets
+                        if isinstance(t, ast.Name) and t.id in shared
+                    ]
+                elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name
+                ):
+                    if node.target.id in shared:
+                        names = [node.target.id]
+                for name in names:
+                    key = f"{fn.full}:{name}"
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.append(
+                        Finding(
+                            code="GL404",
+                            path=fn.module.relpath,
+                            line=node.lineno,
+                            symbol=fn.qualname,
+                            detail=name,
+                            message=f"thread-target closure `{fn.qualname}` "
+                            f"rebinds enclosing-scope local `{name}` "
+                            "(nonlocal/global): the rebind races the "
+                            "submitting frame non-atomically — return the "
+                            "value, or move it onto a locked attribute",
+                        )
+                    )
+        return out
+
+    # -- GL403: cross-root attribute escapes ------------------------------
+
+    def _sync_attrs(self, graph: CallGraph, cls_node: ast.ClassDef,
+                    mod: SourceModule) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(cls_node):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            scope = graph.enclosing_function(mod, node)
+            name = graph.external_name(node.value.func, scope, mod)
+            if name not in _SYNC_TYPES:
+                continue
+            for t in node.targets:
+                chain = attr_chain(t)
+                if chain and len(chain) == 2 and chain[0] == "self":
+                    out.add(chain[1])
+        return out
+
+    def _enclosing_method(self, fn: "FunctionInfo") -> Optional[str]:
+        cur = fn
+        while cur is not None:
+            if cur.class_full is not None:
+                node = cur.node
+                return getattr(node, "name", None)
+            cur = cur.parent
         return None
+
+    def _attr_escapes(self, graph: CallGraph) -> List[Finding]:
+        membership = graph.thread_membership()
+        # class full → guarded-attr annotations / sync-typed attrs
+        guarded: Dict[str, Dict[str, Tuple[str, Optional[str]]]] = {}
+        sync_attrs: Dict[str, Set[str]] = {}
+        node_to_full = {info.node: full for full, info in graph.classes.items()}
+        for mod in graph.ctx.modules:
+            for cls_node, attrs in _guarded_attr_map(mod).items():
+                full = node_to_full.get(cls_node)
+                if full:
+                    guarded[full] = attrs
+        # accesses grouped per (class full, attr)
+        accesses: Dict[Tuple[str, str], List[_Access]] = {}
+        for fn in graph.functions:
+            cls_full = fn.class_full or graph._enclosing_class(fn)
+            if cls_full is None:
+                continue
+            method = self._enclosing_method(fn)
+            if method == "__init__":
+                # pre-escape construction; covers closures nested in
+                # __init__ too (they run before the object is shared
+                # in every pattern this package uses)
+                continue
+            roots = membership.get(fn.full, frozenset(("main",)))
+            cls_info = graph.classes.get(cls_full)
+            if cls_info is not None and cls_full not in sync_attrs:
+                sync_attrs[cls_full] = self._sync_attrs(
+                    graph, cls_info.node, cls_info.module
+                )
+            # param-default expressions (`def work(fn=self._x)`) evaluate in
+            # the ENCLOSING frame at def time — they are not accesses made
+            # by this thread of control
+            args = getattr(fn.node, "args", None)
+            default_ids: Set[int] = set()
+            if args is not None:
+                for d in list(args.defaults) + list(args.kw_defaults):
+                    if d is not None:
+                        default_ids.update(id(n) for n in ast.walk(d))
+            write_bases: Set[int] = set()
+            for node in fn.body_nodes():
+                if id(node) in default_ids:
+                    continue
+                mutated = _mutated_chain(node)
+                if mutated is None:
+                    continue
+                chain, verb = mutated
+                if len(chain) < 2:
+                    continue
+                attr = chain[1]
+                if verb in _MUTATORS and attr in sync_attrs.get(cls_full, ()):
+                    continue  # method call on a sync primitive: its contract
+                # the write target's own attribute loads (`self.stats` inside
+                # `self.stats.x += dt`) are part of the write, not reads
+                targets: List[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets = list(node.targets)
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [node.target]
+                elif isinstance(node, ast.Call):
+                    targets = [node.func]
+                for t in targets:
+                    for sub in ast.walk(t):
+                        write_bases.add(id(sub))
+                accesses.setdefault((cls_full, attr), []).append(
+                    _Access(fn, method, node, node.lineno, verb, roots)
+                )
+            for node in fn.body_nodes():
+                if not (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.ctx, ast.Load)
+                    and id(node) not in write_bases
+                    and id(node) not in default_ids
+                ):
+                    continue
+                chain = attr_chain(node)
+                if not chain or chain[0] != "self" or len(chain) < 2:
+                    continue
+                accesses.setdefault((cls_full, chain[1]), []).append(
+                    _Access(fn, method, node, node.lineno, "read", roots)
+                )
+        return self._verdicts(graph, accesses, guarded)
+
+    def _verdicts(
+        self,
+        graph: CallGraph,
+        accesses: Dict[Tuple[str, str], List[_Access]],
+        guarded: Dict[str, Dict[str, Tuple[str, Optional[str]]]],
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        for (cls_full, attr), acc in sorted(accesses.items()):
+            ann = guarded.get(cls_full, {}).get(attr)
+            decl_method = ann[1] if ann else None
+            live = [a for a in acc if a.method != decl_method]
+            writes = [a for a in live if a.kind != "read"]
+            if not writes:
+                continue  # written only at construction: immutable config
+            roots: Set[str] = set()
+            for a in live:
+                roots |= a.roots
+            if len(roots) <= 1:
+                continue  # single thread of control touches it
+            cls_info = graph.classes.get(cls_full)
+            cls_name = cls_info.name if cls_info else cls_full.rsplit(".", 1)[-1]
+            if ann is None:
+                w = min(writes, key=lambda a: a.line)
+                write_roots = set()
+                for a in writes:
+                    write_roots |= a.roots
+                findings.append(
+                    Finding(
+                        code="GL403",
+                        path=w.fn.module.relpath,
+                        line=w.line,
+                        symbol=cls_name,
+                        detail=attr,
+                        message=f"`self.{attr}` is written under thread "
+                        f"root(s) {sorted(write_roots)} and accessed under "
+                        f"{sorted(roots - write_roots) or sorted(roots)} "
+                        "with no `# guarded-by:` lock — cross-thread shared "
+                        "state needs a lock (and the annotation) on both "
+                        "sides, or must move onto a single thread",
+                    )
+                )
+                continue
+            lock = ann[0]
+            seen_sites: Set[str] = set()
+            for a in live:
+                if a.kind != "read":
+                    continue  # unlocked cross-thread WRITES are GL401's
+                if _holds_lock(a.fn.module, a.node, lock):
+                    continue
+                site = f"{cls_name}.{a.method or a.fn.qualname}"
+                if site in seen_sites:
+                    continue
+                seen_sites.add(site)
+                findings.append(
+                    Finding(
+                        code="GL403",
+                        path=a.fn.module.relpath,
+                        line=a.line,
+                        symbol=site,
+                        detail=f"{attr}:read",
+                        message=f"`self.{attr}` is shared across thread "
+                        f"roots and guarded by `self.{lock}`, but this read "
+                        f"is outside any `with self.{lock}:` block — "
+                        "unlocked reads of cross-thread state see torn/"
+                        "stale values",
+                    )
+                )
+        return findings

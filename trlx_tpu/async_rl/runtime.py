@@ -34,6 +34,9 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from trlx_tpu.async_rl.queue import ExperienceChunk, ExperienceQueue, QueueClosed
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
 
 __all__ = ["AsyncCollector", "ChunkSpec"]
 
@@ -384,3 +387,13 @@ class AsyncCollector:
             threads = list(self._threads)
         for thread in threads:
             thread.join(timeout=10)
+        leaked = [t.name for t in threads if t.is_alive()]
+        if leaked:  # pragma: no cover - requires a wedged actor
+            # a worker stuck past the join deadline is exactly what the
+            # tests' leaked-thread sentinel fails on (docs/TESTING.md) —
+            # name it loudly in production too instead of leaking silently
+            logger.warning(
+                f"async_rl: actor thread(s) {leaked} did not join within "
+                "10s — wedged in generation or a host call; they are daemon "
+                "threads and die with the process, but this run leaked them"
+            )

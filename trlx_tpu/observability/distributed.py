@@ -38,11 +38,18 @@ every rank's events — shifted onto rank 0's clock — into ONE Perfetto
 ``trace.json`` (per-rank ``pid`` rows, labeled ``rank k``), so a cross-rank
 stall is one screenful instead of N unalignable files.
 
-Knobs: ``TRLX_TPU_CLUSTER_TELEMETRY=0`` disables the beat entirely;
-``TRLX_TPU_TRACE_MERGE_WAIT_S`` bounds how long process 0 waits for peer
-trace files (default 15s; missing ranks are recorded in the merged trace's
-metadata rather than hanging the export). See docs/OBSERVABILITY.md
-"Distributed telemetry".
+Knobs: ``TRLX_TPU_CLUSTER_TELEMETRY=0`` disables the telemetry *analysis*
+(gauges, straggler/desync detection, clock offsets) — but NOT the
+coordination collective: when ``resilience.coordinate_preemption`` is on,
+a disabled rank still posts the same packed-vector allgather as its
+enabled peers and only the analysis half is skipped. The collective
+schedule may depend only on rank-uniform config, never a per-process env
+var — otherwise one mis-launched rank posts a mismatched collective and
+hangs the pod (graftlint GL704's rank-uniformity contract,
+docs/STATIC_ANALYSIS.md). ``TRLX_TPU_TRACE_MERGE_WAIT_S`` bounds how long
+process 0 waits for peer trace files (default 15s; missing ranks are
+recorded in the merged trace's metadata rather than hanging the export).
+See docs/OBSERVABILITY.md "Distributed telemetry".
 """
 
 import json
@@ -163,8 +170,18 @@ class ClusterTelemetry:
         beat local: gauges still publish from this rank's own scalars and
         no collective is posted — telemetry never adds a sync point the
         run didn't already have.
+
+        The collective schedule depends ONLY on ``collective`` (the
+        rank-uniform ``resilience.coordinate_preemption`` config) — never
+        on ``self.enabled``: the enabled flag comes from a per-process env
+        var (``TRLX_TPU_CLUSTER_TELEMETRY``), and an env var that selects
+        *which* collective a rank posts would let one mis-launched rank
+        hang (or desync) the whole pod. A disabled rank therefore still
+        posts the same packed-vector allgather when coordination is on; it
+        just skips the analysis/publishing half (graftlint GL704's
+        rank-uniformity contract, docs/STATIC_ANALYSIS.md).
         """
-        if not self.enabled:
+        if not self.enabled and not collective:
             return bool(requested)
         import jax
 
@@ -198,6 +215,11 @@ class ClusterTelemetry:
             )
         else:
             matrix = vec[None]
+        if not self.enabled:
+            # coordination-only beat: this rank posted the SAME collective
+            # as its enabled peers (payload shapes must match rank-for-rank)
+            # but skips the analysis/publishing half entirely
+            return bool(matrix[:, 0].any())
         self.beats += 1
         self._check_desync(matrix)
         # clock offsets: every rank stamped its clock immediately before the

@@ -341,7 +341,11 @@ def coordinate_preemption(requested: bool) -> bool:
     Cost: one scalar allgather per update in multihost jobs — gate with
     ``resilience.coordinate_preemption`` if that ever shows up in profiles
     (an uncoordinated multihost SIGTERM leaves no consistent restorable
-    state, so the default is on).
+    state, so the default is on). That gate field is registered
+    rank-uniform (``RANK_UNIFORM_FIELDS``, graftlint GL704): every rank
+    must be launched with the same value, or the ranks that post this
+    allgather hang on the ones that don't (docs/STATIC_ANALYSIS.md "The
+    rank-uniformity contract").
     """
     if jax.process_count() == 1:
         return bool(requested)

@@ -89,7 +89,6 @@ class HostCallGuard:
         self.plan = plan
         self._rng = random.Random(seed)
         self._sleep = sleep
-        self._executor = None
         # propagate the wrapped fn's face: reward_fn identity matters to
         # callers that introspect (e.g. examples logging the fn name)
         self.__wrapped__ = fn
@@ -109,24 +108,37 @@ class HostCallGuard:
     def _call_with_timeout(self, *args, **kwargs):
         if self.timeout_s is None:
             return self.fn(*args, **kwargs)
-        from concurrent.futures import ThreadPoolExecutor, TimeoutError as FTimeout
+        # One fresh DAEMON thread per timed attempt, not a ThreadPoolExecutor:
+        # modern CPython's executor threads are non-daemon and joined at
+        # interpreter exit, so an abandoned worker stuck inside a dead
+        # endpoint would hang process shutdown — the exact failure mode this
+        # guard exists to survive. The guarded calls are host RPCs (ms+), so
+        # per-call thread spawn cost is noise. A timed-out worker is
+        # deliberately abandoned (Python can't kill a thread); being daemon,
+        # it dies with the process, and the leaked-thread sentinel in
+        # tests/conftest.py allowlists the `-guard` suffix for exactly this.
+        result: Dict[str, Any] = {}
+        done = threading.Event()
 
-        if self._executor is None:
-            self._executor = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix=f"trlx-tpu-{self.name}-guard"
-            )
-        future = self._executor.submit(self.fn, *args, **kwargs)
-        try:
-            return future.result(timeout=self.timeout_s)
-        except FTimeout:
-            # the worker is stuck inside fn: abandon this executor (daemon
-            # threads die with the process) so the retry gets a live worker
-            future.cancel()
-            self._executor.shutdown(wait=False)
-            self._executor = None
+        def _run():
+            try:
+                result["value"] = self.fn(*args, **kwargs)
+            except BaseException as e:
+                result["error"] = e
+            finally:
+                done.set()
+
+        worker = threading.Thread(
+            target=_run, name=f"trlx-tpu-{self.name}-guard", daemon=True
+        )
+        worker.start()
+        if not done.wait(self.timeout_s):
             raise TimeoutError(
                 f"{self.name} call exceeded timeout {self.timeout_s}s"
             ) from None
+        if "error" in result:
+            raise result["error"]
+        return result["value"]
 
     # -- the call -------------------------------------------------------
 

@@ -1266,7 +1266,23 @@ class TPUBaseTrainer(BaseRLTrainer):
 
     def _shutdown_collectors(self) -> None:
         """Stop any background experience collectors (PPO's async
-        actor/learner split overrides). Never raises."""
+        actor/learner split overrides and chains back here). Never raises.
+
+        Closing the prompt-iterator generator chain unwinds
+        ``PrefetchLoader.__iter__``'s ``finally`` — which is what joins the
+        ``trlx-prefetch`` worker: a consumer that stopped mid-epoch
+        otherwise leaves the worker parked on a full queue until the
+        trainer is garbage-collected (caught by the leaked-thread sentinel
+        in tests/conftest.py, the dynamic complement of graftlint GL403)."""
+        self._close_prompt_iterator()
+
+    def _close_prompt_iterator(self) -> None:
+        iterator = getattr(self, "prompt_iterator", None)
+        if iterator is not None and hasattr(iterator, "close"):
+            try:
+                iterator.close()
+            except Exception:  # pragma: no cover - defensive
+                pass
 
     def _shutdown_observability(self, reason: Optional[str] = None) -> None:
         """Best-effort flush of profiler, span trace, and tracker — callable
@@ -1321,26 +1337,25 @@ class TPUBaseTrainer(BaseRLTrainer):
         preemption = self.resilience.preemption
         requested = preemption.requested
         coordinate = self.resilience.config.coordinate_preemption
-        if self.obs.cluster.enabled:
+        if self.obs.cluster.enabled or coordinate:
             # cross-rank telemetry beat (docs/OBSERVABILITY.md "Distributed
             # telemetry"): ONE allgather carries the preemption flag AND the
             # per-rank scalars (step time, host wait, tokens/s, memory) —
             # the coordinated-preemption collective, not a new sync point.
             # With coordination disabled the beat stays local (no
-            # collective) and only this rank's gauges publish.
+            # collective) and only this rank's gauges publish. The beat is
+            # the ONLY collective on this boundary and whether it posts
+            # depends only on `coordinate` (rank-uniform config, graftlint
+            # GL704) — never on the per-process TRLX_TPU_CLUSTER_TELEMETRY
+            # env gate, which would let one mis-launched rank post a
+            # mismatched collective and hang the pod (a telemetry-disabled
+            # rank still rides the same allgather, skipping only the
+            # analysis).
             requested_any = self.obs.cluster.beat(
                 requested, step=self.iter_count, collective=coordinate
             )
             if coordinate:
                 requested = requested_any
-        elif coordinate:
-            # multihost: ALL processes must agree on the checkpoint step —
-            # a SIGTERM lands on one host while the others keep stepping.
-            # The allgather runs every boundary (SPMD lockstep), so the
-            # first boundary after any signal is the step everyone picks.
-            from trlx_tpu.resilience.elastic import coordinate_preemption
-
-            requested = coordinate_preemption(requested)
         if not requested:
             return
         if not preemption.requested:

@@ -1063,11 +1063,14 @@ class PPOTrainer(TPUBaseTrainer):
         return stats
 
     def _shutdown_collectors(self) -> None:
+        # actors first (they draw from the prompt iterator), then the
+        # base closes the iterator chain and joins the prefetch worker
         if self._async is not None:
             try:
                 self._async.close()
             except Exception:  # pragma: no cover - defensive
                 pass
+        super()._shutdown_collectors()
 
     def _consume_skip_initial_experience(self) -> bool:
         """True exactly once after an emergency-payload restore: the store
