@@ -1,0 +1,18 @@
+# Developer entry points. `make lint` is the same gate CI runs
+# (.github/workflows/lint.yml) and the tier-1 self-run asserts
+# (tests/test_analysis.py): graftlint over trlx_tpu/ AND scripts/ against
+# the committed baseline, with a SARIF artifact for inline annotation.
+# It needs NO ML dependencies — `trlx_tpu.analysis` is stdlib-only
+# (pure-AST; the package root's `train` is a lazy attribute).
+
+.PHONY: lint lint-sarif test
+
+lint:
+	python scripts/lint.py
+
+lint-sarif:
+	python scripts/lint.py --sarif graftlint.sarif
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+	  --continue-on-collection-errors -p no:cacheprovider

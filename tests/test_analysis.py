@@ -883,6 +883,459 @@ def test_rank_uniform_registry_matches_real_gates():
 
 
 # ---------------------------------------------------------------------------
+# ownership/lifecycle (GL801–GL804) and the acquire/release registry
+# ---------------------------------------------------------------------------
+
+_POOL = """
+class Pool:
+    def alloc(self, n):  # acquires: block-ref
+        return list(range(n))
+
+    def release(self, blocks):  # releases: block-ref(arg)
+        return blocks
+"""
+
+_LEAK_PKG = {
+    "leak.py": _POOL + """
+def leak_on_error(pool: Pool, n, bad):
+    blocks = pool.alloc(n)
+    if bad:
+        raise RuntimeError("boom")      # GL801: blocks leak on this edge
+    table = {}
+    table[0] = blocks                    # ownership transferred
+    return table
+"""
+}
+
+_DOUBLE_RELEASE_PKG = {
+    "dbl.py": _POOL + """
+def double(pool: Pool, n):
+    blocks = pool.alloc(n)
+    pool.release(blocks)
+    pool.release(blocks)                 # GL802
+"""
+}
+
+
+def test_ownership_leak_on_exception_path(tmp_path):
+    findings = lint_pkg(tmp_path, _LEAK_PKG, passes=["ownership"])
+    assert [(f.code, f.detail) for f in findings] == [("GL801", "blocks:block-ref")]
+    assert findings[0].symbol == "leak_on_error"
+
+
+def test_ownership_leak_on_early_return_and_function_end(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "ret.py": _POOL + """
+def early(pool: Pool, n, flag):
+    blocks = pool.alloc(n)
+    if flag:
+        return 0                        # GL801: early return, blocks live
+    pool.release(blocks)
+    return 1
+
+def drops(pool: Pool, n):
+    blocks = pool.alloc(n)              # GL801 at function end
+    print(len(blocks))
+"""
+        },
+        passes=["ownership"],
+    )
+    assert codes(findings) == ["GL801", "GL801"]
+    assert {f.symbol for f in findings} == {"early", "drops"}
+
+
+def test_ownership_discarded_handle(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "disc.py": _POOL + """
+def discard(pool: Pool):
+    pool.alloc(3)                       # result dropped: nothing can release
+"""
+        },
+        passes=["ownership"],
+    )
+    assert [(f.code, f.detail) for f in findings] == [
+        ("GL801", "<discarded>:block-ref")
+    ]
+
+
+def test_ownership_double_release(tmp_path):
+    findings = lint_pkg(tmp_path, _DOUBLE_RELEASE_PKG, passes=["ownership"])
+    assert [(f.code, f.detail) for f in findings] == [("GL802", "blocks:block-ref")]
+
+
+def test_ownership_use_after_release(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "uar.py": _POOL + """
+def use_after(pool: Pool, n):
+    blocks = pool.alloc(n)
+    pool.release(blocks)
+    return blocks[0]                    # GL803
+"""
+        },
+        passes=["ownership"],
+    )
+    assert [(f.code, f.detail) for f in findings] == [("GL803", "blocks:block-ref")]
+
+
+def test_ownership_conditional_release(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "cond.py": _POOL + """
+def cond_release(pool: Pool, n, ok):
+    blocks = pool.alloc(n)
+    if ok:
+        pool.release(blocks)
+    return None                         # GL804: other branch leaks
+"""
+        },
+        passes=["ownership"],
+    )
+    assert [(f.code, f.detail) for f in findings] == [("GL804", "blocks:block-ref")]
+
+
+def test_ownership_negatives_finally_with_and_transfer(tmp_path):
+    # finally-covered exits, with-context acquires, the error-path-release-
+    # then-main-path-transfer shape (the engine's _prepare_row), and
+    # object-scoped (attr receiver / "(object)" spec) calls are all clean
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "ok.py": _POOL + """
+class Tracer:
+    def span(self, name):  # acquires: span
+        return name
+
+class Cache:
+    def insert(self, pool, blocks):  # acquires: entry-ref(object)
+        return len(blocks)
+
+def covered(pool: Pool, n):
+    blocks = pool.alloc(n)
+    try:
+        x = blocks[0]
+        return x                         # covered by the finally below
+    finally:
+        pool.release(blocks)
+
+def error_path_counterpart(pool: Pool, store, n, shared):
+    pool.release(shared)
+    blocks = pool.alloc(n)
+    try:
+        more = pool.alloc(n)
+    except RuntimeError:
+        pool.release(blocks)             # error-path release...
+        raise
+    store[0] = blocks + more             # ...main path transfers ownership
+
+def spans(tracer: Tracer):
+    with tracer.span("engine/x"):
+        pass
+
+def object_scoped(pool: Pool, cache: Cache, n):
+    cache.insert(pool, [1, 2])           # (object) spec: cache owns the refs
+"""
+        },
+        passes=["ownership"],
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_ownership_thread_pair(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "thr.py": """
+import threading
+
+def f():
+    pass
+
+def joined():
+    t = threading.Thread(target=f)
+    t.start()
+    t.join()
+
+def stored(bag):
+    t = threading.Thread(target=f)
+    bag.append(t)                        # ownership moved BEFORE start
+    t.start()
+
+def leaked(flag):
+    t = threading.Thread(target=f)
+    t.start()
+    if flag:
+        return                           # GL801: t live on this exit
+    t.join()
+"""
+        },
+        passes=["ownership"],
+    )
+    assert [(f.code, f.symbol, f.detail) for f in findings] == [
+        ("GL801", "leaked", "t:thread")
+    ]
+
+
+def test_ownership_registry_on_real_tree():
+    """The seeded acquire/release pairs stay annotated (guards against the
+    pass going vacuous after a refactor): allocator refs, the engine's
+    alloc wrapper and row refs, prefix-cache entries, spool chunks,
+    checkpoint staging, tracer spans."""
+    from trlx_tpu.analysis.ownership import OwnershipRegistry
+
+    ctx = AnalysisContext(TREE)
+    reg = OwnershipRegistry(ctx.callgraph)
+    triples = {
+        (pm.fn.qualname, pm.role, pm.resource)
+        for pms in reg.by_name.values()
+        for pm in pms
+    }
+    assert ("BlockAllocator.alloc", "acquires", "kv-block-ref") in triples
+    assert ("BlockAllocator.retain", "acquires", "kv-block-ref") in triples
+    assert ("BlockAllocator.release", "releases", "kv-block-ref") in triples
+    assert ("ContinuousEngine._alloc_blocks", "acquires", "kv-block-ref") in triples
+    assert ("ContinuousEngine._prepare_row", "acquires", "row-block-ref") in triples
+    assert ("ContinuousEngine._harvest", "releases", "row-block-ref") in triples
+    assert ("PrefixCache.insert", "acquires", "prefix-entry-ref") in triples
+    assert ("PrefixCache.evict", "releases", "prefix-entry-ref") in triples
+    assert ("FileExperienceQueue.put", "acquires", "spool-chunk") in triples
+    assert ("FileExperienceQueue.get", "releases", "spool-chunk") in triples
+    assert ("save_state", "acquires", "ckpt-staging") in triples
+    assert ("save_state.<locals>.commit", "releases", "ckpt-staging") in triples
+    assert ("Tracer.span", "acquires", "span") in triples
+
+
+# ---------------------------------------------------------------------------
+# determinism discipline (GL901–GL904) and the bit-equivalence root set
+# ---------------------------------------------------------------------------
+
+_TIME_STORE_PKG = {
+    "det_time.py": """
+import time
+
+def make_experience(store):
+    store.append(time.time())            # GL901: wall clock into the store
+"""
+}
+
+_UNSORTED_SCAN_PKG = {
+    "det_scan.py": """
+import os
+
+def committed_indices(spool):
+    out = set()
+    for name in os.listdir(spool):       # GL903: unsorted spool scan
+        out.add(name)
+    return out
+
+class FileExperienceQueue:
+    def put(self, spool):
+        return committed_indices(spool)
+"""
+}
+
+
+def test_determinism_wall_clock_and_rng(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            **_TIME_STORE_PKG,
+            "det_rng.py": """
+import random
+import numpy as np
+
+def _collect_serial(batch):
+    random.shuffle(batch)                # GL902: module-level RNG
+    return batch + [np.random.rand()]    # GL902: unseeded global np RNG
+""",
+        },
+        passes=["determinism"],
+    )
+    assert [(f.code, f.detail) for f in findings] == [
+        ("GL902", "random.shuffle"),
+        ("GL902", "numpy.random.rand"),
+        ("GL901", "time.time"),
+    ]
+
+
+def test_determinism_unsorted_scan_and_set_iteration(tmp_path):
+    findings = lint_pkg(
+        tmp_path,
+        {
+            **_UNSORTED_SCAN_PKG,
+            "det_set.py": """
+def export_history(rows):
+    seen = {r for r in rows}
+    out = []
+    for r in seen:                       # GL904: salted set order
+        out.append(r)
+    return out
+""",
+        },
+        passes=["determinism"],
+    )
+    assert [(f.code, f.detail) for f in findings] == [
+        ("GL903", "os.listdir"),
+        ("GL904", "seen"),
+    ]
+
+
+def test_determinism_negatives(tmp_path):
+    # sorted() at the call site, seeded generator instances, perf_counter
+    # intervals, order-free consumers (len/membership), and nondeterminism
+    # OUTSIDE the root-reachable set are all clean
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "ok.py": """
+import os
+import random
+import time
+import numpy as np
+
+def make_experience(root, rows):
+    names = sorted(os.listdir(root))
+    rng = np.random.default_rng(0)
+    jitter = random.Random(1).random()
+    t0 = time.perf_counter()
+    seen = {r for r in rows}
+    count = len({n for n in names})
+    ordered = sorted(seen)
+    return names, rng, jitter, time.perf_counter() - t0, ordered, count
+
+def host_tool(root):
+    # not reachable from any bit-equivalence root: out of scope
+    return os.listdir(root), time.time()
+"""
+        },
+        passes=["determinism"],
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_determinism_reaches_through_calls(tmp_path):
+    # the scan lives in a helper: reachability from the root finds it
+    findings = lint_pkg(tmp_path, _UNSORTED_SCAN_PKG, passes=["determinism"])
+    assert [(f.code, f.symbol) for f in findings] == [
+        ("GL903", "committed_indices")
+    ]
+    assert "FileExperienceQueue.put" in findings[0].message
+
+
+def test_determinism_set_rebound_to_sorted_is_clean(tmp_path):
+    # `seen = sorted(seen)` launders the set into a list: iterating the
+    # rebound name must NOT fire GL904 (review finding: the set-local
+    # tracker never cleared on non-set reassignment)
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "rebind.py": """
+def export_history(rows):
+    seen = {r for r in rows}
+    seen = sorted(seen)
+    out = []
+    for r in seen:
+        out.append(r)
+    return out
+"""
+        },
+        passes=["determinism"],
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_determinism_rng_not_exempted_in_telemetry_modules(tmp_path):
+    # TIMESTAMP_EXEMPT_PATHS exempts wall-clock reads ONLY: global RNG on a
+    # bit-critical path is a divergence wherever it lives (review finding:
+    # the GL902 branch was gated on the clock exemption). Fixture packages
+    # never match the trlx_tpu/ path prefixes, so assert the rule directly:
+    # a module whose clock reads ARE exempt must still flag RNG.
+    import trlx_tpu.analysis.determinism as det
+
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "__init__.py").write_text("")
+    (root / "tele.py").write_text(textwrap.dedent("""
+        import random
+        import time
+
+        def make_experience(store):
+            store.append(time.time())
+            random.shuffle(store)
+        """))
+    ctx = AnalysisContext(str(root))
+    orig = det.TIMESTAMP_EXEMPT_PATHS
+    det.TIMESTAMP_EXEMPT_PATHS = ("pkg/",)
+    try:
+        findings = det.DeterminismPass().run(ctx)
+    finally:
+        det.TIMESTAMP_EXEMPT_PATHS = orig
+    assert [(f.code, f.detail) for f in findings] == [
+        ("GL902", "random.shuffle")
+    ]
+
+
+def test_ownership_events_in_if_condition(tmp_path):
+    # releases/reads spelled in an `if` TEST run on every path and must be
+    # seen (review finding: the walk recursed into branches without
+    # scanning the condition, unlike For/While/With headers)
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "iftest.py": _POOL + """
+def dbl_in_test(pool: Pool, n):
+    b = pool.alloc(n)
+    pool.release(b)
+    if pool.release(b):                  # GL802 in the condition
+        return 1
+    return 0
+
+def read_in_test(pool: Pool, n):
+    b = pool.alloc(n)
+    pool.release(b)
+    if b:                                # GL803 in the condition
+        return 1
+    return 0
+"""
+        },
+        passes=["ownership"],
+    )
+    assert [(f.code, f.symbol) for f in findings] == [
+        ("GL802", "dbl_in_test"),
+        ("GL803", "read_in_test"),
+    ]
+
+
+def test_determinism_root_set_on_real_tree():
+    """The bit-equivalence-critical root set stays resolved and closed over
+    the real tree (guards against the pass going vacuous): collection
+    paths, the spool protocol, checkpoint save/restore incl. the nested
+    commit closure, and FaultPlan parsing."""
+    from trlx_tpu.analysis.determinism import BIT_EQUIVALENCE_ROOTS
+
+    ctx = AnalysisContext(TREE)
+    g = ctx.callgraph
+    roots = g.resolve_root_names(BIT_EQUIVALENCE_ROOTS)
+    quals = {r.qualname for r in roots}
+    assert "PPOTrainer.make_experience" in quals
+    assert "GRPOTrainer.make_experience" in quals
+    assert "FileExperienceQueue.put" in quals
+    assert "save_state" in quals
+    assert "FaultPlan.parse" in quals
+    assert "PPORolloutStorage.export_history" in quals
+    reach = g.reach_from(roots)
+    assert any(f.endswith("save_state.<locals>.commit") for f in reach)
+    assert any("_checkpoint_step_dirs" in f for f in reach)
+    assert len(reach) >= 40
+
+
+# ---------------------------------------------------------------------------
 # metric-names (GL501) and config-keys (GL601)
 # ---------------------------------------------------------------------------
 
@@ -1195,15 +1648,19 @@ def test_cli_rejects_no_baseline_with_update_baseline(tmp_path):
 
 
 def test_analysis_imports_without_jax():
-    """Lint-only CI contract: importing (and running) trlx_tpu.analysis
-    must not pull in the training stack — the package root's `train` is a
-    lazy attribute."""
+    """Lint-only CI contract: importing trlx_tpu.analysis AND loading every
+    registered pass (ownership/determinism included — all_passes() imports
+    the pass modules) must not pull in the training stack — the package
+    root's `train` is a lazy attribute, and no pass module may import jax
+    at module scope."""
     proc = subprocess.run(
         [
             sys.executable,
             "-c",
-            "import sys; import trlx_tpu.analysis; "
-            "assert 'jax' not in sys.modules, 'analysis import loaded jax'",
+            "import sys; from trlx_tpu.analysis import all_passes; "
+            "names = set(all_passes()); "
+            "assert {'ownership', 'determinism'} <= names, names; "
+            "assert 'jax' not in sys.modules, 'loading the passes pulled in jax'",
         ],
         capture_output=True,
         text=True,
@@ -1245,12 +1702,19 @@ def test_default_baseline_is_scan_root_adjacent_not_cwd(tmp_path, monkeypatch):
 # ---------------------------------------------------------------------------
 
 
+_SELF_RUN = {}  # wall-clock seconds of the fixture's full multi-root run
+
+
 @pytest.fixture(scope="module")
 def tree_findings():
     # the CI gate's exact scan surface: the package AND scripts/ (bench/
     # evidence tooling spawns processes and writes spool files — linted
     # with the same baseline, in the same run)
+    import time as _time
+
+    t0 = _time.perf_counter()
     findings, ctxs = run_analysis([TREE, SCRIPTS])
+    _SELF_RUN["seconds"] = _time.perf_counter() - t0
     for ctx in ctxs:
         assert ctx.errors == [], f"unparseable sources: {ctx.errors}"
     return findings
@@ -1289,6 +1753,88 @@ def test_self_run_detects_injected_violation(tree_findings, tmp_path):
     baseline = Baseline.load(BASELINE)
     new, _ = baseline.apply(list(tree_findings) + findings)
     assert [f.key for f in new] == [findings[0].key]
+
+
+def test_self_run_runtime_budget(tree_findings):
+    """The full multi-root self-run (ALL passes, both scan roots) stays
+    under a fixed wall-clock budget: every added pass re-walks the tree, so
+    an accidentally quadratic analysis would quietly turn the tier-1 gate
+    into the slowest test in the suite. ~11s today; the budget leaves slow-
+    CI headroom while catching an order-of-magnitude regression."""
+    assert "seconds" in _SELF_RUN, "fixture did not record its runtime"
+    assert _SELF_RUN["seconds"] < 90.0, (
+        f"graftlint self-run took {_SELF_RUN['seconds']:.1f}s (budget 90s) — "
+        "profile the newest pass; reachability and registry scans must stay "
+        "near-linear in module count"
+    )
+
+
+def test_self_run_detects_injected_ownership_and_determinism_violations(
+    tree_findings, tmp_path
+):
+    """The acceptance shapes for the GL80x/GL90x families: a leaked block
+    ref on an exception path, a double release, an unsorted spool scan, and
+    a wall-clock read feeding store content each surface EXACTLY their
+    finding through the committed baseline."""
+    leak = lint_pkg(tmp_path, _LEAK_PKG, passes=["ownership"])
+    dbl = lint_pkg(tmp_path, _DOUBLE_RELEASE_PKG, passes=["ownership"], name="pkg_dbl")
+    scan = lint_pkg(tmp_path, _UNSORTED_SCAN_PKG, passes=["determinism"], name="pkg_scan")
+    stamp = lint_pkg(tmp_path, _TIME_STORE_PKG, passes=["determinism"], name="pkg_time")
+    assert codes(leak) == ["GL801"]
+    assert codes(dbl) == ["GL802"]
+    assert codes(scan) == ["GL903"]
+    assert codes(stamp) == ["GL901"]
+    baseline = Baseline.load(BASELINE)
+    new, _ = baseline.apply(list(tree_findings) + leak + dbl + scan + stamp)
+    assert sorted(f.code for f in new) == ["GL801", "GL802", "GL901", "GL903"]
+
+
+def test_sarif_fingerprints_are_line_drift_stable(tmp_path):
+    """CI inline annotations key on partialFingerprints: every SARIF result
+    (finding, stale entry, parse error) carries a graftlintKey/v1 derived
+    from the line-number-free finding key, so an edit ABOVE a finding moves
+    region.startLine but never the fingerprint."""
+    import json
+
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "__init__.py").write_text("")
+    (root / "bad.py").write_text(textwrap.dedent(_VIOLATION_PKG["bad.py"]))
+
+    def sarif_results():
+        out = tmp_path / "out.sarif"
+        main([str(root), "--no-baseline", "--format", "sarif", "--output", str(out)])
+        return json.loads(out.read_text())["runs"][0]["results"]
+
+    first = sarif_results()
+    assert len(first) == 1
+    fp = first[0]["partialFingerprints"]["graftlintKey/v1"]
+    line = first[0]["locations"][0]["physicalLocation"]["region"]["startLine"]
+    # the fingerprint IS the baseline key: line-free by construction
+    findings, _ = run_analysis(str(root), passes=["host-sync"])
+    assert fp == findings[0].key
+
+    # drift: push the finding down; startLine moves, the fingerprint doesn't
+    (root / "bad.py").write_text(
+        "# pad\n# pad\n# pad\n" + textwrap.dedent(_VIOLATION_PKG["bad.py"])
+    )
+    second = sarif_results()
+    assert second[0]["partialFingerprints"]["graftlintKey/v1"] == fp
+    assert second[0]["locations"][0]["physicalLocation"]["region"]["startLine"] != line
+
+    # stale-entry and parse-error results carry fingerprints too
+    bl = tmp_path / "bl.txt"
+    bl.write_text(
+        f"{fp} :: fixture\nGL101 pkg/gone.py:f:.item :: matches nothing\n"
+    )
+    (root / "broken.py").write_text("def f(:\n")
+    out = tmp_path / "out2.sarif"
+    main([str(root), "--baseline", str(bl), "--format", "sarif", "--output", str(out)])
+    results = json.loads(out.read_text())["runs"][0]["results"]
+    fps = {r["partialFingerprints"]["graftlintKey/v1"] for r in results}
+    assert "GL000 stale:GL101 pkg/gone.py:f:.item" in fps
+    assert "GL000 parse:pkg/broken.py" in fps
+    assert all("partialFingerprints" in r for r in results)
 
 
 def test_self_run_detects_injected_concurrency_violations(tree_findings, tmp_path):
@@ -1338,6 +1884,7 @@ def test_pass_registry_and_codes():
     assert set(passes) == {
         "host-sync", "recompile-hazard", "donation-safety",
         "lock-discipline", "thread-escape", "collective-discipline",
+        "ownership", "determinism",
         "metric-names", "span-names", "config-keys",
     }
     seen = set()

@@ -142,6 +142,35 @@ class TestExperienceQueue:
         with pytest.raises(TimeoutError, match="actor dead or stalled"):
             q.get(0, timeout=0.1)
 
+    def test_spool_scan_order_independent_of_directory_order(
+        self, tmp_path, monkeypatch
+    ):
+        """The spool-dir scan must not inherit filesystem enumeration
+        order: with os.listdir returning a deliberately shuffled (and
+        junk-laden) listing, committed_indices is exact and junk-tolerant.
+        The scan itself iterating sorted(os.listdir(...)) is pinned
+        statically by graftlint's GL903 gate (tests/test_analysis.py
+        self-run) — this test pins the behavioral contract under shuffle."""
+        import trlx_tpu.async_rl.queue as queue_mod
+
+        q = FileExperienceQueue(str(tmp_path / "spool"), capacity=8)
+        for i in (3, 0, 7):
+            q.put(ExperienceChunk(i, version=1, payload={"x": np.zeros(1)}))
+
+        shuffled = [
+            "chunk_000007.npz", "CURSOR.json", "chunk_000000.npz",
+            "not_a_chunk.txt", "chunk_oops.npz", "chunk_000003.npz",
+        ]
+        real_listdir = queue_mod.os.listdir
+        monkeypatch.setattr(
+            queue_mod.os, "listdir",
+            lambda root: list(shuffled) if root == q.root else real_listdir(root),
+        )
+        assert q.committed_indices() == {0, 3, 7}
+        # and again under the reversed enumeration: same answer
+        shuffled.reverse()
+        assert q.committed_indices() == {0, 3, 7}
+
 
 # ---------------------------------------------------------------------------
 # weight channel + staleness gate

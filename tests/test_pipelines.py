@@ -180,6 +180,19 @@ def test_ppo_rollout_storage_export(tmp_path):
     assert data[0]["query_tensor"] == [0, 1]
 
 
+def test_rollout_storage_export_appends_fresh_ordinal(tmp_path):
+    # ordinal naming: the second export lands beside the first, never over
+    # it (full determinism coverage lives in tests/test_utils.py, which
+    # collects without hypothesis)
+    store = PPORolloutStorage(pad_token_id=0)
+    store.push([_fake_element(2, 3)])
+    store.export_history(str(tmp_path))
+    store.export_history(str(tmp_path))
+    import glob
+
+    assert len(glob.glob(str(tmp_path / "epoch-*.json"))) == 2
+
+
 def test_ilql_collate_shapes():
     from trlx_tpu.data.ilql_types import ILQLElement
     from trlx_tpu.pipeline.offline_pipeline import ilql_collate

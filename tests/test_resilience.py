@@ -336,6 +336,48 @@ class TestUpdateGuardPolicy:
 
 
 class TestAtomicCheckpoint:
+    def test_checkpoint_dir_scan_order_independent_of_directory_order(
+        self, tmp_path, monkeypatch
+    ):
+        """The checkpoint-dir scan must be numerically ordered no matter
+        what order the filesystem enumerates names in (zero-padding width
+        varies with total_steps, so lexicographic enumeration is wrong
+        even when deterministic): with os.listdir returning a shuffled,
+        junk-laden listing, _checkpoint_step_dirs stays numerically sorted
+        and newest_committed_checkpoint still picks the highest committed
+        step. The sorted(os.listdir(...)) call site itself is pinned by
+        graftlint's GL903 gate (tests/test_analysis.py self-run)."""
+        import trlx_tpu.utils.checkpoint as ckpt_mod
+        from trlx_tpu.utils.checkpoint import (
+            _checkpoint_step_dirs, newest_committed_checkpoint, save_state,
+        )
+
+        root = tmp_path / "ckpts"
+        steps = [2, 100, 9]  # lexicographic order would be 100 < 2 < 9
+        for s in steps:
+            save_state(
+                str(root / f"checkpoint_{s}"),
+                {"w": np.zeros(2, np.float32)},
+                async_save=False,
+            )
+        (root / "not_a_checkpoint").mkdir()
+        (root / "checkpoint_junk").mkdir()
+
+        shuffled = [
+            "checkpoint_9", "checkpoint_junk", "checkpoint_100",
+            "not_a_checkpoint", "checkpoint_2",
+        ]
+        real_listdir = ckpt_mod.os.listdir
+        monkeypatch.setattr(
+            ckpt_mod.os, "listdir",
+            lambda p: list(shuffled) if os.path.abspath(p) == str(root)
+            else real_listdir(p),
+        )
+        assert [s for s, _ in _checkpoint_step_dirs(str(root))] == [2, 9, 100]
+        assert newest_committed_checkpoint(str(root)) == str(root / "checkpoint_100")
+        shuffled.reverse()
+        assert [s for s, _ in _checkpoint_step_dirs(str(root))] == [2, 9, 100]
+
     def test_commit_marker_and_roundtrip(self, tmp_path):
         from trlx_tpu.utils.checkpoint import (
             is_committed, restore_state, save_state,

@@ -173,3 +173,58 @@ def test_version_consistent():
         m = re.search(r'^version = "([^"]+)"', f.read(), re.M)
     assert m, "pyproject.toml has no version field"
     assert trlx_tpu.__version__ == m.group(1)
+
+
+def test_rollout_storage_export_names_are_deterministic(tmp_path):
+    """Exports are named by ordinal, not wall clock: reruns produce
+    identical paths (the bit-equivalence contract graftlint's GL901
+    enforces on the store-serialization root set) and back-to-back exports
+    can never collide — the old timestamped name silently OVERWROTE a
+    same-second sibling export. Lives here rather than test_pipelines.py
+    so it runs even where hypothesis (which that module importorskips) is
+    absent."""
+    import json
+    import os
+
+    from trlx_tpu.data.grpo_types import GRPORLElement
+    from trlx_tpu.data.ppo_types import PPORLElement
+    from trlx_tpu.pipeline.grpo_pipeline import GRPORolloutStorage
+    from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+
+    store = PPORolloutStorage(pad_token_id=0)
+    store.push([
+        PPORLElement(
+            query_tensor=np.arange(2, dtype=np.int32),
+            response_tensor=np.arange(3, dtype=np.int32),
+            logprobs=np.zeros(3, np.float32),
+            values=np.zeros(3, np.float32),
+            rewards=np.zeros(3, np.float32),
+        )
+    ])
+    store.export_history(str(tmp_path))
+    store.export_history(str(tmp_path))  # same second: must NOT overwrite
+    assert sorted(os.listdir(tmp_path)) == [
+        "epoch-000000.json", "epoch-000001.json",
+    ]
+    # legacy timestamped exports in the dir don't block the ordinal chain
+    with open(tmp_path / "epoch-1700000000.123.json", "w") as f:
+        json.dump([], f)
+    store.export_history(str(tmp_path))
+    assert (tmp_path / "epoch-000002.json").exists()
+
+    # the GRPO store shares the ordinal naming
+    gstore = GRPORolloutStorage(pad_token_id=0)
+    gstore.push([
+        GRPORLElement(
+            query_tensor=np.zeros(2, np.int32),
+            response_tensor=np.zeros(3, np.int32),
+            logprobs=np.zeros(3, np.float32),
+            ref_logprobs=np.zeros(3, np.float32),
+            advantage=0.5,
+        )
+    ])
+    gdir = tmp_path / "grpo"
+    gdir.mkdir()
+    gstore.export_history(str(gdir))
+    gstore.export_history(str(gdir))
+    assert sorted(os.listdir(gdir)) == ["epoch-000000.json", "epoch-000001.json"]

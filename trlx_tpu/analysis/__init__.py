@@ -1,9 +1,11 @@
 """graftlint: JAX-aware whole-program static analysis for trlx_tpu.
 
 CLI: ``python -m trlx_tpu.analysis [trlx_tpu/]`` (or ``scripts/graftlint.py``
-/ ``scripts/lint.py``). Passes: host-sync, recompile-hazard,
-donation-safety, lock-discipline, metric-names, config-keys — catalog and
-baseline workflow in docs/STATIC_ANALYSIS.md.
+/ ``scripts/lint.py`` — all three are the same entry point: the scripts are
+thin wrappers over this package's ``main``). Passes: host-sync,
+recompile-hazard, donation-safety, lock-discipline, thread-escape,
+collective-discipline, ownership, determinism, metric-names, span-names,
+config-keys — catalog and baseline workflow in docs/STATIC_ANALYSIS.md.
 
 Pure stdlib + AST: the linter parses source text and never *executes* the
 code it lints (no jax backend is initialized), so it runs in CI before any

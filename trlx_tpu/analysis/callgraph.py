@@ -37,6 +37,7 @@ from trlx_tpu.analysis.core import AnalysisContext, SourceModule
 
 __all__ = [
     "CallGraph",
+    "ExceptionFlow",
     "FunctionInfo",
     "ClassInfo",
     "JitRoot",
@@ -712,26 +713,56 @@ class CallGraph:
         # decorator-jitted nested defs are roots on their own
         return out
 
-    def _mark_traced(self) -> None:
+    def reach_from(self, roots: List[FunctionInfo]) -> Dict[str, str]:
+        """``FunctionInfo.full`` → root qualname for every function reachable
+        from ``roots`` over the same edges jit tracing uses: resolved calls,
+        bare package-function references (while_loop/scan/vmap bodies), and
+        nested defs/lambdas. The generic engine behind jit-root tracing and
+        the determinism pass's bit-equivalence-critical root set."""
+        via: Dict[str, str] = {}
         work: List[FunctionInfo] = []
-        for root in self.jit_roots:
-            if root.fn.full not in self.traced:
-                self.traced.add(root.fn.full)
-                self.traced_via[root.fn.full] = root.fn.qualname
-                work.append(root.fn)
+        for root in roots:
+            if root.full not in via:
+                via[root.full] = root.qualname
+                work.append(root)
         while work:
             fn = work.pop()
-            via = self.traced_via[fn.full]
+            v = via[fn.full]
             callees = list(self.edges(fn))
-            # nested defs/lambdas of traced code are part of the trace even
+            # nested defs/lambdas of reached code are part of the region even
             # when only ever passed by reference (while_loop/scan/vmap args)
             for group in fn.nested.values():
                 callees.extend(group)
             for callee in callees:
-                if callee.full not in self.traced:
-                    self.traced.add(callee.full)
-                    self.traced_via[callee.full] = via
+                if callee.full not in via:
+                    via[callee.full] = v
                     work.append(callee)
+        return via
+
+    def resolve_root_names(self, patterns) -> List[FunctionInfo]:
+        """FunctionInfos matching registry patterns: a dotted pattern
+        (``FileExperienceQueue.put``) matches the exact qualname or a
+        ``.``-suffix of it; a bare name (``make_experience``) matches every
+        function/method with that name, in any class. Used by passes that
+        declare root sets by name (``analysis/determinism.py``)."""
+        out: List[FunctionInfo] = []
+        seen: Set[str] = set()
+        for fn in self.functions:
+            last = fn.qualname.rsplit(".", 1)[-1]
+            for pat in patterns:
+                if "." in pat:
+                    hit = fn.qualname == pat or fn.qualname.endswith("." + pat)
+                else:
+                    hit = last == pat
+                if hit and fn.full not in seen:
+                    seen.add(fn.full)
+                    out.append(fn)
+                    break
+        return out
+
+    def _mark_traced(self) -> None:
+        self.traced_via = self.reach_from([r.fn for r in self.jit_roots])
+        self.traced = set(self.traced_via)
 
     def traced_functions(self) -> List[FunctionInfo]:
         return [fn for fn in self.functions if fn.full in self.traced]
@@ -837,4 +868,73 @@ class CallGraph:
                 roots.add("main")
             out[fn.full] = frozenset(roots)
         self._thread_membership = out
+        return out
+
+
+# ---------------------------------------------------------------------------
+# exception-edge modeling (the ownership/lifecycle pass, analysis/ownership.py)
+# ---------------------------------------------------------------------------
+
+
+class ExceptionFlow:
+    """Structural exception-edge facts for one function body.
+
+    Python has two constructs that guarantee cleanup on EVERY exit —
+    normal fall-through, early ``return``, and a raising statement:
+    ``try/finally`` (the finalbody runs on all three) and ``with`` (the
+    context manager's ``__exit__`` runs on all three). The ownership pass
+    treats a resource released inside a covering finalbody — or acquired
+    as a ``with`` context expression — as release-covered on all exits;
+    everything else must be proven released path-by-path.
+    """
+
+    def __init__(self, fn: FunctionInfo):
+        self.fn = fn
+        self.fn.module.build_parents()
+
+    def covering_finallys(self, node: ast.AST) -> List[ast.Try]:
+        """Innermost-first ``try`` statements (within this function) whose
+        TRY BODY contains ``node`` and which carry a ``finally`` — the
+        finalbodies that execute on every exception edge crossing
+        ``node``'s position. Handler and finalbody positions themselves are
+        NOT covered (an exception there escapes the same try)."""
+        out: List[ast.Try] = []
+        mod = self.fn.module
+        cur: Optional[ast.AST] = node
+        while cur is not None and cur is not self.fn.node:
+            parent = mod.parents.get(cur)
+            if (
+                isinstance(parent, ast.Try)
+                and parent.finalbody
+                and cur in parent.body
+            ):
+                out.append(parent)
+            cur = parent
+        return out
+
+    def in_excepthandler(self, node: ast.AST) -> bool:
+        """Is ``node`` inside an ``except`` handler body of this function?
+        Releases there cover only the exception edge, not the normal path —
+        the pass must not treat them as the main-path release."""
+        mod = self.fn.module
+        cur: Optional[ast.AST] = node
+        while cur is not None and cur is not self.fn.node:
+            if isinstance(cur, ast.ExceptHandler):
+                return True
+            cur = mod.parents.get(cur)
+        return False
+
+    def with_context_calls(self) -> Set[int]:
+        """``id()`` of every Call node used as a ``with`` context expression
+        in this function's own body — an acquire spelled that way is
+        release-covered by the context manager's ``__exit__`` on all
+        exits (``with tracer.span(...):``)."""
+        out: Set[int] = set()
+        for node in self.fn.body_nodes():
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    out.add(id(expr))
         return out

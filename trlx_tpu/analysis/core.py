@@ -6,8 +6,10 @@ tree (AST-based — nothing is imported, so linting never initializes jax).
 Each :class:`LintPass` inspects the parsed tree (plus the shared
 intra-package call graph, ``callgraph.py``) and emits :class:`Finding`
 records with a per-finding code (``GL1xx`` host-sync, ``GL2xx`` recompile,
-``GL3xx`` donation, ``GL4xx`` locks, ``GL5xx`` metrics, ``GL6xx`` config
-keys — catalog in docs/STATIC_ANALYSIS.md).
+``GL3xx`` donation, ``GL4xx`` locks/thread-escape, ``GL5xx``
+metric/span names, ``GL6xx`` config keys, ``GL7xx`` collective
+discipline, ``GL8xx`` ownership/lifecycle, ``GL9xx`` determinism
+discipline — catalog in docs/STATIC_ANALYSIS.md).
 
 Findings are keyed by ``(code, path, symbol, detail)`` — deliberately **not**
 by line number, so the committed baseline (``GRAFTLINT_BASELINE.txt``)
@@ -193,8 +195,10 @@ def _ensure_builtin_passes() -> None:
     from trlx_tpu.analysis import (  # noqa: F401
         collectives,
         conventions,
+        determinism,
         jax_passes,
         locks,
+        ownership,
     )
 
 
@@ -278,7 +282,14 @@ def _code_descriptions() -> Dict[str, str]:
 def _sarif_doc(new, stale, errors) -> Dict:
     """SARIF 2.1.0: one run, one result per non-baselined finding (plus one
     per stale baseline entry under the synthetic ``GL000`` rule), so CI can
-    annotate findings inline on the PR diff."""
+    annotate findings inline on the PR diff.
+
+    EVERY result carries a ``partialFingerprints`` entry
+    (``graftlintKey/v1``) derived from the baseline's line-number-free
+    finding key — never from positions — so CI inline annotations survive
+    rebases and line drift exactly the way baseline entries do: edits above
+    a finding change ``region.startLine`` but not the fingerprint, and the
+    annotation platform keeps treating it as the same result."""
     desc = _code_descriptions()
     rules_seen: Dict[str, Dict] = {}
     results = []
@@ -304,7 +315,7 @@ def _sarif_doc(new, stale, errors) -> Dict:
                         }
                     }
                 ],
-                "partialFingerprints": {"graftlintKey": f.key},
+                "partialFingerprints": {"graftlintKey/v1": f.key},
             }
         )
     for entry in stale:
@@ -333,6 +344,7 @@ def _sarif_doc(new, stale, errors) -> Dict:
                         }
                     }
                 ],
+                "partialFingerprints": {"graftlintKey/v1": f"GL000 stale:{entry.key}"},
             }
         )
     for path, err in errors:
@@ -349,6 +361,7 @@ def _sarif_doc(new, stale, errors) -> Dict:
                         }
                     }
                 ],
+                "partialFingerprints": {"graftlintKey/v1": f"GL000 parse:{path}"},
             }
         )
     if errors and "GL000" not in rules_seen:

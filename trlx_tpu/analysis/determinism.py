@@ -1,0 +1,311 @@
+"""Determinism-discipline pass (GL90x): no nondeterminism source is
+reachable from the **bit-equivalence-critical root set**.
+
+The repo's second load-bearing invariant (after SPMD collective
+discipline) is that every new collection path stays bit-identical to the
+serial reference: the pipelined, continuous-batching, and async collectors
+are all tested as ``store == serial store``, preempt/resume is tested as
+``trajectory == uninterrupted trajectory``, and the spool protocol's
+requeue-on-actor-death only works because chunk ``i`` regenerates
+identically. One wall-clock read feeding saved state, one unsorted
+directory scan, one ``set`` iteration feeding ordered output — and a
+divergence appears that no unit test pins to a line.
+
+**The root set** (:data:`BIT_EQUIVALENCE_ROOTS`, resolved by
+``callgraph.resolve_root_names`` and closed over the same edges jit
+tracing uses): the serial reference collection paths
+(``make_experience`` / ``_collect_serial`` and their finalize stages),
+store serialization (``export_history``, ``collate``), the spool
+protocol (``FileExperienceQueue`` + payload flattening), checkpoint
+save/restore (``save_state`` / ``restore_state`` / ``maybe_resume`` /
+the trainer's ``save``/``load`` and the elastic restore), and
+``FaultPlan`` parsing (a plan parsed differently on two ranks fires
+different faults).
+
+**The codes** — all scoped to root-reachable functions:
+
+- GL901 — a wall-clock source (``time.time``, ``time.time_ns``,
+  ``datetime.now/utcnow/today``) feeding store or checkpoint content.
+  Telemetry and span timestamps are exempt via the metric/span
+  registries' home modules (:data:`TIMESTAMP_EXEMPT_PATHS` — the
+  observability package and the tracker stream own wall-clock
+  semantics; their output is diagnostics, never restored state).
+- GL902 — module-level ``random.*`` or unseeded ``np.random.*`` global
+  RNG use (instance constructors — ``random.Random(seed)``,
+  ``np.random.RandomState``/``default_rng`` — are the fix and are
+  exempt).
+- GL903 — an ``os.listdir`` / ``glob.glob`` / ``Path.iterdir``-family
+  scan consumed without ``sorted()`` at the call site: directory order
+  is filesystem-dependent, so a spool or checkpoint scan ordered by it
+  diverges across hosts and reruns.
+- GL904 — iteration over a local ``set`` (literal, ``set()`` call,
+  comprehension, or set algebra) feeding ordered output: Python set
+  order is salted per process, so any ordered consumer diverges run to
+  run. ``sorted(s)`` is the fix and is exempt.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from trlx_tpu.analysis.callgraph import CallGraph, FunctionInfo, attr_chain
+from trlx_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    LintPass,
+    register_pass,
+)
+
+__all__ = ["DeterminismPass", "BIT_EQUIVALENCE_ROOTS", "TIMESTAMP_EXEMPT_PATHS"]
+
+# The bit-equivalence-critical root set (docs/STATIC_ANALYSIS.md "The
+# bit-equivalence-critical root set"). Dotted patterns match the qualname
+# suffix; bare names match every function/method with that name — the
+# abstract `make_experience` deliberately pulls every trainer's collection
+# path in, exactly like the jit-root closure does for `loss_fn`.
+BIT_EQUIVALENCE_ROOTS = (
+    # serial reference collection paths + their finalize stages (running
+    # reward moments are order-sensitive; every other collector is tested
+    # bit-identical against these)
+    "make_experience",
+    "make_experience_seq2seq",
+    "_collect_serial",
+    # store serialization (replay-buffer export + train-batch collation)
+    "export_history",
+    "collate",
+    # spool-protocol ordering: chunk commit/consume and payload round-trip
+    "FileExperienceQueue.put",
+    "FileExperienceQueue.get",
+    "FileExperienceQueue.committed_indices",
+    "FileExperienceQueue.cursor",
+    "flatten_payload",
+    "unflatten_payload",
+    # checkpoint save/restore (+ the elastic reshard restore)
+    "save_state",
+    "restore_state",
+    "restore_state_elastic",
+    "build_manifest",
+    "read_extra",
+    "newest_committed_checkpoint",
+    "prune_checkpoints",
+    "_checkpoint_step_dirs",
+    "TPUBaseTrainer.save",
+    "TPUBaseTrainer.load",
+    "TPUBaseTrainer.maybe_resume",
+    # fault-plan parsing: two ranks parsing one plan differently fire
+    # different faults — divergence by construction
+    "FaultPlan.parse",
+    "FaultPlan.from_config",
+)
+
+# Modules whose wall-clock reads are telemetry, not content: the
+# observability package (spans/metrics/flight recorder) and the tracker
+# stream publish diagnostics that are never restored or replayed.
+TIMESTAMP_EXEMPT_PATHS = (
+    "trlx_tpu/observability/",
+    "trlx_tpu/utils/trackers.py",
+)
+
+_WALL_CLOCK = frozenset({
+    "time.time",
+    "time.time_ns",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+})
+
+# global-RNG constructors that ARE the fix (seeded instances)
+_SEEDED_RNG = frozenset({
+    "random.Random",
+    "random.SystemRandom",
+    "numpy.random.RandomState",
+    "numpy.random.default_rng",
+    "numpy.random.Generator",
+})
+
+_DIR_SCANS = frozenset({"os.listdir", "os.scandir", "glob.glob", "glob.iglob"})
+_DIR_SCAN_METHODS = frozenset({"iterdir", "glob", "rglob"})
+
+
+def _in_sorted(mod, node: ast.AST) -> bool:
+    """Is ``node`` (a scan/iteration source) inside a ``sorted(...)`` call
+    within its own statement? The call-site wrap is the rule: a scan whose
+    order is laundered through intermediate state is exactly the bug."""
+    for anc in mod.ancestors(node):
+        if isinstance(anc, ast.stmt):
+            return False
+        if (
+            isinstance(anc, ast.Call)
+            and isinstance(anc.func, ast.Name)
+            and anc.func.id in ("sorted", "len", "set", "frozenset", "min", "max", "sum")
+        ):
+            # sorted() restores determinism; len/min/max/sum and a set
+            # destination are order-free consumers
+            return True
+    return False
+
+
+@register_pass
+class DeterminismPass(LintPass):
+    name = "determinism"
+    codes = ("GL901", "GL902", "GL903", "GL904")
+    description = "nondeterminism reachable from bit-equivalence-critical roots"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        graph = ctx.callgraph
+        roots = graph.resolve_root_names(BIT_EQUIVALENCE_ROOTS)
+        if not roots:
+            return []
+        reach = graph.reach_from(roots)
+        findings: List[Finding] = []
+        for fn in graph.functions:
+            via = reach.get(fn.full)
+            if via is None:
+                continue
+            if any(fn.module.relpath.startswith(p) for p in TIMESTAMP_EXEMPT_PATHS):
+                exempt_clock = True
+            else:
+                exempt_clock = False
+            findings.extend(self._check_fn(graph, fn, via, exempt_clock))
+        findings.sort(key=lambda f: (f.path, f.line, f.code))
+        return findings
+
+    def _check_fn(
+        self, graph: CallGraph, fn: FunctionInfo, via: str, exempt_clock: bool
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def emit(code: str, line: int, detail: str, message: str) -> None:
+            if detail in seen:
+                return
+            seen.add(detail)
+            findings.append(
+                Finding(
+                    code=code,
+                    path=fn.module.relpath,
+                    line=line,
+                    symbol=fn.qualname,
+                    detail=detail,
+                    message=f"{message} — reachable from bit-equivalence-"
+                    f"critical root `{via}` (docs/STATIC_ANALYSIS.md)",
+                )
+            )
+
+        set_locals = self._set_locals(fn)
+        for node in fn.body_nodes():
+            if isinstance(node, ast.Call):
+                name = graph.external_name(node.func, fn, fn.module)
+                if name in _WALL_CLOCK and not exempt_clock:
+                    emit(
+                        "GL901", node.lineno, name,
+                        f"wall-clock read `{name}()` feeds content on a "
+                        "bit-equivalence-critical path: two runs (or two "
+                        "ranks) produce different bytes — derive the value "
+                        "from step/epoch counters, or move it to the "
+                        "telemetry stream",
+                    )
+                elif name and (
+                    name.startswith("random.") or name.startswith("numpy.random.")
+                ) and name not in _SEEDED_RNG:
+                    # NOT gated on the timestamp exemption: telemetry modules
+                    # own wall-clock semantics, but global RNG on a
+                    # bit-critical path is a divergence wherever it lives
+                    emit(
+                        "GL902", node.lineno, name,
+                        f"global-RNG call `{name}()` on a bit-equivalence-"
+                        "critical path: module-level RNG state is shared and "
+                        "order-dependent — thread an explicit seeded "
+                        "generator (random.Random(seed) / "
+                        "np.random.default_rng(seed)) instead",
+                    )
+                elif name in _DIR_SCANS and not _in_sorted(fn.module, node):
+                    emit(
+                        "GL903", node.lineno, name,
+                        f"`{name}()` order is filesystem-dependent; consumed "
+                        "without `sorted()` a spool/checkpoint scan diverges "
+                        "across hosts and reruns — wrap the scan in "
+                        "`sorted(...)` at the call site",
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _DIR_SCAN_METHODS
+                    and name is None
+                    and not _in_sorted(fn.module, node)
+                ):
+                    # Path-object scans: p.iterdir()/p.glob(...) on a local
+                    emit(
+                        "GL903", node.lineno, f".{node.func.attr}",
+                        f"`.{node.func.attr}()` order is filesystem-"
+                        "dependent; wrap the scan in `sorted(...)` at the "
+                        "call site",
+                    )
+            # GL904: ordered iteration over a set-typed local
+            iters: List[ast.AST] = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+                iters.extend(gen.iter for gen in node.generators)
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                if node.func.id in ("list", "tuple") and node.args:
+                    iters.append(node.args[0])
+            for it in iters:
+                if (
+                    isinstance(it, ast.Name)
+                    and it.id in set_locals
+                    and not _in_sorted(fn.module, it)
+                ):
+                    emit(
+                        "GL904", it.lineno, it.id,
+                        f"iteration over set-typed local `{it.id}` feeds "
+                        "ordered output: set order is salted per process — "
+                        f"iterate `sorted({it.id})`",
+                    )
+                elif isinstance(it, (ast.Set, ast.SetComp)) and not _in_sorted(
+                    fn.module, it
+                ):
+                    emit(
+                        "GL904", it.lineno, "<set-literal>",
+                        "iteration over a set expression feeds ordered "
+                        "output: set order is salted per process — wrap it "
+                        "in `sorted(...)`",
+                    )
+        return findings
+
+    def _set_locals(self, fn: FunctionInfo) -> Set[str]:
+        """Locals assigned from a set-producing expression in ``fn``."""
+
+        def is_set_expr(expr: ast.AST, known: Set[str]) -> bool:
+            if isinstance(expr, (ast.Set, ast.SetComp)):
+                return True
+            if (
+                isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Name)
+                and expr.func.id in ("set", "frozenset")
+            ):
+                return True
+            if isinstance(expr, ast.Name):
+                return expr.id in known
+            if isinstance(expr, ast.BinOp) and isinstance(
+                expr.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+            ):
+                return is_set_expr(expr.left, known) or is_set_expr(
+                    expr.right, known
+                )
+            return False
+
+        out: Set[str] = set()
+        nonset: Set[str] = set()
+        # two sweeps so `b = a | other` resolves through `a = set(...)`
+        for _ in range(2):
+            for node in fn.body_nodes():
+                if not isinstance(node, ast.Assign):
+                    continue
+                hit = is_set_expr(node.value, out)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        (out if hit else nonset).add(t.id)
+        # a name ALSO assigned from a non-set expression is out: the common
+        # `seen = sorted(seen)` rebind launders the set into a list, and
+        # path-insensitive tracking must not flag iterating the result
+        return out - nonset

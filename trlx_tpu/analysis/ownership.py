@@ -1,0 +1,749 @@
+"""Ownership/lifecycle pass (GL80x): every manual acquire/release protocol
+in the package — refcounted KV blocks, prefix-cache entry refs, spool
+chunks, checkpoint staging, tracer spans, spawned threads — is released on
+EVERY exit of the acquiring function, including exception paths.
+
+**The registry.** Acquire/release pairs are declared by trailing comments
+on the *defining* methods' ``def`` lines::
+
+    def alloc(self, n: int) -> List[int]:  # acquires: kv-block-ref
+        ...
+    def release(self, blocks) -> List[int]:  # releases: kv-block-ref(arg)
+        ...
+
+The parenthesized handle spec says where the owned value lives at a CALL
+site of the method:
+
+- ``result`` (acquire default) — the call's return value; tracked when
+  assigned to a plain local (``fresh = self._alloc_blocks(n)``). A bare
+  expression statement discards the only handle — an immediate GL801.
+- ``arg`` (release default) — the first positional argument
+  (``self.allocator.release(blocks)`` releases ``blocks``).
+- ``receiver`` — the object the method is called on (``t.join()``
+  releases ``t``); only plain local receivers are tracked.
+- ``object`` — ownership lives on the receiver object across calls
+  (``PrefixCache.insert`` retains into the cache's own entry table); the
+  registry documents the protocol, but per-function tracking is skipped.
+
+``threading.Thread`` / ``multiprocessing.Process`` carry a built-in pair
+(``start`` acquires / ``join`` releases, resource ``thread``) applied to
+locals constructed from those classes in the same function.
+
+Call sites resolve through receiver types, not bare names: ``self.m()``
+via the class closure, annotated params (``allocator: BlockAllocator``),
+locals assigned from a package-class constructor, and ``self.<attr>``
+assigned from one anywhere in the class — so an unrelated ``d.get(...)``
+never matches an annotated ``get``.
+
+**The checks** (exception-edge model: ``callgraph.ExceptionFlow``):
+
+- GL801 — an acquired handle is live at an exit: an early ``return`` or
+  ``raise`` between acquire and release, or function end without release
+  (the classic leaked block ref on an exception path). A ``try/finally``
+  whose finalbody releases the handle covers every exit crossing the try;
+  an acquire spelled as a ``with`` context expression is covered by
+  ``__exit__``.
+- GL802 — double release of one handle on a straight-line path.
+- GL803 — a read of the handle after its release (the same local dataflow
+  shape as the donation pass's read-after-donate; rebinding clears).
+- GL804 — the handle is released only under a conditional with no
+  error-path counterpart: an exit where the release *may* not have
+  happened (``if ok: release(b)`` … ``return``).
+
+Ownership transfer ends tracking (under-approximation, fewer findings):
+storing the handle into a ``self.*`` attribute / any subscripted target,
+returning or yielding it, aliasing it to another local, appending it to a
+container (``self._threads.append(thread)``), or passing it to another
+*package* function (the callee may assume ownership). The defining
+methods themselves are exempt for their own resource — their bodies ARE
+the protocol implementation.
+"""
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from trlx_tpu.analysis.callgraph import (
+    CallGraph,
+    ExceptionFlow,
+    FunctionInfo,
+    THREAD_CONSTRUCTORS,
+    attr_chain,
+)
+from trlx_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    LintPass,
+    register_pass,
+)
+
+__all__ = ["OwnershipPass", "OwnershipRegistry"]
+
+_ANNOT_RE = re.compile(
+    r"#\s*(acquires|releases):\s*([A-Za-z_][A-Za-z0-9_\-]*)"
+    r"\s*(?:\((arg|result|receiver|object)\))?"
+)
+
+# container-mutator names whose argument escapes into the container
+_ESCAPE_MUTATORS = {
+    "append", "extend", "add", "insert", "appendleft", "update",
+    "setdefault", "put", "put_nowait",
+}
+
+Chain = Tuple[str, ...]
+
+
+@dataclass
+class ProtocolMethod:
+    """One annotated acquire/release method."""
+
+    fn: FunctionInfo
+    role: str  # "acquires" | "releases"
+    resource: str
+    spec: str  # "result" | "arg" | "receiver" | "object"
+
+
+@dataclass
+class _Event:
+    call: ast.Call
+    role: str  # "acquire" | "release"
+    resource: str
+    spec: str
+    handle: Optional[Chain]
+
+
+@dataclass
+class _Track:
+    resource: str
+    state: str  # "live" | "released" | "cond" | "covered"
+    acquire_line: int
+    release_line: int = 0
+
+    def copy(self) -> "_Track":
+        return _Track(self.resource, self.state, self.acquire_line, self.release_line)
+
+
+class OwnershipRegistry:
+    """The annotated acquire/release protocol methods, plus the receiver
+    typing needed to match their call sites."""
+
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        # method name -> annotated methods with that name
+        self.by_name: Dict[str, List[ProtocolMethod]] = {}
+        # FunctionInfo.full -> its own annotations (defining-method exemption)
+        self.own: Dict[str, List[ProtocolMethod]] = {}
+        self._class_attr_types: Dict[str, Dict[str, str]] = {}
+        self._collect()
+
+    def _collect(self) -> None:
+        for fn in self.graph.functions:
+            node = fn.node
+            if isinstance(node, ast.Lambda):
+                continue
+            body = getattr(node, "body", None)
+            if not body:
+                continue
+            # the signature region: the def line up to (excluding) the first
+            # body statement — docstring examples can never live here
+            lines = fn.module.lines
+            for lineno in range(node.lineno, max(body[0].lineno, node.lineno + 1)):
+                if lineno - 1 >= len(lines):
+                    break
+                m = _ANNOT_RE.search(lines[lineno - 1])
+                if not m:
+                    continue
+                role, resource, spec = m.group(1), m.group(2), m.group(3)
+                if spec is None:
+                    spec = "result" if role == "acquires" else "arg"
+                pm = ProtocolMethod(fn, role, resource, spec)
+                name = fn.qualname.rsplit(".", 1)[-1]
+                self.by_name.setdefault(name, []).append(pm)
+                self.own.setdefault(fn.full, []).append(pm)
+
+    def own_resources(self, fn: FunctionInfo) -> Set[str]:
+        return {pm.resource for pm in self.own.get(fn.full, ())}
+
+    # -- receiver typing --------------------------------------------------
+
+    def class_attr_types(self, class_full: str) -> Dict[str, str]:
+        """attr -> class full (or "@thread") for ``self.<attr> = Cls(...)``
+        assignments anywhere in the class."""
+        cached = self._class_attr_types.get(class_full)
+        if cached is not None:
+            return cached
+        out: Dict[str, str] = {}
+        info = self.graph.classes.get(class_full)
+        if info is not None:
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Assign) or not isinstance(
+                    node.value, ast.Call
+                ):
+                    continue
+                scope = self.graph.enclosing_function(info.module, node)
+                ctor = self._ctor_class(node.value, scope, info.module)
+                if ctor is None:
+                    continue
+                for t in node.targets:
+                    chain = attr_chain(t)
+                    if chain and len(chain) == 2 and chain[0] == "self":
+                        out[chain[1]] = ctor
+        self._class_attr_types[class_full] = out
+        return out
+
+    def _ctor_class(
+        self, call: ast.Call, scope: Optional[FunctionInfo], mod
+    ) -> Optional[str]:
+        """Class full of a ``Cls(...)`` constructor call ("@thread" for the
+        built-in thread/process constructors); None when unresolvable."""
+        name = self.graph.external_name(call.func, scope, mod)
+        if name in THREAD_CONSTRUCTORS:
+            return "@thread"
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        cls = self.graph._resolve_dotted_class(".".join(chain), mod)
+        return cls.full if cls else None
+
+    def local_types(self, fn: FunctionInfo) -> Dict[str, str]:
+        """local name -> class full / "@thread", from annotated params
+        (``allocator: BlockAllocator``) and constructor assignments."""
+        out: Dict[str, str] = dict(fn.var_types)
+        for node in fn.body_nodes():
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            ctor = self._ctor_class(node.value, fn, fn.module)
+            if ctor is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = ctor
+        return out
+
+    # -- call-site classification ----------------------------------------
+
+    def classify(
+        self, call: ast.Call, fn: FunctionInfo, local_types: Dict[str, str]
+    ) -> Optional[_Event]:
+        chain = attr_chain(call.func)
+        if chain is None:
+            return None
+        if len(chain) == 1:
+            # bare call: an imported/module-level annotated function
+            for callee in self.graph.resolve_callable(call.func, fn, fn.module):
+                for pm in self.own.get(callee.full, ()):
+                    return self._event(call, pm)
+            return None
+        method, receiver = chain[-1], tuple(chain[:-1])
+        rtype = self._receiver_type(receiver, fn, local_types)
+        # built-in thread pair: start/join on a local Thread/Process
+        if rtype == "@thread":
+            if method == "start":
+                return _Event(call, "acquire", "thread", "receiver", receiver)
+            if method == "join":
+                return _Event(call, "release", "thread", "receiver", receiver)
+            return None
+        candidates = self.by_name.get(method)
+        if not candidates:
+            return None
+        if receiver == ("self",):
+            cls = self.graph._enclosing_class(fn)
+            if cls is None:
+                return None
+            resolved = {m.full for m in self.graph.resolve_method(cls, method)}
+            for pm in candidates:
+                if pm.fn.full in resolved:
+                    return self._event(call, pm)
+            return None
+        if rtype is None:
+            return None
+        if rtype in self.graph.classes:
+            related = self.graph.related_classes(rtype)
+        else:
+            related = {rtype}
+        for pm in candidates:
+            if pm.fn.class_full and pm.fn.class_full in related:
+                return self._event(call, pm)
+        return None
+
+    def _receiver_type(
+        self, receiver: Chain, fn: FunctionInfo, local_types: Dict[str, str]
+    ) -> Optional[str]:
+        if len(receiver) == 1 and receiver[0] != "self":
+            return local_types.get(receiver[0])
+        if len(receiver) == 2 and receiver[0] == "self":
+            cls = self.graph._enclosing_class(fn)
+            if cls is None:
+                return None
+            for related in sorted(self.graph.related_classes(cls)):
+                hit = self.class_attr_types(related).get(receiver[1])
+                if hit:
+                    return hit
+        return None
+
+    def _event(self, call: ast.Call, pm: ProtocolMethod) -> _Event:
+        role = "acquire" if pm.role == "acquires" else "release"
+        handle: Optional[Chain] = None
+        if pm.spec == "arg" and call.args:
+            chain = attr_chain(call.args[0])
+            handle = tuple(chain) if chain else None
+        elif pm.spec == "receiver":
+            chain = attr_chain(call.func)
+            if chain and len(chain) == 2 and chain[0] != "self":
+                handle = (chain[0],)
+        # "result" handles are derived from the enclosing statement shape
+        return _Event(call, role, pm.resource, pm.spec, handle)
+
+
+def _stmt_subnodes(stmt: ast.AST):
+    """The statement's own expression nodes: nested defs/lambdas/classes
+    and nested *statements* are skipped — compound bodies are walked as
+    their own interpreter steps."""
+    work: List[ast.AST] = [stmt]
+    while work:
+        node = work.pop()
+        if node is not stmt and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        if node is not stmt and isinstance(node, ast.stmt):
+            continue
+        yield node
+        work.extend(ast.iter_child_nodes(node))
+
+
+def _mentions(node: ast.AST, handle: Chain) -> bool:
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Name)
+            and isinstance(sub.ctx, ast.Load)
+            and sub.id == handle[0]
+        ):
+            return True
+    return False
+
+
+@register_pass
+class OwnershipPass(LintPass):
+    name = "ownership"
+    codes = ("GL801", "GL802", "GL803", "GL804")
+    description = "acquired resources not released on every exit path"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        graph = ctx.callgraph
+        registry = OwnershipRegistry(graph)
+        findings: List[Finding] = []
+        for fn in graph.functions:
+            if isinstance(fn.node, ast.Lambda):
+                continue
+            findings.extend(_FunctionCheck(graph, registry, fn).run())
+        findings.sort(key=lambda f: (f.path, f.line, f.code))
+        return findings
+
+
+class _FunctionCheck:
+    """Per-function abstract interpretation: handle states over the
+    statement tree, with try/finally and ``with`` exception edges."""
+
+    def __init__(self, graph: CallGraph, registry: OwnershipRegistry, fn: FunctionInfo):
+        self.graph = graph
+        self.registry = registry
+        self.fn = fn
+        self.flow = ExceptionFlow(fn)
+        self.with_calls = self.flow.with_context_calls()
+        self.local_types = registry.local_types(fn)
+        self.own = registry.own_resources(fn)
+        self.findings: List[Finding] = []
+        self.escaped: Set[Chain] = set()
+        self._reported: Set[Tuple[str, Chain]] = set()
+
+    def run(self) -> List[Finding]:
+        body = self.fn.body_statements()
+        if not body:
+            return []
+        state: Dict[Chain, _Track] = {}
+        terminal = self._walk(body, state)
+        if not terminal:
+            line = getattr(body[-1], "end_lineno", body[-1].lineno)
+            self._check_exit(state, line, "function end")
+        return self.findings
+
+    # -- findings ---------------------------------------------------------
+
+    def _emit(self, code: str, line: int, handle: Chain, resource: str,
+              message: str) -> None:
+        key = (code, handle)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self.findings.append(
+            Finding(
+                code=code,
+                path=self.fn.module.relpath,
+                line=line,
+                symbol=self.fn.qualname,
+                detail=f"{'.'.join(handle)}:{resource}",
+                message=message,
+            )
+        )
+
+    def _check_exit(self, state: Dict[Chain, _Track], line: int, where: str) -> None:
+        for handle, track in sorted(state.items()):
+            name = ".".join(handle)
+            if track.state == "live":
+                self._emit(
+                    "GL801", line, handle, track.resource,
+                    f"`{name}` holds a `{track.resource}` acquired on line "
+                    f"{track.acquire_line} but is not released on this exit "
+                    f"path ({where}) — release it in a finally, use a "
+                    "with-block, or transfer ownership explicitly",
+                )
+            elif track.state == "cond":
+                self._emit(
+                    "GL804", line, handle, track.resource,
+                    f"`{name}` (`{track.resource}`, acquired on line "
+                    f"{track.acquire_line}) is released only under a "
+                    "conditional with no counterpart on this exit path — "
+                    "the other branch (or an error path) leaks it; release "
+                    "unconditionally or in a finally",
+                )
+
+    # -- the walk ---------------------------------------------------------
+
+    def _walk(self, stmts: List[ast.stmt], state: Dict[Chain, _Track]) -> bool:
+        """Interpret ``stmts`` mutating ``state``; True when every path
+        through the body leaves the function (return/raise)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                # the test expression runs first on every path — releases,
+                # reads, and double releases spelled in the condition count
+                # (_stmt_subnodes skips the nested branch statements)
+                self._simple(stmt, state)
+                s1 = _copy_state(state)
+                t1 = self._walk(stmt.body, s1)
+                s2 = _copy_state(state)
+                t2 = self._walk(stmt.orelse, s2) if stmt.orelse else False
+                self._merge(state, [(s1, t1), (s2, t2)])
+                if t1 and t2:
+                    return True
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                self._simple(stmt, state)
+                s1 = _copy_state(state)
+                self._walk(stmt.body, s1)
+                # the body may run zero times: merge entry and one-iteration
+                self._merge(state, [(s1, False), (_copy_state(state), False)])
+                if stmt.orelse:
+                    self._walk(stmt.orelse, state)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._simple(stmt, state)
+                if self._walk(stmt.body, state):
+                    return True
+                continue
+            if isinstance(stmt, ast.Try):
+                if self._try(stmt, state):
+                    return True
+                continue
+            self._simple(stmt, state)
+            if isinstance(stmt, ast.Return):
+                self._check_exit(state, stmt.lineno, "early return")
+                return True
+            if isinstance(stmt, ast.Raise):
+                self._check_exit(state, stmt.lineno, "raise")
+                return True
+        return False
+
+    def _merge(self, state: Dict[Chain, _Track],
+               branches: List[Tuple[Dict[Chain, _Track], bool]]) -> None:
+        """Merge branch outcomes back into ``state``. A terminal branch
+        (its exits were already checked) contributes nothing; live+released
+        across surviving branches becomes "cond" — the GL804 signal."""
+        live_branches = [s for s, terminal in branches if not terminal]
+        state.clear()
+        if not live_branches:
+            return
+        keys: Set[Chain] = set()
+        for s in live_branches:
+            keys |= set(s)
+        for h in sorted(keys):
+            if h in self.escaped:
+                continue  # transferred on some path: ownership moved
+            tracks = [s[h].copy() for s in live_branches if h in s]
+            states = {t.state for t in tracks}
+            first = tracks[0]
+            if len(tracks) < len(live_branches):
+                # tracked on some paths only (acquired under a conditional):
+                # a path still holding it keeps the leak check alive
+                holding = [t for t in tracks if t.state in ("live", "cond", "covered")]
+                if holding:
+                    state[h] = holding[0]
+            elif len(states) == 1:
+                state[h] = first
+            elif "live" in states or "cond" in states:
+                merged = _Track(first.resource, "cond", first.acquire_line)
+                for t in tracks:
+                    merged.release_line = max(merged.release_line, t.release_line)
+                state[h] = merged
+            else:  # released/covered mixtures: the resource is safe
+                state[h] = first
+
+    def _try(self, stmt: ast.Try, state: Dict[Chain, _Track]) -> bool:
+        # handles released in the finalbody are covered on EVERY exit
+        # crossing the try — the exception edge the model exists for
+        covered: List[Chain] = []
+        final_releases = self._release_handles(stmt.finalbody)
+        for handle, track in state.items():
+            if track.state in ("live", "cond") and handle in final_releases:
+                track.state = "covered"
+                covered.append(handle)
+        entry = _copy_state(state)
+        t_body = self._walk(stmt.body, state)
+        if stmt.orelse and not t_body:
+            t_body = self._walk(stmt.orelse, state)
+        # handlers run from the conservative ENTRY state: an acquire inside
+        # the try may or may not have happened when the exception fired
+        branches: List[Tuple[Dict[Chain, _Track], bool]] = [(state, t_body)]
+        handlers_terminal = bool(stmt.handlers)
+        for handler in stmt.handlers:
+            hs = _copy_state(entry)
+            ht = self._walk(handler.body, hs)
+            handlers_terminal = handlers_terminal and ht
+            branches.append((hs, ht))
+        merged = _copy_state(state)
+        self._merge(merged, branches)
+        state.clear()
+        state.update(merged)
+        if stmt.finalbody:
+            self._walk(stmt.finalbody, state)
+            for handle in covered:
+                track = state.get(handle)
+                if track is not None and track.state == "covered":
+                    track.state = "released"
+        return t_body and handlers_terminal
+
+    def _release_handles(self, stmts: List[ast.stmt]) -> Set[Chain]:
+        out: Set[Chain] = set()
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                ev = self.registry.classify(node, self.fn, self.local_types)
+                if ev is not None and ev.role == "release" and ev.handle:
+                    out.add(ev.handle)
+        return out
+
+    # -- one simple statement (or a compound statement's header) ----------
+
+    def _simple(self, stmt: ast.stmt, state: Dict[Chain, _Track]) -> None:
+        calls: List[ast.Call] = []
+        loads: List[Tuple[Chain, ast.Name]] = []
+        for node in _stmt_subnodes(stmt):
+            if isinstance(node, ast.Call):
+                calls.append(node)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                loads.append(((node.id,), node))
+        events = [
+            ev
+            for ev in (
+                self.registry.classify(c, self.fn, self.local_types) for c in calls
+            )
+            if ev is not None
+        ]
+        event_calls = {id(ev.call) for ev in events}
+        # a release's own argument load is the release, not a read — a
+        # repeated release must report GL802 alone, not GL802+GL803
+        release_arg_nodes: Set[int] = set()
+        for ev in events:
+            if ev.role == "release":
+                for sub in ast.walk(ev.call):
+                    release_arg_nodes.add(id(sub))
+
+        # GL803: reads of an already-released handle (checked against the
+        # state BEFORE this statement's own releases apply)
+        for chain, load_node in loads:
+            if id(load_node) in release_arg_nodes:
+                continue
+            track = state.get(chain)
+            if track is not None and track.state == "released":
+                self._emit(
+                    "GL803", stmt.lineno, chain, track.resource,
+                    f"`{'.'.join(chain)}` is read after its "
+                    f"`{track.resource}` was released on line "
+                    f"{track.release_line} — a released resource may already "
+                    "belong to another owner (read before releasing, or "
+                    "re-acquire)",
+                )
+
+        # releases
+        for ev in events:
+            if ev.role != "release" or ev.handle is None:
+                continue
+            track = state.get(ev.handle)
+            if track is None or ev.handle in self.escaped:
+                continue
+            if track.state == "released":
+                self._emit(
+                    "GL802", ev.call.lineno, ev.handle, track.resource,
+                    f"`{'.'.join(ev.handle)}`'s `{track.resource}` is "
+                    f"released twice (first on line {track.release_line}) — "
+                    "a double release corrupts the refcount and can free a "
+                    "resource another owner still shares",
+                )
+            else:
+                track.state = "released"
+                track.release_line = ev.call.lineno
+
+        # transfers of tracked handles END tracking (the callee/container/
+        # object owns the resource now)
+        self._transfers(stmt, state, event_calls)
+
+        # acquires
+        for ev in events:
+            if ev.role != "acquire" or ev.resource in self.own:
+                continue
+            if id(ev.call) in self.with_calls:
+                continue  # with-context acquire: __exit__ covers every exit
+            handle = ev.handle
+            if ev.spec == "result":
+                handle = self._result_handle(stmt, ev.call)
+                if (
+                    handle is None
+                    and isinstance(stmt, ast.Expr)
+                    and stmt.value is ev.call
+                ):
+                    self._emit(
+                        "GL801", ev.call.lineno, ("<discarded>",), ev.resource,
+                        f"the only handle to an acquired `{ev.resource}` is "
+                        "discarded (bare expression statement) — nothing can "
+                        "ever release it",
+                    )
+                    continue
+            if handle is None or handle in self.escaped or len(handle) > 1:
+                # unresolvable / escaped / attr-rooted handles are
+                # object-scoped: out of per-function scope
+                continue
+            if self._finally_covers(ev):
+                continue
+            state[handle] = _Track(ev.resource, "live", ev.call.lineno)
+
+    def _finally_covers(self, ev: _Event) -> bool:
+        """A release of the same handle (or, for result-handles bound this
+        statement, the same resource) in a finalbody enclosing the acquire
+        covers every exit inside that try."""
+        for t in self.flow.covering_finallys(ev.call):
+            handles = self._release_handles(t.finalbody)
+            if ev.handle is not None and ev.handle in handles:
+                return True
+            for stmt in t.finalbody:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    fev = self.registry.classify(node, self.fn, self.local_types)
+                    if fev is not None and fev.role == "release" and (
+                        fev.resource == ev.resource
+                    ):
+                        return True
+        return False
+
+    def _result_handle(self, stmt: ast.stmt, call: ast.Call) -> Optional[Chain]:
+        if isinstance(stmt, ast.Assign) and stmt.value is call:
+            if len(stmt.targets) == 1 and isinstance(stmt.targets[0], ast.Name):
+                return (stmt.targets[0].id,)
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is call:
+            if isinstance(stmt.target, ast.Name):
+                return (stmt.target.id,)
+        return None
+
+    def _transfers(self, stmt: ast.stmt, state: Dict[Chain, _Track],
+                   event_calls: Set[int]) -> None:
+        # candidates include typed-but-not-yet-acquired locals: a Thread
+        # appended to self._threads BEFORE .start() has already transferred
+        # ownership — the later receiver-acquire must not start tracking
+        tracked = [h for h, t in state.items() if t.state in ("live", "cond")]
+        tracked += [
+            (n,) for n in self.local_types
+            if (n,) not in state and (n,) not in self.escaped
+        ]
+        moved: Set[Chain] = set()
+
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            for h in tracked:
+                if _mentions(stmt.value, h):
+                    moved.add(h)
+        for node in _stmt_subnodes(stmt):
+            if isinstance(node, (ast.Yield, ast.YieldFrom)) and node.value is not None:
+                for h in tracked:
+                    if _mentions(node.value, h):
+                        moved.add(h)
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            value = stmt.value
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    h = (t.id,)
+                    # rebinding the handle name clears tracking (unless the
+                    # value is this statement's own acquire, which re-tracks)
+                    if h in state and not (
+                        isinstance(value, ast.Call) and id(value) in event_calls
+                    ):
+                        del state[h]
+                    # direct aliasing (`b = handle` / `pair = (h, x)`): the
+                    # alias shares ownership — stop tracking. Reads through
+                    # calls (`n = len(handle)`) do NOT transfer.
+                    if value is not None:
+                        for h2 in tracked:
+                            if _alias_value(value, h2):
+                                moved.add(h2)
+                else:
+                    # store into self.*, a subscript, tuple unpack: escapes
+                    if value is not None:
+                        for h in tracked:
+                            if _mentions(value, h):
+                                moved.add(h)
+                    chain = attr_chain(t)
+                    if chain and tuple(chain) in state:
+                        del state[tuple(chain)]
+        for node in _stmt_subnodes(stmt):
+            if not isinstance(node, ast.Call) or id(node) in event_calls:
+                continue
+            is_escape_mutator = (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _ESCAPE_MUTATORS
+            )
+            is_package_callee = bool(
+                self.graph.resolve_callable(node.func, self.fn, self.fn.module)
+            )
+            if not (is_escape_mutator or is_package_callee):
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for h in tracked:
+                    if _mentions(arg, h):
+                        moved.add(h)
+        for h in moved:
+            state.pop(h, None)
+            self.escaped.add(h)
+
+
+def _alias_value(value: ast.AST, handle: Chain) -> bool:
+    """Does an assignment VALUE alias the handle into a new binding? Bare
+    names and tuple/list/binop compositions alias; a call result does not
+    (``n = len(handle)`` is a read)."""
+    if isinstance(value, ast.Name):
+        return value.id == handle[0]
+    if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+        return any(_alias_value(e, handle) for e in value.elts)
+    if isinstance(value, ast.BinOp):
+        return _alias_value(value.left, handle) or _alias_value(value.right, handle)
+    if isinstance(value, ast.Starred):
+        return _alias_value(value.value, handle)
+    return False
+
+
+def _copy_state(state: Dict[Chain, _Track]) -> Dict[Chain, _Track]:
+    return {h: t.copy() for h, t in state.items()}

@@ -213,9 +213,12 @@ class FileExperienceQueue:
 
     def committed_indices(self) -> set:
         """Produced-but-unconsumed chunk indices currently in the spool —
-        a respawned actor skips these (and everything below the cursor)."""
+        a respawned actor skips these (and everything below the cursor).
+        The scan is sorted: consumers today are order-free (membership
+        tests), but directory order is filesystem-dependent and a future
+        ordered consumer must not inherit it silently (GL903)."""
         out = set()
-        for name in os.listdir(self.root):
+        for name in sorted(os.listdir(self.root)):
             if name.startswith("chunk_") and name.endswith(".npz"):
                 try:
                     out.add(int(name[len("chunk_"):-len(".npz")]))
@@ -230,8 +233,15 @@ class FileExperienceQueue:
     def done(self) -> bool:
         return os.path.exists(os.path.join(self.root, self.DONE))
 
-    def put(self, chunk: ExperienceChunk, stop: Optional[threading.Event] = None) -> None:
-        """Commit one chunk, back-pressuring against the consumer cursor."""
+    def put(  # acquires: spool-chunk(object)
+        self, chunk: ExperienceChunk, stop: Optional[threading.Event] = None
+    ) -> None:
+        """Commit one chunk, back-pressuring against the consumer cursor.
+
+        Lifecycle (graftlint ownership registry, docs/STATIC_ANALYSIS.md):
+        the tmp write is the *stage*, ``os.replace`` the *commit*; the chunk
+        then exists in the spool until :meth:`get` consumes it — stage →
+        commit → consume, owned by the spool directory between the two."""
         while chunk.index - self.cursor() >= self.capacity:
             if self.done or (stop is not None and stop.is_set()):
                 raise QueueClosed("spool closed")
@@ -244,7 +254,9 @@ class FileExperienceQueue:
             np.savez(f, **arrays)
         os.replace(tmp, path)
 
-    def get(self, index: int, timeout: Optional[float] = None) -> ExperienceChunk:
+    def get(  # releases: spool-chunk(object)
+        self, index: int, timeout: Optional[float] = None
+    ) -> ExperienceChunk:
         """Consume chunk ``index``: wait for its file, load, delete, advance
         the cursor. ``timeout`` bounds the wait (actor-liveness guard)."""
         path = self._chunk_path(index)

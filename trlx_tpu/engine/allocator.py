@@ -13,6 +13,12 @@ unallocated regions reproduce the dense cache's zeros.
 
 Single-threaded by design, like the engine that owns it (see the thread-
 affinity note in ``trlx_tpu/engine/core.py``).
+
+The acquire/release protocol is declared to graftlint's ownership pass
+(``# acquires:`` / ``# releases:`` on the methods below; GL80x,
+docs/STATIC_ANALYSIS.md): a caller holding a ``kv-block-ref`` must release
+it on every exit — including exception paths — or transfer ownership
+(store it on the engine's per-slot state, commit it to the prefix cache).
 """
 
 from collections import deque
@@ -63,7 +69,7 @@ class BlockAllocator:
 
     # -- transitions -----------------------------------------------------
 
-    def alloc(self, n: int) -> List[int]:
+    def alloc(self, n: int) -> List[int]:  # acquires: kv-block-ref
         """Take ``n`` fresh blocks (refcount 1 each). Raises
         :class:`BlockPoolExhausted` when the free list is short — the
         engine catches this once, evicts prefix-cache entries, and retries
@@ -80,14 +86,14 @@ class BlockAllocator:
         self.high_water = max(self.high_water, self.blocks_in_use)
         return out
 
-    def retain(self, blocks: Iterable[int]) -> None:
+    def retain(self, blocks: Iterable[int]) -> None:  # acquires: kv-block-ref(arg)
         """One more holder for already-allocated blocks (prefix-cache hit)."""
         for b in blocks:
             if b not in self._refcount:
                 raise ValueError(f"retain of unallocated block {b}")
             self._refcount[b] += 1
 
-    def release(self, blocks: Iterable[int]) -> List[int]:
+    def release(self, blocks: Iterable[int]) -> List[int]:  # releases: kv-block-ref(arg)
         """Drop one ref per block; returns the blocks that became free."""
         freed: List[int] = []
         for b in blocks:

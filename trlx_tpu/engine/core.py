@@ -500,7 +500,7 @@ class ContinuousEngine(Engine):
 
     # -- paged-block bookkeeping ----------------------------------------
 
-    def _alloc_blocks(self, n: int) -> List[int]:
+    def _alloc_blocks(self, n: int) -> List[int]:  # acquires: kv-block-ref
         """Allocate with one eviction retry: on pool pressure, drop LRU
         prefix-cache entries (their blocks free unless a live row still
         shares them) before giving up."""
@@ -522,7 +522,7 @@ class ContinuousEngine(Engine):
             self.allocator.high_water * self._block_bytes
         )
 
-    def _prepare_row(self, req: "_Request", slot: int) -> int:
+    def _prepare_row(self, req: "_Request", slot: int) -> int:  # acquires: row-block-ref(object)
         """Assign blocks for one refilled row: shared prefix blocks from
         the cache (refcount++), fresh private blocks for the rest of the
         prompt region. Returns the row's hit length in cache columns
@@ -546,13 +546,11 @@ class ContinuousEngine(Engine):
         # it back as this row's writable "fresh" block (aliasing a shared
         # prefix position with a write target). With the row's ref held,
         # eviction only ever drops the cache's ref — the block survives.
-        if shared:
-            self.allocator.retain(shared)
+        self.allocator.retain(shared)  # no-op for a cold miss (empty hit)
         try:
             fresh = self._alloc_blocks(n_prompt_blocks - len(shared))
         except BlockPoolExhausted:
-            if shared:
-                self.allocator.release(shared)  # no leak on the error path
+            self.allocator.release(shared)  # no leak on the error path
             raise
         row = np.zeros(self._TB, np.int32)
         row[: len(shared)] = shared
@@ -672,7 +670,7 @@ class ContinuousEngine(Engine):
                 )
         self._note_block_usage()
 
-    def _harvest(self) -> List[CompletedSequence]:
+    def _harvest(self) -> List[CompletedSequence]:  # releases: row-block-ref(object)
         done = np.asarray(self.state.done)
         finished = [
             s for s in range(self.B) if self._slots[s] is not None and done[s]

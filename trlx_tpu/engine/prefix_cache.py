@@ -29,6 +29,12 @@ pressure and on the optional ``capacity_blocks`` cap.
 
 Single-threaded by design, like the engine that owns it (see the thread-
 affinity note in ``trlx_tpu/engine/core.py``).
+
+Entry refs are object-scoped ownership: ``insert`` retains blocks into the
+cache's own entry table, ``evict``/``clear`` drop them — declared to
+graftlint's ownership pass with the ``(object)`` handle spec (GL80x,
+docs/STATIC_ANALYSIS.md), which documents the protocol without per-caller
+handle tracking.
 """
 
 from dataclasses import dataclass
@@ -94,7 +100,7 @@ class PrefixCache:
             parent_uid = entry.uid
         return blocks
 
-    def insert(
+    def insert(  # acquires: prefix-entry-ref(object)
         self,
         tokens: np.ndarray,
         mask: np.ndarray,
@@ -135,7 +141,7 @@ class PrefixCache:
             )
         return inserted
 
-    def evict(
+    def evict(  # releases: prefix-entry-ref(object)
         self,
         allocator: BlockAllocator,
         blocks_needed: int = 0,
@@ -165,7 +171,7 @@ class PrefixCache:
             dropped += 1
         return freed
 
-    def clear(self, allocator: BlockAllocator) -> None:
+    def clear(self, allocator: BlockAllocator) -> None:  # releases: prefix-entry-ref(object)
         """Release every entry's ref (end-of-engine teardown)."""
         for entry in self._entries.values():
             allocator.release([entry.block])

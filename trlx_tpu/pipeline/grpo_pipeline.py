@@ -19,13 +19,14 @@ class GRPORolloutStorage(PPORolloutStorage):
     values/per-token rewards), so only collation and export change."""
 
     def export_history(self, location: str):
-        """Append rollouts as JSON (reference ``ppo_pipeline.py:30-40``)."""
+        """Append rollouts as JSON (reference ``ppo_pipeline.py:30-40``);
+        ordinal file naming shared with the PPO store — deterministic and
+        collision-free where the old timestamp name was neither."""
         import json
         import os
-        import time
 
         assert os.path.exists(location)
-        fpath = os.path.join(location, f"epoch-{str(time.time())}.json")
+        fpath = os.path.join(location, f"epoch-{self._next_export_index(location):06d}.json")
         with open(fpath, "w") as f:
             json.dump(
                 [

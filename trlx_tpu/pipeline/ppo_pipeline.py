@@ -8,7 +8,6 @@ shapes for the jitted train step).
 
 import json
 import os
-import time
 from typing import List, Optional
 
 import numpy as np
@@ -33,9 +32,15 @@ class PPORolloutStorage(BaseRolloutStore):
         self.history = []
 
     def export_history(self, location: str):
-        """Append rollouts as JSON (for algorithm-distillation datasets)."""
+        """Append rollouts as JSON (for algorithm-distillation datasets).
+
+        Files are named by export ordinal, not wall clock: a timestamped
+        name is nondeterministic (two runs disagree byte-for-byte on the
+        dataset layout) and same-second exports silently OVERWRITE each
+        other — the ordinal is derived from the directory state, so every
+        export lands in a fresh file and reruns produce identical names."""
         assert os.path.exists(location)
-        fpath = os.path.join(location, f"epoch-{str(time.time())}.json")
+        fpath = os.path.join(location, f"epoch-{self._next_export_index(location):06d}.json")
 
         def exp_to_dict(exp: PPORLElement) -> dict:
             return {
@@ -48,6 +53,20 @@ class PPORolloutStorage(BaseRolloutStore):
 
         with open(fpath, "w") as f:
             json.dump([exp_to_dict(exp) for exp in self.history], f)
+
+    @staticmethod
+    def _next_export_index(location: str) -> int:
+        """Smallest ordinal above every ``epoch-*.json`` already present
+        (sorted scan: never dependent on filesystem enumeration order)."""
+        taken = []
+        for name in sorted(os.listdir(location)):
+            if not (name.startswith("epoch-") and name.endswith(".json")):
+                continue
+            try:
+                taken.append(int(name[len("epoch-"):-len(".json")]))
+            except ValueError:
+                continue  # legacy timestamped exports don't block ordinals
+        return max(taken) + 1 if taken else 0
 
     def collate(
         self,

@@ -12,7 +12,7 @@ forward overlapping the host reward call, the hydra frozen-reference branch
   (:meth:`~trlx_tpu.models.grpo.GRPOConfig.loss`), so rewards stay pure.
 """
 
-from time import time
+from time import perf_counter
 from typing import Any, Dict, Tuple
 
 import jax
@@ -157,12 +157,12 @@ class GRPOTrainer(PPOTrainer):
         samples, prompts, outputs = self.decode(
             prompt_ids, response_tokens, append_eos_token=True
         )
-        score_time = time()
+        score_time = perf_counter()
         scores = np.asarray(
             self.reward_fn(samples=samples, prompts=prompts, outputs=outputs),
             dtype=np.float32,
         )
-        score_s = time() - score_time
+        score_s = perf_counter() - score_time
         host = to_host(score_out)
         return {
             "prompt_ids": prompt_ids,
@@ -244,7 +244,7 @@ class GRPOTrainer(PPOTrainer):
                 np.asarray(batch["attention_mask"], np.int32), G, axis=0
             )
 
-            gen_time = time()
+            gen_time = perf_counter()
             gen_out = self.generate(prompt_ids, prompt_mask)
             # dispatch the scoring forward on the generation's device arrays
             # FIRST: it needs nothing from the host, so it runs while the
@@ -266,7 +266,7 @@ class GRPOTrainer(PPOTrainer):
             )
             response_tokens = host_gen["response_tokens"]
             response_mask = host_gen["response_mask"]
-            agg["gen_time_sum"] += time() - gen_time
+            agg["gen_time_sum"] += perf_counter() - gen_time
             # slot accounting (docs/PERFORMANCE.md): this chunk's decode ran
             # max(n_i) steps over B slots — same mask-derived gauges as
             # PPO's chunked paths, so a serial-vs-CB A/B compares them
@@ -446,7 +446,7 @@ class GRPOTrainer(PPOTrainer):
             "gen_time_sum": 0.0, "score_time_sum": 0.0,
             "slot_steps": 0, "live_slot_steps": 0,
         }
-        exp_time = time()
+        exp_time = perf_counter()
 
         if bool(self.config.async_rl.enabled):
             self._collect_async_grpo(num_rollouts, elements, agg)
@@ -482,7 +482,7 @@ class GRPOTrainer(PPOTrainer):
             stats["rollout/padded_decode_frac"] = (
                 1.0 - agg["live_slot_steps"] / agg["slot_steps"]
             )
-        stats["time/exp"] = time() - exp_time
+        stats["time/exp"] = perf_counter() - exp_time
         self.make_experience_stats = stats
         self.tracker.log(stats, step=iter_count)
 

@@ -22,7 +22,7 @@ shape bucket.
 
 import os
 from contextlib import ExitStack
-from time import time
+from time import perf_counter
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -434,11 +434,11 @@ class PPOTrainer(TPUBaseTrainer):
         caller — shared verbatim between the serial reference path (trainer
         state params/RNG) and the async actor path (channel-published
         params, dispatched per-chunk RNG)."""
-        gen_time = time()
+        gen_time = perf_counter()
         # generate() opens its own fenced "generate" span, nested under the
         # caller's "rollout" span in the Chrome/Perfetto export
         gen_out = self.generate(prompt_ids, prompt_mask, params=params, rng=rng)
-        stats["time/exp_generate"] = time() - gen_time
+        stats["time/exp_generate"] = perf_counter() - gen_time
         stats["time/generate"] = self.last_generate_time
         stats.update(self.last_spec_stats)
 
@@ -469,7 +469,7 @@ class PPOTrainer(TPUBaseTrainer):
         the scoring forward: it deliberately stays open across the
         interleaved decode/reward work, so the recorded time includes the
         overlap window rather than serializing it."""
-        host_t0 = time()
+        host_t0 = perf_counter()
         # named `stats` so scripts/check_metric_names.py lints these keys too
         stats: Dict[str, float] = {}
         with ExitStack() as score_ctx:
@@ -506,7 +506,7 @@ class PPOTrainer(TPUBaseTrainer):
             "scores": scores,
             "host": host,
             "stats": stats,
-            "host_s": time() - host_t0,
+            "host_s": perf_counter() - host_t0,
         }
 
     def _rollout_chunk_finalize(
@@ -630,7 +630,7 @@ class PPOTrainer(TPUBaseTrainer):
             rows_in_flight.popleft()
             self._rollout_chunk_finalize(chunk, elements, stats, acc)
 
-        t0 = time()
+        t0 = perf_counter()
         with RolloutPipeline(
             depth=depth, finalize=finalize, name="rollout", tracer=self.obs.tracer
         ) as pipe:
@@ -662,7 +662,7 @@ class PPOTrainer(TPUBaseTrainer):
                 pipe.submit(work)
             pipe_stats = pipe.stats
         stats["throughput/rollout_overlap_frac"] = pipe_stats.overlap_frac(
-            time() - t0
+            perf_counter() - t0
         )
 
     # ------------------------------------------------------------------
@@ -807,7 +807,7 @@ class PPOTrainer(TPUBaseTrainer):
             state["finalized_rows"] += int(chunk["prompt_ids"].shape[0])
             self._rollout_chunk_finalize(chunk, elements, stats, acc)
 
-        t0 = time()
+        t0 = perf_counter()
         with ExitStack() as ctx:
             pipe = None
             if depth > 0:
@@ -857,7 +857,7 @@ class PPOTrainer(TPUBaseTrainer):
                     submit_group(group)
             if pipe is not None:
                 stats["throughput/rollout_overlap_frac"] = pipe.stats.overlap_frac(
-                    time() - t0
+                    perf_counter() - t0
                 )
             else:
                 stats["throughput/rollout_overlap_frac"] = 0.0
@@ -1103,7 +1103,7 @@ class PPOTrainer(TPUBaseTrainer):
             "gen_tokens": 0, "chunks": 0,
             "slot_steps": 0, "live_slot_steps": 0,
         }
-        exp_time = time()
+        exp_time = perf_counter()
 
         if bool(self.config.async_rl.enabled):
             # the actor/learner split (docs/ASYNC_RL.md): actors generate —
@@ -1122,7 +1122,7 @@ class PPOTrainer(TPUBaseTrainer):
         self.mean_kl = acc["kl_sum"] / max(acc["kl_batches"], 1)
         stats["kl_ctl_value"] = self.kl_ctl.value
         stats["time/rollout_host"] = acc["host_s"]
-        total = time() - exp_time
+        total = perf_counter() - exp_time
         stats["time/exp"] = total
         # whole-collection aggregates with identical definitions in BOTH
         # modes (wall per chunk; generated tokens ÷ collection wall time) —

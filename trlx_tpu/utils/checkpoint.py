@@ -120,11 +120,13 @@ def is_committed(directory: str) -> bool:
 
 def _checkpoint_step_dirs(root: str) -> List[Tuple[int, str]]:
     """``(step, path)`` for every ``checkpoint_<int>`` dir under ``root``,
-    numerically sorted (zero-padding width varies with total_steps)."""
+    numerically sorted (zero-padding width varies with total_steps). The
+    directory scan itself is sorted too: the output order never depends on
+    filesystem enumeration, even transiently (GL903)."""
     if not os.path.isdir(root):
         return []
     out = []
-    for name in os.listdir(root):
+    for name in sorted(os.listdir(root)):
         if not name.startswith("checkpoint_"):
             continue
         try:
@@ -162,7 +164,7 @@ def prune_checkpoints(root: str, keep_last_n: int) -> List[str]:
     return pruned
 
 
-def save_state(
+def save_state(  # acquires: ckpt-staging(object)
     directory: str, state: Any, extra: Optional[Dict] = None, async_save: bool = True
 ) -> None:
     """Save a train-state pytree (+ small JSON ``extra``) to ``directory``
@@ -206,7 +208,7 @@ def save_state(
         with open(manifest_path + ".staging", "w") as f:
             json.dump(manifest, f)
 
-    def commit() -> None:
+    def commit() -> None:  # releases: ckpt-staging(object)
         from trlx_tpu.resilience.faults import InjectedFault, poll_fault
 
         # every process polls (identical plans keep counters in lockstep),
