@@ -573,12 +573,17 @@ def main():
     # BENCH_ENGINE=1: continuous batching over the paged-KV engine with the
     # prefix cache (docs/PERFORMANCE.md engine section) — the headline then
     # carries prefix_hit_rate and kv_blocks_in_use; the dedicated A/B lives
-    # in `python -m trlx_tpu.benchmark engine-paged`
+    # in `python -m trlx_tpu.benchmark engine-paged`. BENCH_DECODE_KERNEL
+    # selects the paged decode compute (xla | pallas — the in-place
+    # paged-attention kernel, docs/PERFORMANCE.md "Pallas kernels").
     bench_engine = os.environ.get("BENCH_ENGINE", "0") == "1"
     if bench_engine:
         config = config.evolve(
             train=dict(continuous_batching=True),
-            engine=dict(backend="paged", prefix_cache=True),
+            engine=dict(
+                backend="paged", prefix_cache=True,
+                decode_kernel=os.environ.get("BENCH_DECODE_KERNEL", "xla"),
+            ),
         )
 
     # BENCH_ASYNC=1: route experience collection through the disaggregated

@@ -147,9 +147,12 @@ def main():
     t0 = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     alternating = run_arm("alt", asynchronous=False)
     asynchronous = run_arm("async", asynchronous=True)
+    from trlx_tpu.benchmark import provenance
+
     artifact = {
         "benchmark": "async_rl_vs_alternating (PPO, gpt2-test, CPU)",
         "timestamp": t0,
+        "provenance": provenance(),
         "workload": {
             "model": "builtin:gpt2-test",
             "num_rollouts": NUM_ROLLOUTS,

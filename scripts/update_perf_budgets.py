@@ -59,6 +59,9 @@ def main() -> None:
             )
     payload = {
         "backend": jax.default_backend(),
+        "device_kind": getattr(
+            jax.devices()[0], "device_kind", str(jax.devices()[0])
+        ),
         "jax_version": jax.__version__,
         "note": "XLA compiled-program budgets; regenerate with scripts/update_perf_budgets.py",
         "budgets": budgets,

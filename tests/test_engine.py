@@ -427,6 +427,51 @@ def test_prefix_without_paged_rejected_at_construction(tmp_path):
         )
 
 
+def test_decode_kernel_without_paged_rejected_at_construction(tmp_path):
+    """engine.decode_kernel: pallas is the in-place *paged* decode kernel —
+    selecting it on the dense backend is a config error at construction."""
+    with pytest.raises(ValueError, match="engine.backend: paged"):
+        _ppo_trainer(
+            tmp_path, "badk", continuous=True,
+            engine_overrides=dict(decode_kernel="pallas"),
+        )
+    with pytest.raises(ValueError, match="decode_kernel"):
+        _ppo_trainer(
+            tmp_path, "badk2", continuous=True,
+            engine_overrides=dict(backend="paged", decode_kernel="cuda"),
+        )
+
+
+def test_ppo_paged_kernel_engine_store_matches_serial(tmp_path):
+    """engine.decode_kernel: pallas threaded through the trainer's config
+    path: PPO collection over the in-place kernel decode fills the store
+    with the same sequences / logprobs / values / rewards as the serial
+    dense path, and the engine gauges record which compute ran."""
+    serial = _ppo_trainer(tmp_path, "serial_k", continuous=False)
+    kernel = _ppo_trainer(
+        tmp_path, "kernel", continuous=True,
+        engine_overrides=dict(
+            backend="paged", kv_block_size=4, prefix_cache=True,
+            decode_kernel="pallas",
+        ),
+    )
+    serial.make_experience(16)
+    kernel.make_experience(16)
+    assert len(serial.store) == len(kernel.store) == 16
+    a, b = _canonical(serial.store), _canonical(kernel.store)
+    assert set(a) == set(b)
+    for key in a:
+        for field in ("logprobs", "values", "rewards"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a[key], field)),
+                np.asarray(getattr(b[key], field)),
+                err_msg=field,
+            )
+    stats = kernel.make_experience_stats
+    assert stats["engine/decode_kernel_pallas"] == 1.0
+    assert stats["engine/kv_blocks_in_use"] > 0
+
+
 def test_ppo_paged_engine_store_matches_serial(tmp_path):
     """Acceptance: PPO rollout collection through the paged engine (with
     the prefix cache on) fills the store with the same sequences /

@@ -1,0 +1,439 @@
+"""In-place paged-attention decode + fused sampling kernels
+(``trlx_tpu/ops/paged_attention.py``; docs/PERFORMANCE.md "Pallas kernels").
+
+The load-bearing contract is **bitwise equality with the gather path** in
+interpret mode on CPU: the kernel reads K/V through the block table in
+place, and must reproduce — to the bit — what gathering the pool into a
+dense view and running the dense einsum attention produces, across block
+sizes (including 1 and sizes that do not divide the prompt width), GQA
+ratios, out-of-range (poisoned/padding) table ids, and recycled blocks
+holding stale values. The fused sampling kernel must reproduce
+``process_logits`` → ``jax.random.categorical`` → ``log_softmax`` gather
+to the bit across temperature/top-k/top-p settings. On top of the unit
+contracts, an engine-level suite drives the whole kernel decode path
+(refills, freezes, recycling) against plain ``generate``
+(``tests/test_engine.py`` holds the trainer-integration twin).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.data.configs import ModelConfig
+from trlx_tpu.engine.core import ContinuousEngine
+from trlx_tpu.models.builder import build_causal_lm
+from trlx_tpu.models.transformer import make_kv_cache
+from trlx_tpu.ops.paged_attention import (
+    fused_sample,
+    paged_attention_decode,
+    paged_attention_decode_reference,
+    sample_token_fused,
+)
+from trlx_tpu.ops.paged_kv import PagedSpec, num_table_blocks
+from trlx_tpu.ops.sampling import (
+    GenerationConfig,
+    generate,
+    per_row_keys,
+    sample_token_from_logits,
+)
+from trlx_tpu.ops.slot_refill import make_slot_refill_fns
+
+# ---------------------------------------------------------------------------
+# kernel unit parity: random geometry sweep
+# ---------------------------------------------------------------------------
+
+# (B, H, KV, D, block_size, S): block sizes 1/3/4/8/16, S not divisible by
+# the block size in most rows, GQA ratios 1/2/3/4, multi-block tables
+_GEOMETRIES = [
+    (4, 4, 4, 32, 8, 19),
+    (3, 4, 2, 16, 3, 10),
+    (2, 8, 8, 32, 1, 7),
+    (5, 4, 4, 32, 4, 24),
+    (2, 2, 1, 64, 8, 33),
+    (1, 12, 4, 64, 16, 128),
+    (6, 6, 3, 48, 4, 21),
+]
+
+
+class TestPagedDecodeKernelParity:
+    @pytest.mark.parametrize("per_head_bias", [False, True])
+    @pytest.mark.parametrize("geometry", _GEOMETRIES)
+    def test_bitwise_vs_gather_reference(self, geometry, per_head_bias):
+        """Random pools/tables/masks: the in-place kernel equals the
+        gather-then-dense reference bit for bit. Tables deliberately
+        include out-of-range ids (poisoned/padding lanes clamp; their
+        columns are bias-masked) and every pool row holds random 'stale'
+        values — masked stale values must contribute exactly 0.0.
+        ``per_head_bias`` exercises the ALiBi-shaped [B, H, S] bias (each
+        head carries its own additive slopes, like
+        ``CausalTransformer._attention_bias`` under ``alibi``)."""
+        B, H, KV, D, bs, S = geometry
+        rs = np.random.RandomState(hash(geometry) % (2**31))
+        TB = num_table_blocks(S, bs)
+        NB = 1 + B * TB + 3
+        q = jnp.asarray(rs.randn(B, H, D).astype(np.float32))
+        k_pool = jnp.asarray(rs.randn(NB, bs, KV, D).astype(np.float32))
+        v_pool = jnp.asarray(rs.randn(NB, bs, KV, D).astype(np.float32))
+        # ids beyond the pool exercise the clamp path
+        table = jnp.asarray(rs.randint(0, NB + 2, (B, TB)).astype(np.int32))
+        visible = rs.rand(B, S) > 0.3
+        visible[:, 0] = True  # at least one visible key per row
+        mask_bias = np.where(visible, 0.0, -1e9)[:, None, :]  # [B, 1, S]
+        if per_head_bias:
+            slopes = 0.5 ** (1 + np.arange(H))
+            dist = -np.abs(S - 1 - np.arange(S))
+            alibi = np.where(
+                visible[:, None, :],
+                slopes[None, :, None] * dist[None, None, :],
+                0.0,
+            )
+            bias = jnp.asarray((mask_bias + alibi).astype(np.float32))
+        else:
+            bias = jnp.asarray(mask_bias.astype(np.float32))
+        out_kernel = jax.jit(paged_attention_decode)(
+            q, k_pool, v_pool, table, bias
+        )
+        out_ref = jax.jit(paged_attention_decode_reference)(
+            q, k_pool, v_pool, table, bias
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_kernel), np.asarray(out_ref)
+        )
+
+    def test_masked_stale_blocks_contribute_zero(self):
+        """Blowing up the masked positions' values (recycled-block stale
+        garbage) must not change a single output bit — the -1e9 bias
+        underflows their softmax weight to exactly 0.0."""
+        B, H, KV, D, bs, S = 2, 4, 4, 32, 4, 11
+        rs = np.random.RandomState(7)
+        TB = num_table_blocks(S, bs)
+        NB = 1 + B * TB
+        q = jnp.asarray(rs.randn(B, H, D).astype(np.float32))
+        k_np = rs.randn(NB, bs, KV, D).astype(np.float32)
+        v_np = rs.randn(NB, bs, KV, D).astype(np.float32)
+        table = jnp.asarray(
+            (1 + np.arange(B * TB).reshape(B, TB)).astype(np.int32)
+        )
+        visible = rs.rand(B, S) > 0.4
+        visible[:, 0] = True
+        bias = jnp.asarray(
+            np.where(visible, 0.0, -1e9)[:, None, :].astype(np.float32)
+        )
+        base = paged_attention_decode(
+            q, jnp.asarray(k_np), jnp.asarray(v_np), table, bias
+        )
+        # poison every masked column's K/V with huge stale values
+        k_big, v_big = k_np.copy(), v_np.copy()
+        for b in range(B):
+            for s in range(S):
+                if not visible[b, s]:
+                    blk, off = table[b, s // bs], s % bs
+                    k_big[blk, off] = 1e4
+                    v_big[blk, off] = -1e4
+        poisoned = paged_attention_decode(
+            q, jnp.asarray(k_big), jnp.asarray(v_big), table, bias
+        )
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(poisoned))
+
+
+# ---------------------------------------------------------------------------
+# fused sampling parity
+# ---------------------------------------------------------------------------
+
+
+class TestFusedSamplingParity:
+    @pytest.mark.parametrize(
+        "temperature,top_k,top_p,do_sample",
+        [
+            (1.0, 0, 1.0, True),  # pure categorical (the engine default)
+            (0.7, 5, 1.0, True),  # temperature + top-k
+            (1.0, 0, 0.9, True),  # top-p alone
+            (1.3, 12, 0.8, True),  # all three filters composed
+            (1.0, 3, 0.95, False),  # greedy argmax over the filtered row
+        ],
+    )
+    def test_bitwise_vs_xla_sampler(self, temperature, top_k, top_p, do_sample):
+        """The fused kernel reproduces sample_token_from_logits bit for
+        bit: same token ids, same behavior logprobs — including the
+        min_new_tokens eos blocking and per-row key chains."""
+        B, V = 6, 259
+        rs = np.random.RandomState(top_k * 17 + int(top_p * 100))
+        logits = jnp.asarray((rs.randn(B, V) * 3).astype(np.float32))
+        keys = per_row_keys(jax.random.PRNGKey(int(temperature * 10)), B)
+        config = GenerationConfig(
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            do_sample=do_sample, eos_token_id=3, pad_token_id=258,
+            min_new_tokens=2, per_row_rng=True,
+        )
+        step = jnp.asarray(rs.randint(0, 5, (B,)).astype(np.int32))
+        step_out = {}
+        ref_tok, ref_lp = jax.jit(
+            lambda l, k, s: sample_token_from_logits(
+                l, step_out, k, config, s, None
+            )
+        )(logits, keys, step)
+        fus_tok, fus_lp = jax.jit(
+            lambda l, k, s: sample_token_fused(
+                l, step_out, k, config, s, None
+            )
+        )(logits, keys, step)
+        np.testing.assert_array_equal(np.asarray(ref_tok), np.asarray(fus_tok))
+        np.testing.assert_array_equal(np.asarray(ref_lp), np.asarray(fus_lp))
+
+    def test_adjust_hook_composes(self):
+        """The adjust-logits hook (ILQL reshaping, logit masks) runs in the
+        prologue — fused and XLA samplers see identical post-hook logits."""
+        B, V = 4, 64
+        rs = np.random.RandomState(11)
+        logits = jnp.asarray(rs.randn(B, V).astype(np.float32))
+        keys = per_row_keys(jax.random.PRNGKey(5), B)
+        config = GenerationConfig(
+            do_sample=True, top_k=7, eos_token_id=2, pad_token_id=0,
+            per_row_rng=True,
+        )
+        step = jnp.zeros((B,), jnp.int32)
+        boost = lambda so, lg: lg.at[..., 9].add(3.0)  # noqa: E731
+        ref = sample_token_from_logits(logits, {}, keys, config, step, boost)
+        fus = sample_token_fused(logits, {}, keys, config, step, boost)
+        np.testing.assert_array_equal(np.asarray(ref[0]), np.asarray(fus[0]))
+        np.testing.assert_array_equal(np.asarray(ref[1]), np.asarray(fus[1]))
+
+    def test_gumbel_is_the_categorical_draw(self):
+        """The external-noise contract: argmax(gumbel + logits) with our
+        vmapped gumbel equals vmapped jax.random.categorical — if a jax
+        upgrade changes categorical's internals, this canary fails before
+        the parity suite does."""
+        B, V = 8, 101
+        rs = np.random.RandomState(3)
+        logits = jnp.asarray(rs.randn(B, V).astype(np.float32))
+        keys = per_row_keys(jax.random.PRNGKey(1), B)
+        want = jax.vmap(lambda k, row: jax.random.categorical(k, row))(
+            keys, logits
+        )
+        gumbel = jax.vmap(lambda k: jax.random.gumbel(k, (V,), jnp.float32))(
+            keys
+        )
+        got, _ = fused_sample(
+            logits, gumbel, temperature=1.0, top_k=0, top_p=1.0
+        )
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+# ---------------------------------------------------------------------------
+# engine-level parity: the whole kernel decode path vs plain generate
+# ---------------------------------------------------------------------------
+
+_EOS = 3
+_PAD = 258
+_B, _P, _N = 4, 10, 9  # P deliberately not divisible by block sizes 3, 4, 8
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    module, params, tcfg = build_causal_lm(
+        ModelConfig(model_path="builtin:gpt2-test"), head="value"
+    )
+
+    def apply_fn(p, ids, **kw):
+        return module.apply({"params": p}, ids, **kw)
+
+    return apply_fn, params, tcfg
+
+
+def _eos_boost(step_out, logits):
+    return logits.at[..., _EOS].add(4.0)
+
+
+def _gen_config(**kw):
+    base = dict(
+        max_new_tokens=_N, eos_token_id=_EOS, pad_token_id=_PAD,
+        min_new_tokens=2, per_row_rng=True,
+    )
+    base.update(kw)
+    return GenerationConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def reference(tiny_lm):
+    """Plain-generate ground truth + per-row keys for a left-padded,
+    heterogeneous-length prompt set (same recipe as tests/test_engine.py)."""
+    apply_fn, params, tcfg = tiny_lm
+    config = _gen_config()
+    rs = np.random.RandomState(1)
+    n = 10
+    prompts = rs.randint(0, 200, (n, _P)).astype(np.int32)
+    masks = np.ones_like(prompts)
+    for i in range(n):  # vary left padding across rows
+        pad = i % 3
+        prompts[i, :pad] = _PAD
+        masks[i, :pad] = 0
+    gen = jax.jit(
+        lambda p, ids, m, r: generate(
+            apply_fn, p, lambda b, s: make_kv_cache(tcfg, b, s),
+            ids, m, r, config, adjust_logits=_eos_boost,
+        )
+    )
+    rng = jax.random.PRNGKey(0)
+    ref, keys = {}, {}
+    for c0 in range(0, n, _B):
+        batch, bm = prompts[c0 : c0 + _B], masks[c0 : c0 + _B]
+        if batch.shape[0] < _B:
+            extra = _B - batch.shape[0]
+            batch = np.concatenate([batch, np.tile(batch[-1:], (extra, 1))])
+            bm = np.concatenate([bm, np.tile(bm[-1:], (extra, 1))])
+        rng, call = jax.random.split(rng)
+        out = gen(params, jnp.asarray(batch), jnp.asarray(bm), call)
+        ks = np.asarray(per_row_keys(call, _B))
+        for i in range(min(_B, n - c0)):
+            ref[c0 + i] = {
+                "tokens": np.asarray(out.response_tokens[i]),
+                "logprobs": np.asarray(out.response_logprobs[i]),
+                "values": np.asarray(out.response_values[i]),
+                "mask": np.asarray(out.response_mask[i]),
+            }
+            keys[c0 + i] = ks[i]
+    lens = {int(r["mask"].sum()) for r in ref.values()}
+    assert len(lens) > 1, "workload must be heterogeneous to exercise refill"
+    return prompts, masks, ref, keys
+
+
+def _kernel_engine(tiny_lm, block_size, max_blocks=None, prefix=False):
+    apply_fn, params, tcfg = tiny_lm
+    TB = num_table_blocks(_P + _N, block_size)
+    spec = PagedSpec(
+        block_size=block_size, max_blocks=max_blocks or (1 + 2 * _B * TB)
+    )
+    fns = make_slot_refill_fns(
+        apply_fn, lambda b, s: make_kv_cache(tcfg, b, s), _B, _P,
+        _gen_config(), adjust_logits=_eos_boost, segment_len=3,
+        params_example=params, paged=spec, decode_kernel="pallas",
+    )
+    return ContinuousEngine(fns, params, _PAD, prefix_cache=prefix)
+
+
+def _drain(engine, prompts, masks, keys, waves=1):
+    n = prompts.shape[0]
+    got = {}
+    for _ in range(waves):
+        engine.enqueue_prompts(
+            prompts, masks, np.stack([keys[j] for j in range(n)])
+        )
+        while engine.busy:
+            for c in engine.step():
+                got[c.index % n] = {
+                    "tokens": c.tokens, "logprobs": c.logprobs,
+                    "values": c.values, "mask": c.mask,
+                }
+    return got
+
+
+def _assert_matches(ref, got):
+    assert set(got) == set(ref)
+    for j in ref:
+        for field in ("tokens", "mask", "logprobs", "values"):
+            np.testing.assert_array_equal(
+                ref[j][field], got[j][field], err_msg=f"prompt {j} {field}"
+            )
+
+
+class TestKernelEngineBitEquivalence:
+    @pytest.mark.parametrize("block_size", [1, 3, 4, 8])
+    def test_kernel_decode_matches_plain_generate(
+        self, tiny_lm, reference, block_size
+    ):
+        """The whole kernel decode path — in-place writes through the
+        table, per-step freeze poisoning, refills into recycled blocks —
+        reproduces plain generate bit-for-bit across block sizes
+        (including 1, and sizes that do not divide P=10)."""
+        prompts, masks, ref, keys = reference
+        engine = _kernel_engine(tiny_lm, block_size)
+        got = _drain(engine, prompts, masks, keys)
+        _assert_matches(ref, got)
+        assert engine.stats.refill_prefills > 1  # refills actually happened
+        assert engine.stats.decode_kernel_pallas
+        assert engine.stats.metrics()["engine/decode_kernel_pallas"] == 1.0
+
+    def test_recycled_stale_blocks_second_wave(self, tiny_lm, reference):
+        """A tight pool + a second wave forces wave-2 rows into blocks
+        wave-1 rows wrote and freed — stale K/V at slot-masked positions
+        must not perturb a bit (the -1e9 underflow contract, now exercised
+        through the in-place kernel instead of the gathered view)."""
+        prompts, masks, ref, keys = reference
+        TB = num_table_blocks(_P + _N, 4)
+        engine = _kernel_engine(tiny_lm, 4, max_blocks=1 + _B * TB + 2)
+        got = _drain(engine, prompts, masks, keys, waves=2)
+        _assert_matches(ref, got)
+
+    def test_prefix_hits_then_kernel_decode(self, tiny_lm, reference):
+        """Prefix-cache hits (gather-path suffix prefill) hand shared
+        blocks to the kernel decode — a warm second wave stays
+        bit-identical and actually takes hits."""
+        prompts, masks, ref, keys = reference
+        TB = num_table_blocks(_P + _N, 4)
+        engine = _kernel_engine(
+            tiny_lm, 4, max_blocks=1 + 3 * _B * TB * 2, prefix=True
+        )
+        got = _drain(engine, prompts, masks, keys, waves=2)
+        _assert_matches(ref, got)
+        assert engine.stats.prefix_tokens_saved > 0
+
+
+def test_kernel_engine_alibi_matches_plain_generate():
+    """ALiBi models carry PER-HEAD additive bias rows ([B, H, T, S] from
+    ``_attention_bias``): the kernel path must thread the full head dim
+    through to the kernel — collapsing it to head 0's slopes would
+    silently diverge. Pins kernel engine ≡ plain generate on a bloom-style
+    (alibi) model, left-padded prompts included."""
+    module, params, tcfg = build_causal_lm(
+        ModelConfig(
+            model_path="builtin:gpt2-test",
+            model_extra_kwargs=dict(position_scheme="alibi"),
+        ),
+        head="value",
+    )
+
+    def apply_fn(p, ids, **kw):
+        return module.apply({"params": p}, ids, **kw)
+
+    config = _gen_config()
+    rs = np.random.RandomState(5)
+    prompts = rs.randint(0, 200, (_B, _P)).astype(np.int32)
+    masks = np.ones_like(prompts)
+    prompts[0, :2] = _PAD
+    masks[0, :2] = 0
+    rng = jax.random.PRNGKey(9)
+    out = jax.jit(
+        lambda p, ids, m, r: generate(
+            apply_fn, p, lambda b, s: make_kv_cache(tcfg, b, s),
+            ids, m, r, config, adjust_logits=_eos_boost,
+        )
+    )(params, jnp.asarray(prompts), jnp.asarray(masks), rng)
+    keys = {i: k for i, k in enumerate(np.asarray(per_row_keys(rng, _B)))}
+    ref = {
+        i: {
+            "tokens": np.asarray(out.response_tokens[i]),
+            "logprobs": np.asarray(out.response_logprobs[i]),
+            "values": np.asarray(out.response_values[i]),
+            "mask": np.asarray(out.response_mask[i]),
+        }
+        for i in range(_B)
+    }
+    engine = _kernel_engine((apply_fn, params, tcfg), 4)
+    got = _drain(engine, prompts, masks, keys)
+    _assert_matches(ref, got)
+
+
+def test_kernel_requires_paged_backend(tiny_lm):
+    apply_fn, params, tcfg = tiny_lm
+    with pytest.raises(ValueError, match="paged"):
+        make_slot_refill_fns(
+            apply_fn, lambda b, s: make_kv_cache(tcfg, b, s), _B, _P,
+            _gen_config(), params_example=params, paged=None,
+            decode_kernel="pallas",
+        )
+    with pytest.raises(ValueError, match="decode_kernel"):
+        make_slot_refill_fns(
+            apply_fn, lambda b, s: make_kv_cache(tcfg, b, s), _B, _P,
+            _gen_config(), params_example=params, decode_kernel="cuda",
+        )

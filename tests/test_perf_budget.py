@@ -72,6 +72,17 @@ def test_budget_gpt2_test_paged():
 
 
 @pytest.mark.slow
+def test_budget_gpt2_test_paged_kernel():
+    """The in-place kernel decode path (paged_refill + paged_decode_kernel,
+    ops/paged_attention.py, engine.decode_kernel: pallas): pins the
+    program that contains NO per-segment dense-view gather/scatter — a
+    change that reintroduces a pool-sized temporary shows up as a
+    byte/temp jump. CPU-backend numbers lower the kernel through the
+    Pallas interpreter (deterministic for the pinned toolchain)."""
+    _assert_within_budget("gpt2_test_paged_kernel")
+
+
+@pytest.mark.slow
 def test_budget_ilql_gpt2_test():
     """ILQL's programs: twin-Q/CQL train step + the advantage-reshaping
     sampler (a different generate program than PPO's)."""

@@ -102,6 +102,10 @@ ENGINE_KEYS = frozenset({
     "engine/prefix_tokens_saved",
     "engine/queue_wait_s",
     "memory/kv_cache_bytes",
+    # paged decode compute path gauge (0/1): engine.decode_kernel — the
+    # in-place Pallas kernel (ops/paged_attention.py) vs the
+    # gather/scatter reference (docs/PERFORMANCE.md "Pallas kernels")
+    "engine/decode_kernel_pallas",
 })
 
 # Canonical cross-rank telemetry gauges (observability/distributed.py,

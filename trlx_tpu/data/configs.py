@@ -302,6 +302,16 @@ class EngineConfig:
         breaks the suffix-prefill bit-equality the cache relies on.
     :param prefix_cache_blocks: entry cap for the prefix cache (0 = only
         pool pressure evicts).
+    :param decode_kernel: compute path for the paged decode segments.
+        ``"xla"`` (default) is the gather → dense compute → scatter
+        reference; ``"pallas"`` runs the in-place Pallas paged-attention
+        decode kernel + fused top-k/top-p/temperature sampling
+        (``ops/paged_attention.py``) — K/V read and written through the
+        block table with no transient dense view, deleting the
+        per-segment gather tax (docs/PERFORMANCE.md "Pallas kernels").
+        Bit-identical outputs by contract (``tests/test_paged_attention
+        .py``); off-TPU the kernels run under the Pallas interpreter.
+        Requires ``backend: paged``.
     """
 
     backend: str = "dense"
@@ -309,6 +319,7 @@ class EngineConfig:
     max_kv_blocks: int = 0
     prefix_cache: bool = False
     prefix_cache_blocks: int = 0
+    decode_kernel: str = "xla"
 
     from_dict = classmethod(_strict_from_dict)
 

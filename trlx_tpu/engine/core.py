@@ -113,6 +113,9 @@ class EngineStats:
     kv_blocks_total: int = 0  # 0 = dense backend
     kv_blocks_in_use: int = 0  # high-water blocks simultaneously held
     kv_bytes_high_water: int = 0  # blocks_in_use × per-block bytes (paged)
+    # paged decode compute path: True = in-place Pallas kernel decode
+    # (engine.decode_kernel: pallas), False = the gather/scatter reference
+    decode_kernel_pallas: bool = False
     # prefix cache
     prefix_enabled: bool = False
     prefix_lookup_blocks: int = 0
@@ -154,6 +157,12 @@ class EngineStats:
             stats["engine/kv_blocks_in_use"] = float(self.kv_blocks_in_use)
             stats["engine/block_pool_occupancy"] = self.kv_blocks_in_use / max(
                 self.kv_blocks_total, 1
+            )
+            # which decode compute the segments ran — an A/B artifact (or a
+            # dashboard) can tell kernel from gather runs without config
+            # archaeology
+            stats["engine/decode_kernel_pallas"] = float(
+                self.decode_kernel_pallas
             )
         if self.prefix_enabled:
             stats["engine/prefix_hit_rate"] = self.prefix_hit_rate
@@ -341,6 +350,9 @@ class ContinuousEngine(Engine):
             # upper bound on each slot's decode step (segments survived)
             self._steps_bound = [0] * self.B
             self.stats.kv_blocks_total = self.spec.max_blocks - 1
+            self.stats.decode_kernel_pallas = (
+                getattr(fns, "decode_kernel", "xla") == "pallas"
+            )
             self._block_bytes = block_bytes(self.state.cache)
         elif prefix_cache:
             raise ValueError(
@@ -398,10 +410,12 @@ class ContinuousEngine(Engine):
         kv_cache_bytes = self.stats.kv_cache_bytes
         prefix_enabled = self.stats.prefix_enabled
         kv_blocks_total = self.stats.kv_blocks_total
+        decode_kernel_pallas = self.stats.decode_kernel_pallas
         self.stats = EngineStats(
             kv_cache_bytes=kv_cache_bytes,
             prefix_enabled=prefix_enabled,
             kv_blocks_total=kv_blocks_total,
+            decode_kernel_pallas=decode_kernel_pallas,
         )
         if self.allocator is not None:
             # per-collection high-water, not lifetime

@@ -569,6 +569,47 @@ class Attention(nn.Module):
             q = apply_rotary(q, sin, cos, rdim, neox)
             k = apply_rotary(k, sin, cos, rdim, neox)
 
+        paged = cache is not None and isinstance(cache, dict) and "block_table" in cache
+        if paged:
+            # in-place paged decode (ops/paged_attention.py): K/V live in
+            # the block pool ({"k","v"} over [NB, bs, KV, D]) and this
+            # step's k/v commit straight through the per-row block table —
+            # no gathered dense view exists, before or after. Drop-mode
+            # writes make poisoned (out-of-range) table rows — frozen slots,
+            # padding lanes — write nothing, mirroring scatter_steps'
+            # live-writes-only commit on the gather path.
+            if T != 1:
+                raise ValueError(
+                    "paged in-place attention is a single-token decode "
+                    f"path (got T={T}); prefill goes through the gather "
+                    "path (ops/slot_refill.py)"
+                )
+            table = cache["block_table"]
+            ci = jnp.asarray(cache_index)
+            if ci.ndim == 0:
+                ci = jnp.broadcast_to(ci, (B,))
+            blk_size = cache["k"].shape[-3]
+            blk = jnp.take_along_axis(table, (ci // blk_size)[:, None], axis=1)[:, 0]
+            off = ci % blk_size
+            k_pool = cache["k"].at[blk, off].set(
+                k[:, 0].astype(cache["k"].dtype), mode="drop"
+            )
+            v_pool = cache["v"].at[blk, off].set(
+                v[:, 0].astype(cache["v"].dtype), mode="drop"
+            )
+            new_cache = {"k": k_pool, "v": v_pool, "block_table": table}
+            from trlx_tpu.ops.paged_attention import paged_attention_decode
+
+            # the additive bias rows carry the full masking semantics
+            # (slot-causal + key validity + window/ALiBi) — identical to
+            # what the dense einsum path would consume. The head dim is 1
+            # (mask-only) or H (per-head ALiBi slopes) and is preserved.
+            out = paged_attention_decode(
+                q[:, 0], k_pool, v_pool, table, attention_bias[:, :, 0, :]
+            ).reshape(B, 1, H * D)
+            out = _dense(cfg, cfg.hidden_size, cfg.attn_bias, ("joined_kv", "embed"), "o_proj")(out)
+            return out, new_cache
+
         new_cache = None
         if cache is not None:
             # decode: write this step's k/v into the cache at cache_index —

@@ -32,22 +32,13 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # pallas TPU backend is unavailable on some CPU-only builds
-    from jax.experimental.pallas import tpu as pltpu
-
-    _HAS_PLTPU = True
-except ImportError:  # pragma: no cover
-    pltpu = None
-    _HAS_PLTPU = False
-
-NEG_INF = -1e30
-# lane width for per-row stats (lse/delta); 8 is the f32 sublane minimum and
-# the "equal to the overall array dim" rule makes the last dim legal
-LANES = 8
-
-
-def _default_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from trlx_tpu.ops.pallas_utils import (  # noqa: F401  (NEG_INF/LANES re-export)
+    LANES,
+    NEG_INF,
+    default_interpret as _default_interpret,
+    pad_to as _pad_to,
+    smem_spec as _smem_spec,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -271,22 +262,6 @@ def _bwd_fused_kernel(
 # ---------------------------------------------------------------------------
 # host-side wrappers
 # ---------------------------------------------------------------------------
-
-
-def _pad_to(x: jax.Array, mult: int, axis: int) -> jax.Array:
-    size = x.shape[axis]
-    pad = (-size) % mult
-    if pad == 0:
-        return x
-    widths = [(0, 0)] * x.ndim
-    widths[axis] = (0, pad)
-    return jnp.pad(x, widths)
-
-
-def _smem_spec():
-    if _HAS_PLTPU:
-        return pl.BlockSpec(memory_space=pltpu.SMEM)
-    return pl.BlockSpec(memory_space=pl.ANY)
 
 
 @functools.partial(

@@ -28,10 +28,12 @@ dense slot-refill decode, which is bit-identical to plain ``generate``
 under per-row RNG.
 
 The dense view is a per-program *temporary* (alive only inside one XLA
-program); the pool + table are the persistent state. A Pallas
+program); the pool + table are the persistent state. The Pallas
 paged-attention decode kernel that reads blocks in place — removing the
-transient view — is ROADMAP item 3; this module fixes the memory layout
-and the semantics it must reproduce.
+transient view from the decode inner loop — lives in
+``ops/paged_attention.py`` (selected by ``engine.decode_kernel: pallas``);
+the gather path here stays as the bit-equivalence reference it must
+reproduce, and remains the only prefill path.
 
 Pool layout reuses the model cache structure verbatim:
 ``init_cache_fn(max_blocks, block_size)`` — the block axis rides the cache's
@@ -56,6 +58,8 @@ __all__ = [
     "gather_view",
     "scatter_span",
     "scatter_steps",
+    "attach_block_table",
+    "detach_block_table",
     "kv_bytes",
     "block_bytes",
     "dense_kv_bytes",
@@ -207,6 +211,46 @@ def scatter_steps(
     return jax.tree_util.tree_map(
         leaf_scatter, pool, dense_view, is_leaf=lambda x: x is None
     )
+
+
+def attach_block_table(pool: Any, block_table: jax.Array) -> Any:
+    """Per-layer model-cache views of the pool that CARRY the block table —
+    the cache pytree the kernel decode path feeds ``apply_fn``. The model's
+    attention (``models/transformer.py::Attention``) recognises the
+    ``"block_table"`` leaf and reads/writes K/V through the table in place
+    (``ops/paged_attention.py``) instead of expecting a dense view.
+
+    Rows whose table entries are out of range (``>= max_blocks`` — frozen
+    slots the decode loop poisons, bucket-padding refill rows) write
+    nothing (drop-mode) and read clamped garbage their callers discard.
+    """
+    if isinstance(pool, list):  # per-layer [{"k", "v"}, ...]
+        return [
+            None if layer is None else {**layer, "block_table": block_table}
+            for layer in pool
+        ]
+    # scanned layout {"k": [L, NB, bs, KV, D], ...}: nn.scan slices every
+    # cache leaf along the layer axis, so the (tiny, int32) table is tiled
+    L = pool["k"].shape[0]
+    return {
+        **pool,
+        "block_table": jnp.broadcast_to(
+            block_table[None], (L,) + block_table.shape
+        ),
+    }
+
+
+def detach_block_table(cache: Any) -> Any:
+    """Inverse of :func:`attach_block_table`: strip the table leaves, give
+    back the bare pool pytree (what ``PagedKV.pool`` persists)."""
+    if isinstance(cache, list):
+        return [
+            None
+            if layer is None
+            else {k: v for k, v in layer.items() if k != "block_table"}
+            for layer in cache
+        ]
+    return {k: v for k, v in cache.items() if k != "block_table"}
 
 
 def kv_bytes(cache: Any) -> int:

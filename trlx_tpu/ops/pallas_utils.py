@@ -1,0 +1,88 @@
+"""Shared Pallas TPU plumbing for the repo's kernels.
+
+Every Pallas kernel module (``ops/flash_attention.py``,
+``ops/paged_attention.py``) needs the same three decisions made the same
+way, so they live here once:
+
+- **Backend probe**: ``jax.experimental.pallas.tpu`` (Mosaic) is absent on
+  some CPU-only builds; kernels must import it guardedly and degrade to
+  generic Pallas (``pl.ANY`` memory spaces) when it is missing.
+- **Interpret-mode default**: off-TPU, kernels run under the Pallas
+  interpreter — the same kernel body executed as traced jax ops, which is
+  what makes the CPU tier-1 bit-parity tests meaningful (interpret-mode
+  ops are ordinary XLA ops on the same values).
+- **SMEM spec**: scalar operands live in SMEM on hardware; interpret mode
+  (and pltpu-less builds) take ``pl.ANY``.
+
+Masking convention shared by the kernels: masked scores are driven to
+``NEG_INF`` (or carry the dense path's ``-1e9`` additive bias) so that
+``exp(masked - max)`` underflows to exactly ``0.0`` — which is what makes
+recycled-block stale values contribute nothing to paged attention and
+padded key slots contribute nothing to flash attention.
+"""
+
+from typing import Optional
+
+import jax
+from jax.experimental import pallas as pl
+
+try:  # the pallas TPU backend is unavailable on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+__all__ = [
+    "pltpu",
+    "NEG_INF",
+    "LANES",
+    "has_pallas_tpu",
+    "default_interpret",
+    "resolve_interpret",
+    "smem_spec",
+    "pad_to",
+]
+
+NEG_INF = -1e30
+# lane width for per-row stats (lse/delta/sampled token); 8 is the f32
+# sublane minimum and the "equal to the overall array dim" rule makes the
+# last dim legal
+LANES = 8
+
+
+def has_pallas_tpu() -> bool:
+    """True when the Mosaic (pallas TPU) backend is importable."""
+    return _HAS_PLTPU
+
+
+def default_interpret() -> bool:
+    """Kernels compile for real only on TPU; every other backend runs the
+    Pallas interpreter (bit-parity tests pin the interpret path on CPU)."""
+    return jax.default_backend() != "tpu"
+
+
+def resolve_interpret(interpret: Optional[bool]) -> bool:
+    """The per-call ``interpret=`` knob: ``None`` = backend default."""
+    return default_interpret() if interpret is None else bool(interpret)
+
+
+def smem_spec() -> pl.BlockSpec:
+    """Whole-operand scalar spec: SMEM on hardware, ANY elsewhere."""
+    if _HAS_PLTPU:
+        return pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.BlockSpec(memory_space=pl.ANY)
+
+
+def pad_to(x: jax.Array, mult: int, axis: int) -> jax.Array:
+    """Zero-pad ``axis`` up to the next multiple of ``mult``."""
+    import jax.numpy as jnp
+
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)

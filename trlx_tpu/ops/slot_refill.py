@@ -41,7 +41,11 @@ persistent state is a block pool + per-slot block tables
 (``ops/paged_kv.py``): each program gathers the pool into the exact dense
 view the model consumes, runs the *unchanged* dense compute, and scatters
 the written span back — so paged decode is bit-identical to dense decode
-by construction (``tests/test_engine.py``). The paged refill additionally
+by construction (``tests/test_engine.py``). With
+``decode_kernel="pallas"`` the paged *decode segments* skip the gather
+entirely: the in-place Pallas paged-attention kernel + fused sampling
+(``ops/paged_attention.py``) read and write K/V through the block table,
+bit-identical to the gather path (``tests/test_paged_attention.py``). The paged refill additionally
 supports a static ``hit`` offset: rows whose leading ``hit`` cache columns
 are already committed (prefix-cache hits, ``trlx_tpu/engine/``) prefill
 only their unshared suffix ``[hit, P)`` — the suffix forward attends to
@@ -62,6 +66,8 @@ import jax.numpy as jnp
 from trlx_tpu.ops.paged_kv import (
     PagedKV,
     PagedSpec,
+    attach_block_table,
+    detach_block_table,
     gather_view,
     init_paged_kv,
     scatter_span,
@@ -114,6 +120,7 @@ class SlotRefillFns(NamedTuple):
     max_new_tokens: int
     segment_len: int = 8  # decode steps per compiled segment
     paged: Optional[PagedSpec] = None  # None = dense per-slot cache
+    decode_kernel: str = "xla"  # "pallas" = in-place paged decode kernel
 
 
 def _row_where(flag: jax.Array, new: Any, old: Any) -> Any:
@@ -150,6 +157,7 @@ def make_slot_refill_fns(
     params_example: Any = None,
     jit: bool = True,
     paged: Optional[PagedSpec] = None,
+    decode_kernel: str = "xla",
 ) -> SlotRefillFns:
     """Build the (jitted) slot-refill programs for one shape bucket.
 
@@ -164,7 +172,26 @@ def make_slot_refill_fns(
     tables (``ops/paged_kv.py``); the refill and segment programs then take
     their block-table rows from the host allocator (``trlx_tpu/engine/``)
     and gather/scatter around the unchanged dense compute.
+
+    ``decode_kernel`` selects the paged *decode-segment* compute
+    (``engine.decode_kernel``): ``"xla"`` is the gather → dense compute →
+    scatter reference; ``"pallas"`` runs the in-place paged-attention
+    decode kernel + fused sampling (``ops/paged_attention.py``) — K/V read
+    and written through the block table with no transient dense view.
+    Bit-identical to the gather path by contract
+    (``tests/test_paged_attention.py``); refill prefills always take the
+    gather path (they run once per prompt — the per-segment gather is the
+    tax the kernel deletes).
     """
+    if decode_kernel not in ("xla", "pallas"):
+        raise ValueError(
+            f"unknown decode_kernel '{decode_kernel}' (xla | pallas)"
+        )
+    if decode_kernel == "pallas" and paged is None:
+        raise ValueError(
+            "decode_kernel: pallas is the in-place *paged* decode kernel — "
+            "it requires the paged KV backend (engine.backend: paged)"
+        )
     if not config.per_row_rng:
         config = dataclasses.replace(config, per_row_rng=True)
     B, P, N = batch_size, prompt_len, config.max_new_tokens
@@ -440,14 +467,26 @@ def make_slot_refill_fns(
         — the utilization numerators/denominators for
         ``throughput/slot_utilization`` / ``rollout/padded_decode_frac``.
 
-        Paged backend: gather the pool into the dense view once per
-        segment, run the UNCHANGED dense loop on it, scatter each row's
-        live writes (columns ``P + step_before .. P + step_after − 1``)
-        back into its table's blocks. The loop body literally is the dense
-        body over bit-identical values, so paged decode inherits the dense
-        backend's bit-parity with plain ``generate``; the view is a
-        per-program temporary (the Pallas in-place paged decode kernel is
-        ROADMAP item 3)."""
+        Paged backend, ``decode_kernel: xla`` (the reference): gather the
+        pool into the dense view once per segment, run the UNCHANGED dense
+        loop on it, scatter each row's live writes (columns
+        ``P + step_before .. P + step_after − 1``) back into its table's
+        blocks. The loop body literally is the dense body over
+        bit-identical values, so paged decode inherits the dense backend's
+        bit-parity with plain ``generate``; the view is a per-program
+        temporary.
+
+        Paged backend, ``decode_kernel: pallas``: no view, no scatter —
+        each step's forward reads K/V through the block table in place and
+        commits its one column per live row through the table
+        (``ops/paged_attention.py`` via ``models/transformer.py``), with
+        fused top-k/top-p/temperature sampling. Frozen rows' table rows
+        are poisoned out of range per step, so their dead writes drop —
+        exactly the columns ``scatter_steps`` would not have committed.
+        Bit-identical to the gather path (tests/test_paged_attention.py,
+        tests/test_engine.py)."""
+        if paged is not None and decode_kernel == "pallas":
+            return _decode_segment_paged_kernel(params, state)
         if paged is not None:
             paged_cache = state.cache
             view = gather_view(paged_cache.pool, paged_cache.block_table, S)
@@ -470,11 +509,45 @@ def make_slot_refill_fns(
             )
         return _decode_segment_dense(params, state)
 
+    def _decode_segment_paged_kernel(params: Any, state: SlotState):
+        """The in-place twin of ``_decode_segment_dense``: same sampling
+        and bookkeeping ops on the same values, but the cache threaded
+        through ``apply_fn`` is the block pool + (live-masked) table
+        instead of a gathered dense view, and sampling runs the fused
+        kernel. The per-row sample/bookkeeping stream is bit-identical by
+        construction of the two kernels."""
+        from trlx_tpu.ops.paged_attention import sample_token_fused
+
+        table = state.cache.block_table
+
+        def step_cache(st: SlotState, live: jax.Array):
+            # freeze-mask the table EVERY step: a row that finished mid-
+            # segment must stop committing K/V (its blocks may already be
+            # recycled after harvest) — out-of-range ids drop all writes
+            eff_table = jnp.where(live[:, None], table, paged.max_blocks)
+            return attach_block_table(st.cache.pool, eff_table)
+
+        def fold_cache(out_cache: Any) -> PagedKV:
+            return PagedKV(detach_block_table(out_cache), table)
+
+        return _segment_loop(
+            params, state, step_cache, fold_cache, sample_token_fused
+        )
+
     def _decode_segment_dense(params: Any, state: SlotState):
+        return _segment_loop(
+            params,
+            state,
+            lambda st, live: st.cache,
+            lambda out_cache: out_cache,
+            sample_token_from_logits,
+        )
+
+    def _segment_loop(params, state, step_cache, fold_cache, sample_fn):
         def sample_step(carry):
             st, live_steps, k = carry
             new_rng, sample_rng = split_row_keys(st.rng)
-            next_token, logprob = sample_token_from_logits(
+            next_token, logprob = sample_fn(
                 st.logits, st.step_out, sample_rng, config, st.step, adjust_logits
             )
             live = ~st.done
@@ -502,7 +575,7 @@ def make_slot_refill_fns(
                 next_token[:, None],
                 attention_mask=slot_mask,
                 positions=(st.prompt_len + st.step)[:, None],
-                cache=st.cache,
+                cache=step_cache(st, live),
                 cache_index=slot,
             )
             step_out = {**last_step_info(out), "last_tokens": next_token}
@@ -512,9 +585,11 @@ def make_slot_refill_fns(
                 values=values,
                 mask=mask,
                 slot_mask=slot_mask,
-                # the forward wrote every row's k/v at its own slot; done
-                # rows wrote into dead (masked) columns — harmless
-                cache=out["cache"],
+                # dense view: the forward wrote every row's k/v at its own
+                # slot (done rows into dead masked columns — harmless);
+                # in-place kernel: only live rows committed, through the
+                # live-masked table
+                cache=fold_cache(out["cache"]),
                 logits=_row_where(live, out["logits"][:, -1, :], st.logits),
                 step_out=_row_where(live, step_out, st.step_out),
                 prompt_len=st.prompt_len,
@@ -546,4 +621,5 @@ def make_slot_refill_fns(
         max_new_tokens=N,
         segment_len=segment_len,
         paged=paged,
+        decode_kernel=decode_kernel,
     )

@@ -53,15 +53,33 @@ CONTINUOUS_BATCHING_PROGRAMS = ("cb_refill", "cb_segment")
 # itself under regression guard.
 PAGED_ENGINE_PROGRAMS = ("paged_refill", "paged_decode")
 
+# Paged backend with engine.decode_kernel: pallas — the segment decode is
+# the in-place paged-attention kernel + fused sampling
+# (ops/paged_attention.py); no per-segment gather/scatter exists in the
+# program, and the budget pins that (a regression that reintroduces a
+# pool-sized temporary shows up as a temp/byte jump). The refill prefill
+# stays the gather-path program.
+PAGED_KERNEL_PROGRAMS = ("paged_refill", "paged_decode_kernel")
+
+
+def _engine_programs(config: TRLConfig) -> Tuple[str, ...]:
+    """The rollout programs ``train.continuous_batching`` adds, resolved
+    from the engine config — the single selection point for
+    ``_config_programs`` and ``hot_program_costs`` (a new engine program
+    variant must be added exactly here)."""
+    if not bool(getattr(config.train, "continuous_batching", False)):
+        return ()
+    if config.engine.backend == "paged":
+        if config.engine.decode_kernel == "pallas":
+            return PAGED_KERNEL_PROGRAMS
+        return PAGED_ENGINE_PROGRAMS
+    return CONTINUOUS_BATCHING_PROGRAMS
+
 
 def _config_programs(config: TRLConfig) -> Tuple[str, ...]:
-    programs = TRAINER_PROGRAMS[config.train.trainer.lower()]
-    if bool(getattr(config.train, "continuous_batching", False)):
-        if config.engine.backend == "paged":
-            programs = programs + PAGED_ENGINE_PROGRAMS
-        else:
-            programs = programs + CONTINUOUS_BATCHING_PROGRAMS
-    return programs
+    return TRAINER_PROGRAMS[config.train.trainer.lower()] + _engine_programs(
+        config
+    )
 
 
 def budget_programs() -> Dict[str, Tuple[str, ...]]:
@@ -203,12 +221,9 @@ def hot_program_costs(
         trainer = _build_abstract_trainer(config)
     trainer_name = type(trainer).__name__.lower()
     if programs is None:
-        programs = TRAINER_PROGRAMS.get(trainer_name, ("train_step",))
-        if bool(getattr(config.train, "continuous_batching", False)):
-            if config.engine.backend == "paged":
-                programs = programs + PAGED_ENGINE_PROGRAMS
-            else:
-                programs = programs + CONTINUOUS_BATCHING_PROGRAMS
+        programs = TRAINER_PROGRAMS.get(
+            trainer_name, ("train_step",)
+        ) + _engine_programs(config)
 
     B, P, N = batch_size, prompt_len, gen_len
     SDS = jax.ShapeDtypeStruct
@@ -261,7 +276,11 @@ def hot_program_costs(
                 )
             )
 
-        cb_all = CONTINUOUS_BATCHING_PROGRAMS + PAGED_ENGINE_PROGRAMS
+        cb_all = (
+            CONTINUOUS_BATCHING_PROGRAMS
+            + PAGED_ENGINE_PROGRAMS
+            + PAGED_KERNEL_PROGRAMS
+        )
         if any(p in programs for p in cb_all):
             # the continuous-batching rollout programs: the on-demand refill
             # prefill and the fixed-size segment decode (ops/slot_refill.py)
@@ -302,8 +321,17 @@ def hot_program_costs(
                 results[name] = _costs_of(
                     fns.refill_program(B).lower(*refill_args)
                 )
-            if "cb_segment" in programs or "paged_decode" in programs:
-                name = "paged_decode" if fns.paged is not None else "cb_segment"
+            if (
+                "cb_segment" in programs
+                or "paged_decode" in programs
+                or "paged_decode_kernel" in programs
+            ):
+                if fns.paged is None:
+                    name = "cb_segment"
+                elif getattr(fns, "decode_kernel", "xla") == "pallas":
+                    name = "paged_decode_kernel"
+                else:
+                    name = "paged_decode"
                 results[name] = _costs_of(
                     fns.decode_segment.lower(params, state_sds)
                 )
@@ -452,6 +480,24 @@ def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
                 model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
                 tokenizer=dict(tokenizer_path="builtin:bytes"),
                 engine=dict(backend="paged", kv_block_size=8, prefix_cache=True),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "gpt2_test_paged_kernel": (
+            # the paged engine with engine.decode_kernel: pallas — the
+            # in-place paged-attention decode kernel + fused sampling
+            # replace the per-segment gather/scatter (paged_refill +
+            # paged_decode_kernel). The pair of budgets (this and
+            # gpt2_test_paged) is the standing program-level record that
+            # the kernel path carries no pool-sized temporaries.
+            base.evolve(
+                train=dict(continuous_batching=True),
+                model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+                engine=dict(
+                    backend="paged", kv_block_size=8, prefix_cache=True,
+                    decode_kernel="pallas",
+                ),
             ),
             dict(batch_size=8, prompt_len=32, gen_len=16),
         ),

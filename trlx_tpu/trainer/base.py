@@ -160,6 +160,20 @@ class TPUBaseTrainer(BaseRLTrainer):
                 "engine.prefix_cache: true requires engine.backend: paged — "
                 "dense per-slot KV caches cannot share blocks"
             )
+        if config.engine.decode_kernel not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown engine.decode_kernel "
+                f"'{config.engine.decode_kernel}' (xla | pallas)"
+            )
+        if (
+            config.engine.decode_kernel == "pallas"
+            and config.engine.backend != "paged"
+        ):
+            raise ValueError(
+                "engine.decode_kernel: pallas is the in-place *paged* "
+                "decode kernel (ops/paged_attention.py) — it requires "
+                "engine.backend: paged"
+            )
         self.mesh = make_mesh(config.parallel)
         set_global_mesh(self.mesh)  # model code reads this for sequence-parallel ops
         # NOTE: the global mesh is process-wide; entry points re-assert it so
@@ -904,9 +918,12 @@ class TPUBaseTrainer(BaseRLTrainer):
 
         gen_config = _dc.replace(gen_config, per_row_rng=True)
         paged = self._resolve_paged_spec(batch_size, prompt_len, gen_config)
+        decode_kernel = (
+            self.config.engine.decode_kernel if paged is not None else "xla"
+        )
         key = (
             "slot_refill", gen_config, extra_kwargs, batch_size, prompt_len,
-            segment_len, paged,
+            segment_len, paged, decode_kernel,
         )
         if key not in self._generate_fns:
             from trlx_tpu.ops.slot_refill import make_slot_refill_fns
@@ -923,6 +940,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                 segment_len=segment_len,
                 params_example=self.state.params,
                 paged=paged,
+                decode_kernel=decode_kernel,
             )
         return self._generate_fns[key]
 
