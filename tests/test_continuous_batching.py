@@ -256,6 +256,13 @@ class TestEngineStateMachine:
             # the dense engine now reports its KV allocation too
             # (docs/PERFORMANCE.md; engine/* gauges are paged-only)
             "memory/kv_cache_bytes",
+            # decode-stall accounting (docs/PERFORMANCE.md "Chunked
+            # prefill"): every engine reports how long live decode slots
+            # waited on prefill work
+            "rollout/decode_stall_p50",
+            "rollout/decode_stall_p95",
+            "rollout/decode_stall_max",
+            "rollout/prefill_chunks",
         }
         assert metrics["memory/kv_cache_bytes"] > 0
 

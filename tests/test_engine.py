@@ -110,15 +110,19 @@ def reference(tiny_lm):
     return prompts, masks, ref, keys
 
 
-def _make_engine(tiny_lm, paged, prefix=False, segment_len=3, capacity=0):
+def _make_engine(
+    tiny_lm, paged, prefix=False, segment_len=3, capacity=0,
+    prefill_kernel="xla", prefill_chunk=0,
+):
     apply_fn, params, tcfg = tiny_lm
     fns = make_slot_refill_fns(
         apply_fn, lambda b, s: make_kv_cache(tcfg, b, s), _B, _P, _gen_config(),
         adjust_logits=_eos_boost, segment_len=segment_len,
-        params_example=params, paged=paged,
+        params_example=params, paged=paged, prefill_kernel=prefill_kernel,
     )
     return ContinuousEngine(
-        fns, params, _PAD, prefix_cache=prefix, prefix_capacity_blocks=capacity
+        fns, params, _PAD, prefix_cache=prefix, prefix_capacity_blocks=capacity,
+        prefill_chunk=prefill_chunk,
     )
 
 
@@ -323,6 +327,105 @@ class TestPagedBitEquivalence:
 
 
 # ---------------------------------------------------------------------------
+# chunked-prefill scheduling (XLA gather flavor; the pallas-prefill twin
+# lives in tests/test_paged_attention.py)
+# ---------------------------------------------------------------------------
+
+
+class TestChunkedPrefill:
+    @pytest.mark.parametrize("chunk", [1, 3, 4, 7])
+    def test_chunked_matches_plain_generate(self, tiny_lm, reference, chunk):
+        """Chunk-size invariance (the acceptance invariant): splitting
+        prefills into fixed spans interleaved with decode segments must
+        not change a bit of any harvested stream, across chunk sizes that
+        do and do not divide P=10 or the block size."""
+        prompts, masks, ref, keys = reference
+        TB = num_table_blocks(_P + _N, 4)
+        spec = PagedSpec(block_size=4, max_blocks=1 + 2 * _B * TB)
+        engine = _make_engine(tiny_lm, spec, prefill_chunk=chunk)
+        got = _drain(engine, prompts, masks, keys)
+        _assert_matches(ref, got)
+        assert engine.stats.prefill_chunk_calls > 0
+        # every column from the first real (chunk-grid-aligned) one is
+        # prefilled exactly once: all-masked leading pads are skipped
+        pads = [int(_P - masks[i].sum()) for i in range(prompts.shape[0])]
+        expected = sum(_P - (pad // chunk) * chunk for pad in pads)
+        assert engine.stats.prefill_tokens == expected
+
+    @pytest.mark.parametrize("block_size", [1, 3, 8])
+    def test_chunked_across_block_sizes(self, tiny_lm, reference, block_size):
+        """Chunk boundaries and block boundaries need not align: every
+        (chunk=3, block_size) pairing reproduces the reference."""
+        prompts, masks, ref, keys = reference
+        TB = num_table_blocks(_P + _N, block_size)
+        spec = PagedSpec(block_size=block_size, max_blocks=1 + 2 * _B * TB)
+        engine = _make_engine(tiny_lm, spec, prefill_chunk=3)
+        got = _drain(engine, prompts, masks, keys)
+        _assert_matches(ref, got)
+
+    def test_chunked_with_prefix_hits(self, tiny_lm, reference):
+        """Prefix-cache-aware chunk skipping: a warm second wave starts its
+        chunks AFTER the committed shared blocks (hits are never
+        re-prefilled), and stays bit-identical."""
+        prompts, masks, ref, keys = reference
+        spec = PagedSpec(block_size=4, max_blocks=1 + 3 * _B * _TB8 * 2)
+        engine = _make_engine(
+            tiny_lm, spec, prefix=True, prefill_chunk=3
+        )
+        got = _drain(engine, prompts, masks, keys, waves=2)
+        _assert_matches(ref, got)
+        assert engine.stats.prefix_tokens_saved > 0
+        # committed blocks were skipped: fewer columns prefilled than
+        # 2 waves × rows × P
+        assert engine.stats.prefill_tokens < 2 * prompts.shape[0] * _P
+
+    def test_decode_stall_and_gather_bytes_accounted(self, tiny_lm, reference):
+        """The measured gauges behind the ENGINE_PREFILL A/B: the gather
+        flavor reports non-zero refill gather/scatter bytes, and prefill
+        events that ran while seeded slots decoded produce stall samples
+        with ordered percentiles."""
+        prompts, masks, ref, keys = reference
+        TB = num_table_blocks(_P + _N, 4)
+        spec = PagedSpec(block_size=4, max_blocks=1 + 2 * _B * TB)
+        engine = _make_engine(tiny_lm, spec, prefill_chunk=3)
+        _assert_matches(ref, _drain(engine, prompts, masks, keys))
+        st = engine.stats
+        # 10 heterogeneous-length rows over 4 slots: later admissions
+        # prefill while earlier rows decode
+        assert len(st.decode_stall_samples) > 0
+        assert st.decode_stall_s > 0.0
+        assert (
+            0.0
+            < st.decode_stall_p50
+            <= st.decode_stall_p95
+            <= st.decode_stall_max
+        )
+        # gather flavor: the refill programs move transient dense views
+        assert st.refill_gather_bytes > 0  # chunks gather committed prefixes
+        assert st.refill_scatter_bytes > 0
+        m = st.metrics()
+        assert m["rollout/decode_stall_max"] == st.decode_stall_max
+        assert m["rollout/prefill_chunks"] == float(st.prefill_chunk_calls)
+        assert m["engine/prefill_kernel_pallas"] == 0.0
+
+    def test_chunk_requires_paged_backend(self, tiny_lm):
+        with pytest.raises(ValueError, match="paged"):
+            _make_engine(tiny_lm, None, prefill_chunk=4)
+
+    def test_mid_span_program_rejects_bad_spans(self, tiny_lm):
+        spec = PagedSpec(block_size=4, max_blocks=1 + 2 * _B * _TB8)
+        apply_fn, params, tcfg = tiny_lm
+        fns = make_slot_refill_fns(
+            apply_fn, lambda b, s: make_kv_cache(tcfg, b, s), _B, _P,
+            _gen_config(), params_example=params, paged=spec,
+        )
+        with pytest.raises(ValueError, match="strictly inside"):
+            fns.prefill_chunk_program(_B, 4, _P)  # final span = refill's job
+        with pytest.raises(ValueError, match="strictly inside"):
+            fns.prefill_chunk_program(_B, 4, 4)
+
+
+# ---------------------------------------------------------------------------
 # SerialEngine: the dense reference behind the same interface
 # ---------------------------------------------------------------------------
 
@@ -440,6 +543,61 @@ def test_decode_kernel_without_paged_rejected_at_construction(tmp_path):
             tmp_path, "badk2", continuous=True,
             engine_overrides=dict(backend="paged", decode_kernel="cuda"),
         )
+
+
+def test_prefill_knobs_without_paged_rejected_at_construction(tmp_path):
+    """engine.prefill_kernel: pallas and engine.prefill_chunk both require
+    the paged backend — config errors at trainer construction, never a
+    silent no-op."""
+    with pytest.raises(ValueError, match="engine.backend: paged"):
+        _ppo_trainer(
+            tmp_path, "badpf", continuous=True,
+            engine_overrides=dict(prefill_kernel="pallas"),
+        )
+    with pytest.raises(ValueError, match="prefill_kernel"):
+        _ppo_trainer(
+            tmp_path, "badpf2", continuous=True,
+            engine_overrides=dict(backend="paged", prefill_kernel="cuda"),
+        )
+    with pytest.raises(ValueError, match="engine.backend: paged"):
+        _ppo_trainer(
+            tmp_path, "badpf3", continuous=True,
+            engine_overrides=dict(prefill_chunk=8),
+        )
+
+
+def test_ppo_prefill_kernel_chunked_store_matches_serial(tmp_path):
+    """The full ISSUE-14 configuration threaded through the trainer's
+    config path — paged backend, prefix cache, BOTH in-place kernels, and
+    chunked-prefill scheduling — fills the PPO store with the same
+    sequences / logprobs / values / rewards as the serial dense path, and
+    the gauges record the kernel prefill (gather/scatter bytes = 0)."""
+    serial = _ppo_trainer(tmp_path, "serial_pf", continuous=False)
+    chunked = _ppo_trainer(
+        tmp_path, "chunked_pf", continuous=True,
+        engine_overrides=dict(
+            backend="paged", kv_block_size=4, prefix_cache=True,
+            decode_kernel="pallas", prefill_kernel="pallas", prefill_chunk=3,
+        ),
+    )
+    serial.make_experience(16)
+    chunked.make_experience(16)
+    assert len(serial.store) == len(chunked.store) == 16
+    a, b = _canonical(serial.store), _canonical(chunked.store)
+    assert set(a) == set(b)
+    for key in a:
+        for field in ("logprobs", "values", "rewards"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a[key], field)),
+                np.asarray(getattr(b[key], field)),
+                err_msg=field,
+            )
+    stats = chunked.make_experience_stats
+    assert stats["engine/decode_kernel_pallas"] == 1.0
+    assert stats["engine/prefill_kernel_pallas"] == 1.0
+    assert stats["engine/refill_gather_bytes"] == 0.0
+    assert stats["engine/refill_scatter_bytes"] == 0.0
+    assert stats["rollout/prefill_chunks"] > 0
 
 
 def test_ppo_paged_kernel_engine_store_matches_serial(tmp_path):

@@ -62,6 +62,16 @@ def test_scanner_sees_the_codebase():
     assert "engine/kv_blocks_in_use" in keys
     assert "engine/prefix_hit_rate" in keys
     assert "engine/queue_wait_s" in keys
+    # paged-prefill / chunked-prefill keys (docs/PERFORMANCE.md "Pallas
+    # kernels" + "Chunked prefill"): the refill gather/scatter byte
+    # accounting and the measured decode-stall percentiles
+    assert "engine/prefill_kernel_pallas" in keys
+    assert "engine/refill_gather_bytes" in keys
+    assert "engine/refill_scatter_bytes" in keys
+    assert "rollout/decode_stall_p50" in keys
+    assert "rollout/decode_stall_p95" in keys
+    assert "rollout/decode_stall_max" in keys
+    assert "rollout/prefill_chunks" in keys
     # distributed-telemetry keys (docs/OBSERVABILITY.md "Distributed
     # telemetry"): the cluster beat's literal set_gauge sites
     assert "cluster/step_skew_s" in keys

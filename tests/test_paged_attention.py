@@ -30,6 +30,10 @@ from trlx_tpu.ops.paged_attention import (
     paged_attention_decode_reference,
     sample_token_fused,
 )
+from trlx_tpu.ops.paged_prefill import (
+    paged_prefill_attention,
+    paged_prefill_attention_reference,
+)
 from trlx_tpu.ops.paged_kv import PagedSpec, num_table_blocks
 from trlx_tpu.ops.sampling import (
     GenerationConfig,
@@ -135,6 +139,114 @@ class TestPagedDecodeKernelParity:
             q, jnp.asarray(k_big), jnp.asarray(v_big), table, bias
         )
         np.testing.assert_array_equal(np.asarray(base), np.asarray(poisoned))
+
+
+# ---------------------------------------------------------------------------
+# paged-prefill kernel unit parity (ops/paged_prefill.py)
+# ---------------------------------------------------------------------------
+
+# (B, T, H, KV, D, block_size, S): chunk lengths 1..7, block sizes 1/3/4/8/16,
+# S mostly not divisible by the block size, GQA ratios 1/2/3/4
+_PREFILL_GEOMETRIES = [
+    (3, 5, 4, 4, 32, 8, 19),
+    (2, 4, 4, 2, 16, 3, 10),
+    (2, 7, 8, 8, 32, 1, 7),
+    (4, 3, 4, 4, 32, 4, 24),
+    (2, 1, 2, 1, 64, 8, 33),  # T=1: the degenerate single-query chunk
+    (1, 6, 12, 4, 64, 16, 128),
+    (5, 2, 6, 3, 48, 4, 21),
+]
+
+
+class TestPagedPrefillKernelParity:
+    @pytest.mark.parametrize("per_head_bias", [False, True])
+    @pytest.mark.parametrize("geometry", _PREFILL_GEOMETRIES)
+    def test_bitwise_vs_gather_reference(self, geometry, per_head_bias):
+        """Random pools/tables/biases: the in-place prefill kernel equals
+        the gather-then-dense reference bit for bit — T queries per row
+        over the assembled VMEM row, out-of-range table ids clamped,
+        masked stale pool values contributing exactly 0.0, per-head
+        (ALiBi-shaped) bias rows preserved."""
+        B, T, H, KV, D, bs, S = geometry
+        rs = np.random.RandomState(hash(geometry) % (2**31))
+        TB = num_table_blocks(S, bs)
+        NB = 1 + B * TB + 3
+        q = jnp.asarray(rs.randn(B, T, H, D).astype(np.float32))
+        k_pool = jnp.asarray(rs.randn(NB, bs, KV, D).astype(np.float32))
+        v_pool = jnp.asarray(rs.randn(NB, bs, KV, D).astype(np.float32))
+        table = jnp.asarray(rs.randint(0, NB + 2, (B, TB)).astype(np.int32))
+        visible = rs.rand(B, T, S) > 0.3
+        visible[:, :, 0] = True  # at least one visible key per query
+        mask_bias = np.where(visible, 0.0, -1e9)[:, None]  # [B, 1, T, S]
+        if per_head_bias:
+            slopes = 0.5 ** (1 + np.arange(H))
+            dist = -np.abs(S - 1 - np.arange(S))
+            alibi = np.where(
+                visible[:, None, :, :],
+                slopes[None, :, None, None] * dist[None, None, None, :],
+                0.0,
+            )
+            bias = jnp.asarray((mask_bias + alibi).astype(np.float32))
+        else:
+            bias = jnp.asarray(mask_bias.astype(np.float32))
+        out_kernel = jax.jit(paged_prefill_attention)(
+            q, k_pool, v_pool, table, bias
+        )
+        out_ref = jax.jit(paged_prefill_attention_reference)(
+            q, k_pool, v_pool, table, bias
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_kernel), np.asarray(out_ref)
+        )
+
+    def test_masked_stale_blocks_contribute_zero(self):
+        """Blowing up masked positions' pool values (recycled-block stale
+        garbage, not-yet-written columns) must not change a single output
+        bit — the -1e9 underflow contract, now for T-query chunks."""
+        B, T, H, KV, D, bs, S = 2, 4, 4, 4, 32, 4, 11
+        rs = np.random.RandomState(7)
+        TB = num_table_blocks(S, bs)
+        NB = 1 + B * TB
+        q = jnp.asarray(rs.randn(B, T, H, D).astype(np.float32))
+        k_np = rs.randn(NB, bs, KV, D).astype(np.float32)
+        v_np = rs.randn(NB, bs, KV, D).astype(np.float32)
+        table = jnp.asarray(
+            (1 + np.arange(B * TB).reshape(B, TB)).astype(np.int32)
+        )
+        visible = rs.rand(B, S) > 0.4
+        visible[:, 0] = True
+        bias = jnp.asarray(
+            np.broadcast_to(
+                np.where(visible, 0.0, -1e9)[:, None, None, :], (B, 1, T, S)
+            ).astype(np.float32)
+        )
+        base = paged_prefill_attention(
+            q, jnp.asarray(k_np), jnp.asarray(v_np), table, bias
+        )
+        k_big, v_big = k_np.copy(), v_np.copy()
+        for b in range(B):
+            for s in range(S):
+                if not visible[b, s]:
+                    blk, off = table[b, s // bs], s % bs
+                    k_big[blk, off] = 1e4
+                    v_big[blk, off] = -1e4
+        poisoned = paged_prefill_attention(
+            q, jnp.asarray(k_big), jnp.asarray(v_big), table, bias
+        )
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(poisoned))
+
+    def test_shape_validation(self):
+        q = jnp.zeros((2, 3, 4, 8), jnp.float32)
+        pool = jnp.zeros((5, 2, 4, 8), jnp.float32)
+        table = jnp.zeros((2, 2), jnp.int32)
+        with pytest.raises(ValueError, match="chunk length"):
+            paged_prefill_attention(
+                q, pool, pool, table, jnp.zeros((2, 1, 5, 4), jnp.float32)
+            )
+        with pytest.raises(ValueError, match="covers"):
+            paged_prefill_attention(
+                q, pool, pool, table, jnp.zeros((2, 1, 3, 9), jnp.float32)
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -298,7 +410,10 @@ def reference(tiny_lm):
     return prompts, masks, ref, keys
 
 
-def _kernel_engine(tiny_lm, block_size, max_blocks=None, prefix=False):
+def _kernel_engine(
+    tiny_lm, block_size, max_blocks=None, prefix=False,
+    prefill_kernel="xla", prefill_chunk=0,
+):
     apply_fn, params, tcfg = tiny_lm
     TB = num_table_blocks(_P + _N, block_size)
     spec = PagedSpec(
@@ -308,8 +423,11 @@ def _kernel_engine(tiny_lm, block_size, max_blocks=None, prefix=False):
         apply_fn, lambda b, s: make_kv_cache(tcfg, b, s), _B, _P,
         _gen_config(), adjust_logits=_eos_boost, segment_len=3,
         params_example=params, paged=spec, decode_kernel="pallas",
+        prefill_kernel=prefill_kernel,
     )
-    return ContinuousEngine(fns, params, _PAD, prefix_cache=prefix)
+    return ContinuousEngine(
+        fns, params, _PAD, prefix_cache=prefix, prefill_chunk=prefill_chunk
+    )
 
 
 def _drain(engine, prompts, masks, keys, waves=1):
@@ -377,6 +495,139 @@ class TestKernelEngineBitEquivalence:
         got = _drain(engine, prompts, masks, keys, waves=2)
         _assert_matches(ref, got)
         assert engine.stats.prefix_tokens_saved > 0
+
+
+class TestPrefillKernelEngineBitEquivalence:
+    """The whole in-place prefill path (engine.prefill_kernel: pallas) —
+    K/V committed through the table inside the refill forward, attention
+    reading pool blocks in place, no gather on entry, no scatter on exit —
+    reproduces plain generate bit-for-bit, monolithic and chunked."""
+
+    @pytest.mark.parametrize("block_size", [1, 3, 4, 8])
+    def test_prefill_kernel_matches_plain_generate(
+        self, tiny_lm, reference, block_size
+    ):
+        prompts, masks, ref, keys = reference
+        engine = _kernel_engine(
+            tiny_lm, block_size, prefill_kernel="pallas"
+        )
+        got = _drain(engine, prompts, masks, keys)
+        _assert_matches(ref, got)
+        st = engine.stats
+        assert st.prefill_kernel_pallas
+        # the acceptance number: the in-place prefill moves NO transient
+        # dense-view bytes
+        assert st.refill_gather_bytes == 0
+        assert st.refill_scatter_bytes == 0
+        assert st.metrics()["engine/prefill_kernel_pallas"] == 1.0
+
+    @pytest.mark.parametrize("chunk", [1, 3, 4, 7])
+    def test_chunked_prefill_kernel_matches_plain_generate(
+        self, tiny_lm, reference, chunk
+    ):
+        """Chunk-size invariance through the kernel flavor: fixed prefill
+        spans interleaved with kernel decode segments stay bit-identical
+        across chunk sizes (including 1 and sizes that do not divide
+        P=10 or the block size)."""
+        prompts, masks, ref, keys = reference
+        engine = _kernel_engine(
+            tiny_lm, 4, prefill_kernel="pallas", prefill_chunk=chunk
+        )
+        got = _drain(engine, prompts, masks, keys)
+        _assert_matches(ref, got)
+        st = engine.stats
+        assert st.prefill_chunk_calls > 0
+        assert st.refill_gather_bytes == 0 and st.refill_scatter_bytes == 0
+        assert len(st.decode_stall_samples) > 0  # admissions met live rows
+
+    def test_chunked_prefill_kernel_with_prefix_hits(self, tiny_lm, reference):
+        """Prefix-cache-aware chunk skipping through the kernel flavor: a
+        warm second wave's chunks start after the committed shared blocks
+        and the harvest stays bit-identical."""
+        prompts, masks, ref, keys = reference
+        TB = num_table_blocks(_P + _N, 4)
+        engine = _kernel_engine(
+            tiny_lm, 4, max_blocks=1 + 3 * _B * TB * 2, prefix=True,
+            prefill_kernel="pallas", prefill_chunk=3,
+        )
+        got = _drain(engine, prompts, masks, keys, waves=2)
+        _assert_matches(ref, got)
+        assert engine.stats.prefix_tokens_saved > 0
+        assert engine.stats.prefill_tokens < 2 * prompts.shape[0] * _P
+
+    def test_recycled_stale_blocks_second_wave(self, tiny_lm, reference):
+        """A tight pool + a second wave forces wave-2 prefills into blocks
+        wave-1 rows wrote and freed — the kernel reads stale K/V only at
+        bias-masked positions, which contribute exactly 0.0."""
+        prompts, masks, ref, keys = reference
+        TB = num_table_blocks(_P + _N, 4)
+        engine = _kernel_engine(
+            tiny_lm, 4, max_blocks=1 + _B * TB + 2,
+            prefill_kernel="pallas", prefill_chunk=3,
+        )
+        got = _drain(engine, prompts, masks, keys, waves=2)
+        _assert_matches(ref, got)
+
+
+def test_prefill_kernel_requires_paged_backend(tiny_lm):
+    apply_fn, params, tcfg = tiny_lm
+    with pytest.raises(ValueError, match="paged"):
+        make_slot_refill_fns(
+            apply_fn, lambda b, s: make_kv_cache(tcfg, b, s), _B, _P,
+            _gen_config(), params_example=params, paged=None,
+            prefill_kernel="pallas",
+        )
+    with pytest.raises(ValueError, match="prefill_kernel"):
+        make_slot_refill_fns(
+            apply_fn, lambda b, s: make_kv_cache(tcfg, b, s), _B, _P,
+            _gen_config(), params_example=params, prefill_kernel="cuda",
+        )
+
+
+def test_prefill_kernel_engine_alibi_matches_plain_generate():
+    """ALiBi models carry PER-HEAD additive bias rows ([B, H, T, S]): the
+    prefill kernel must thread the full head dim through — pins kernel
+    prefill ≡ plain generate on a bloom-style (alibi) model with
+    left-padded prompts and chunked scheduling."""
+    module, params, tcfg = build_causal_lm(
+        ModelConfig(
+            model_path="builtin:gpt2-test",
+            model_extra_kwargs=dict(position_scheme="alibi"),
+        ),
+        head="value",
+    )
+
+    def apply_fn(p, ids, **kw):
+        return module.apply({"params": p}, ids, **kw)
+
+    config = _gen_config()
+    rs = np.random.RandomState(5)
+    prompts = rs.randint(0, 200, (_B, _P)).astype(np.int32)
+    masks = np.ones_like(prompts)
+    prompts[0, :2] = _PAD
+    masks[0, :2] = 0
+    rng = jax.random.PRNGKey(9)
+    out = jax.jit(
+        lambda p, ids, m, r: generate(
+            apply_fn, p, lambda b, s: make_kv_cache(tcfg, b, s),
+            ids, m, r, config, adjust_logits=_eos_boost,
+        )
+    )(params, jnp.asarray(prompts), jnp.asarray(masks), rng)
+    keys = {i: k for i, k in enumerate(np.asarray(per_row_keys(rng, _B)))}
+    ref = {
+        i: {
+            "tokens": np.asarray(out.response_tokens[i]),
+            "logprobs": np.asarray(out.response_logprobs[i]),
+            "values": np.asarray(out.response_values[i]),
+            "mask": np.asarray(out.response_mask[i]),
+        }
+        for i in range(_B)
+    }
+    engine = _kernel_engine(
+        (apply_fn, params, tcfg), 4, prefill_kernel="pallas", prefill_chunk=4
+    )
+    got = _drain(engine, prompts, masks, keys)
+    _assert_matches(ref, got)
 
 
 def test_kernel_engine_alibi_matches_plain_generate():

@@ -83,6 +83,18 @@ def test_budget_gpt2_test_paged_kernel():
 
 
 @pytest.mark.slow
+def test_budget_gpt2_test_paged_prefill():
+    """The fully in-place paged engine with chunked-prefill scheduling
+    (paged_prefill_kernel + paged_prefill_chunk + paged_decode_kernel,
+    ops/paged_prefill.py, engine.prefill_kernel: pallas +
+    engine.prefill_chunk): pins the refill/chunk programs that contain NO
+    dense-view gather/scatter — a change reintroducing a pool-sized
+    temporary (or losing the chunk program's logits-span restriction)
+    shows up as a byte/temp jump."""
+    _assert_within_budget("gpt2_test_paged_prefill")
+
+
+@pytest.mark.slow
 def test_budget_ilql_gpt2_test():
     """ILQL's programs: twin-Q/CQL train step + the advantage-reshaping
     sampler (a different generate program than PPO's)."""

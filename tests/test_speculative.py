@@ -744,25 +744,96 @@ def test_pipe_mesh_greedy_matches_unpipelined(tmp_path):
 
 class TestPerRowRngComposition:
     """per_row_rng × speculative decoding (the continuous-batching
-    composition seam): multi-row requests are rejected with a precise,
-    knob-naming error; a single row is accepted because the per-row and
-    shared stream disciplines coincide there — with one row there is no
-    batch composition for a per-row chain to be invariant to."""
+    composition seam, ROADMAP item 2's named blocker — removed): every
+    rng consumer (draft proposals, acceptance uniforms, residual/bonus)
+    advances a per-row key chain a fixed number of times per round, so a
+    row's sample stream depends only on (its chain, its round) — batch
+    composition invariance, pinned by the B=1-loop parity test."""
 
-    def test_multi_row_rejected_naming_the_knobs(self):
+    def test_batched_equals_row_by_row_loop_sampled(self):
+        """THE per-row contract: a sampled B=3 batch is bit-identical per
+        row to running each row alone with its chain — tokens, behavior
+        logprobs, values, and masks (eos + min_new_tokens active)."""
+        from trlx_tpu.ops.sampling import per_row_keys
+
         t, d = _models()
         ids, mask = _prompts(B=3)
         cfg = GenerationConfig(
-            max_new_tokens=4, pad_token_id=258, per_row_rng=True
+            max_new_tokens=6, pad_token_id=258, eos_token_id=5,
+            min_new_tokens=1, temperature=0.9, top_k=7, per_row_rng=True,
         )
-        with pytest.raises(ValueError) as exc:
-            _spec(t, d, ids, mask, cfg, 2)
-        msg = str(exc.value)
-        # the error must name the config knobs and the actual reason
-        assert "per_row_rng" in msg
-        assert "train.continuous_batching" in msg
-        assert "model.draft_model_path" in msg
-        assert "n_rows == 1" in msg
+        keys = per_row_keys(jax.random.PRNGKey(0), 3)
+
+        def run(i0, i1, k):
+            (t_apply, t_params, t_cfg), (d_apply, d_params, d_cfg) = t, d
+            from trlx_tpu.ops.speculative import generate_speculative
+
+            return generate_speculative(
+                t_apply, t_params, d_apply, d_params,
+                lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+                lambda b, s: make_kv_cache(d_cfg, b, s, jnp.float32),
+                ids[i0:i1], mask[i0:i1], k, cfg, gamma=3,
+            )
+
+        batched = run(0, 3, keys)
+        for i in range(3):
+            solo = run(i, i + 1, keys[i : i + 1])
+            for f in (
+                "response_tokens", "response_logprobs",
+                "response_values", "response_mask",
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(batched, f)[i]),
+                    np.asarray(getattr(solo, f)[0]),
+                    err_msg=f"row {i} {f}",
+                )
+
+    def test_single_key_entry_derives_per_row_chains(self):
+        """Passing ONE key with per_row_rng derives the same chains
+        per_row_keys would (the plain sampler's convention), so the two
+        entry forms are interchangeable."""
+        from trlx_tpu.ops.sampling import per_row_keys
+
+        t, d = _models()
+        ids, mask = _prompts(B=3)
+        cfg = GenerationConfig(
+            max_new_tokens=4, pad_token_id=258, eos_token_id=None,
+            per_row_rng=True,
+        )
+        stacked = _spec(t, d, ids, mask, cfg, 2, rng=0)
+        (t_apply, t_params, t_cfg), (d_apply, d_params, d_cfg) = t, d
+        out = generate_speculative(
+            t_apply, t_params, d_apply, d_params,
+            lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+            lambda b, s: make_kv_cache(d_cfg, b, s, jnp.float32),
+            ids, mask, per_row_keys(jax.random.PRNGKey(0), 3), cfg, gamma=2,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(stacked.response_tokens), np.asarray(out.response_tokens)
+        )
+
+    def test_multi_row_greedy_bit_identical(self):
+        """Greedy multi-row per_row_rng (previously rejected) consumes no
+        rng and stays bit-identical to the plain sampler."""
+        t, d = _models()
+        ids, mask = _prompts(B=3)
+        cfg = GenerationConfig(
+            max_new_tokens=6, do_sample=False, eos_token_id=None,
+            pad_token_id=258, per_row_rng=True,
+        )
+        t_apply, t_params, t_cfg = t
+        ref = generate(
+            t_apply, t_params,
+            lambda b, s: make_kv_cache(t_cfg, b, s, jnp.float32),
+            ids, mask, jax.random.PRNGKey(0), cfg,
+        )
+        out = _spec(t, d, ids, mask, cfg, 3)
+        assert (
+            np.asarray(out.response_tokens) == np.asarray(ref.response_tokens)
+        ).all()
+        assert (
+            np.asarray(out.response_mask) == np.asarray(ref.response_mask)
+        ).all()
 
     def test_single_row_accepted_greedy_bit_identical(self):
         t, d = _models()

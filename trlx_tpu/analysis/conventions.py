@@ -102,10 +102,26 @@ ENGINE_KEYS = frozenset({
     "engine/prefix_tokens_saved",
     "engine/queue_wait_s",
     "memory/kv_cache_bytes",
-    # paged decode compute path gauge (0/1): engine.decode_kernel — the
-    # in-place Pallas kernel (ops/paged_attention.py) vs the
-    # gather/scatter reference (docs/PERFORMANCE.md "Pallas kernels")
+    # paged decode/prefill compute path gauges (0/1): engine.decode_kernel
+    # / engine.prefill_kernel — the in-place Pallas kernels
+    # (ops/paged_attention.py, ops/paged_prefill.py) vs the gather/scatter
+    # references (docs/PERFORMANCE.md "Pallas kernels")
     "engine/decode_kernel_pallas",
+    "engine/prefill_kernel_pallas",
+    # analytic bytes the refill prefills move through transient dense
+    # views (pool→view gather on entry, span→pool scatter on exit):
+    # exactly 0 under the in-place prefill kernel — the acceptance number
+    # of benchmarks/ENGINE_PREFILL_cpu.json
+    "engine/refill_gather_bytes",
+    "engine/refill_scatter_bytes",
+    # chunked-prefill scheduling (engine.prefill_chunk,
+    # docs/PERFORMANCE.md "Chunked prefill"): mid-chunk program calls, and
+    # the measured wall-seconds live decode slots spent waiting on prefill
+    # work — one sample per stalling prefill event
+    "rollout/prefill_chunks",
+    "rollout/decode_stall_p50",
+    "rollout/decode_stall_p95",
+    "rollout/decode_stall_max",
 })
 
 # Canonical cross-rank telemetry gauges (observability/distributed.py,

@@ -312,6 +312,26 @@ class EngineConfig:
         Bit-identical outputs by contract (``tests/test_paged_attention
         .py``); off-TPU the kernels run under the Pallas interpreter.
         Requires ``backend: paged``.
+    :param prefill_kernel: compute path for the paged refill *prefills*.
+        ``"xla"`` (default) is gather → dense prefill → scatter — the last
+        dense-view copy on the generation hot path; ``"pallas"`` runs the
+        in-place Pallas paged-prefill kernel (``ops/paged_prefill.py``):
+        prompt K/V commits through the block table and attention reads
+        pool blocks straight into VMEM — refill gather/scatter bytes drop
+        to exactly 0 (``benchmarks/ENGINE_PREFILL_cpu.json``).
+        Bit-identical to the gather path by contract; the parity reference
+        is the dense einsum attention (models whose
+        ``resolved_attention_impl()`` is pallas-flash prefill through the
+        flash kernel on the gather path — same masking semantics,
+        flash-vs-dense numerics; docs/PERFORMANCE.md). Requires
+        ``backend: paged``.
+    :param prefill_chunk: chunked-prefill scheduling (0 = off): admitted
+        prompts prefill at most this many columns per engine step,
+        interleaved with decode segments, so a long prompt can never
+        stall live decode slots longer than one chunk's prefill — the
+        measured ``rollout/decode_stall_p50/p95/max`` gauges bound it.
+        Harvests stay bit-identical across chunk sizes. Requires
+        ``backend: paged``.
     """
 
     backend: str = "dense"
@@ -320,6 +340,8 @@ class EngineConfig:
     prefix_cache: bool = False
     prefix_cache_blocks: int = 0
     decode_kernel: str = "xla"
+    prefill_kernel: str = "xla"
+    prefill_chunk: int = 0
 
     from_dict = classmethod(_strict_from_dict)
 

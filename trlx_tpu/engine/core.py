@@ -46,7 +46,7 @@ enforces the locking, as in ``rollout_pipeline.py``.
 
 import time
 from collections import deque
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -86,11 +86,15 @@ class _Request:
     key: np.ndarray  # [2] per-row RNG chain start
     meta: Any = None
     # lifecycle timestamps (perf_counter) for the per-request trace spans:
-    # queue wait = enqueue → refill start, prefill = the refill program
-    # call, decode = refill end → harvest
+    # queue wait = enqueue → first prefill work, prefill = the refill (or
+    # first-through-last chunk) program calls, decode = prefill end →
+    # harvest
     t_enqueue: float = 0.0
     t_refill0: float = 0.0
     t_refill1: float = 0.0
+    # chunked prefill: next prompt column to prefill (None = prefill done
+    # or not chunked); the engine advances one chunk per step
+    prefill_pos: Optional[int] = None
 
 
 @dataclass
@@ -116,6 +120,23 @@ class EngineStats:
     # paged decode compute path: True = in-place Pallas kernel decode
     # (engine.decode_kernel: pallas), False = the gather/scatter reference
     decode_kernel_pallas: bool = False
+    # paged prefill compute path: True = in-place Pallas prefill kernel
+    # (engine.prefill_kernel: pallas), False = gather-prefill-scatter
+    prefill_kernel_pallas: bool = False
+    # analytic bytes the refill prefills move through transient dense
+    # views: gather = pool → dense view on program entry, scatter = written
+    # span → pool on exit. Exactly 0 under the in-place prefill kernel —
+    # the acceptance number of the ENGINE_PREFILL A/B (docs/PERFORMANCE.md)
+    refill_gather_bytes: int = 0
+    refill_scatter_bytes: int = 0
+    # chunked-prefill scheduling (engine.prefill_chunk)
+    prefill_chunk_calls: int = 0  # mid-chunk program invocations
+    # decode-stall accounting: wall-seconds of prefill work that ran while
+    # >= 1 seeded (decoding) slot sat waiting — one sample per stalling
+    # prefill event, so p50/p95/max bound how long a live decode slot can
+    # be held up by prompt admission (the number chunked prefill shrinks)
+    decode_stall_s: float = 0.0
+    decode_stall_samples: List[float] = field(default_factory=list)
     # prefix cache
     prefix_enabled: bool = False
     prefix_lookup_blocks: int = 0
@@ -142,6 +163,25 @@ class EngineStats:
             return 0.0
         return self.prefix_hit_blocks / self.prefix_lookup_blocks
 
+    def _stall_pct(self, q: float) -> float:
+        if not self.decode_stall_samples:
+            return 0.0
+        return float(np.percentile(np.asarray(self.decode_stall_samples), q))
+
+    @property
+    def decode_stall_p50(self) -> float:
+        return self._stall_pct(50.0)
+
+    @property
+    def decode_stall_p95(self) -> float:
+        return self._stall_pct(95.0)
+
+    @property
+    def decode_stall_max(self) -> float:
+        if not self.decode_stall_samples:
+            return 0.0
+        return float(max(self.decode_stall_samples))
+
     def metrics(self) -> Dict[str, float]:
         """The observability-layer gauges (registered in
         ``tests/test_metric_names.py``; see docs/OBSERVABILITY.md)."""
@@ -153,16 +193,34 @@ class EngineStats:
         stats["rollout/segments"] = float(self.segments)
         stats["engine/queue_wait_s"] = float(self.queue_wait_s)
         stats["memory/kv_cache_bytes"] = float(self.kv_cache_bytes)
+        # decode-stall percentiles (docs/PERFORMANCE.md "Chunked prefill"):
+        # how long live decode slots waited on prefill work — the measured
+        # number behind the chunked-prefill scheduling claim
+        stats["rollout/decode_stall_p50"] = self.decode_stall_p50
+        stats["rollout/decode_stall_p95"] = self.decode_stall_p95
+        stats["rollout/decode_stall_max"] = self.decode_stall_max
+        stats["rollout/prefill_chunks"] = float(self.prefill_chunk_calls)
         if self.kv_blocks_total:
             stats["engine/kv_blocks_in_use"] = float(self.kv_blocks_in_use)
             stats["engine/block_pool_occupancy"] = self.kv_blocks_in_use / max(
                 self.kv_blocks_total, 1
             )
-            # which decode compute the segments ran — an A/B artifact (or a
-            # dashboard) can tell kernel from gather runs without config
-            # archaeology
+            # which decode/prefill compute the programs ran — an A/B
+            # artifact (or a dashboard) can tell kernel from gather runs
+            # without config archaeology
             stats["engine/decode_kernel_pallas"] = float(
                 self.decode_kernel_pallas
+            )
+            stats["engine/prefill_kernel_pallas"] = float(
+                self.prefill_kernel_pallas
+            )
+            # the refill gather/scatter tax, measured: 0 under the
+            # in-place prefill kernel
+            stats["engine/refill_gather_bytes"] = float(
+                self.refill_gather_bytes
+            )
+            stats["engine/refill_scatter_bytes"] = float(
+                self.refill_scatter_bytes
             )
         if self.prefix_enabled:
             stats["engine/prefix_hit_rate"] = self.prefix_hit_rate
@@ -299,6 +357,20 @@ class ContinuousEngine(Engine):
     ``engine/prefill`` → ``engine/decode`` on a per-slot track — so a stall
     is attributable to one row, not smeared over the batch. ``prefix_cache``
     (paged backend only) turns on shared-prefix prefill skipping.
+
+    ``prefill_chunk`` (paged backend only, ``engine.prefill_chunk``) turns
+    on chunked-prefill *scheduling*: admitted prompts prefill one
+    fixed-size span per :meth:`step`, interleaved with decode segments, so
+    a long prompt can never stall live decode slots longer than one
+    chunk's prefill (the stall mode PipelineRL, arXiv:2509.19128,
+    identifies for long-sequence RL generation; the
+    ``rollout/decode_stall_*`` gauges measure it). Spans align to absolute
+    multiples of the chunk size, mid-prompt spans run cache-only chunk
+    programs, the final span is the ordinary refill program (hit = its
+    start) — harvested sequences stay bit-identical to the monolithic
+    path across chunk sizes (``tests/test_paged_attention.py``,
+    ``tests/test_engine.py``). Each per-request chunk additionally lands
+    as an ``engine/prefill_chunk`` span on the slot's trace track.
     """
 
     def __init__(
@@ -311,6 +383,7 @@ class ContinuousEngine(Engine):
         prewarm: bool = True,
         prefix_cache: bool = False,
         prefix_capacity_blocks: int = 0,
+        prefill_chunk: int = 0,
     ):
         import jax.numpy as jnp  # deferred: host module, device state here only
 
@@ -326,8 +399,16 @@ class ContinuousEngine(Engine):
         self.N = fns.max_new_tokens
         self._queue: deque = deque()
         self._slots: List[Optional[_Request]] = [None] * self.B
+        # True once the slot's FINAL prefill span ran (the refill program
+        # scattered its SlotState row: logits seeded, done=False). Chunked
+        # prefill leaves a slot unseeded — and hence outside harvest and
+        # decode-block growth — until its last span lands.
+        self._seeded: List[bool] = [False] * self.B
         self._submitted = 0
         self.stats = EngineStats()
+        self._chunk = int(prefill_chunk)
+        if self._chunk < 0:
+            raise ValueError(f"prefill_chunk {self._chunk} must be >= 0")
 
         self.spec = getattr(fns, "paged", None)
         self.allocator: Optional[BlockAllocator] = None
@@ -350,15 +431,36 @@ class ContinuousEngine(Engine):
             # upper bound on each slot's decode step (segments survived)
             self._steps_bound = [0] * self.B
             self.stats.kv_blocks_total = self.spec.max_blocks - 1
+            # gauges reflect the compute that actually RUNS: on builds
+            # without the Mosaic backend the kernels fall back to their
+            # gather references (ops/pallas_utils.has_pallas_tpu), and
+            # reporting kernel=1 / gather bytes=0 there would stamp wrong
+            # acceptance numbers into an A/B artifact
+            from trlx_tpu.ops.pallas_utils import has_pallas_tpu
+
             self.stats.decode_kernel_pallas = (
                 getattr(fns, "decode_kernel", "xla") == "pallas"
+                and has_pallas_tpu()
+            )
+            self.stats.prefill_kernel_pallas = (
+                getattr(fns, "prefill_kernel", "xla") == "pallas"
+                and has_pallas_tpu()
             )
             self._block_bytes = block_bytes(self.state.cache)
+            # per-cache-column bytes (all layers, k+v): the unit of the
+            # analytic refill gather/scatter accounting
+            self._col_bytes = self._block_bytes / max(self._bs, 1)
         elif prefix_cache:
             raise ValueError(
                 "engine.prefix_cache requires the paged KV backend "
                 "(engine.backend: paged) — dense per-slot caches cannot "
                 "share blocks"
+            )
+        elif self._chunk:
+            raise ValueError(
+                "engine.prefill_chunk requires the paged KV backend "
+                "(engine.backend: paged) — the chunk programs commit "
+                "prompt spans through the block table"
             )
         self.stats.kv_cache_bytes = kv_bytes(self.state.cache)
         # identity of the params the pool's committed KV (and hence every
@@ -401,21 +503,19 @@ class ContinuousEngine(Engine):
                 self._alloc_upto[slot] = 0
                 self._steps_bound[slot] = 0
             self._slots[slot] = None
+            self._seeded[slot] = False
         if not bool(np.asarray(self.state.done).all()):
             # freeze any still-decoding device rows from the aborted run
             self.state = self.state._replace(
                 done=self._jnp.ones((self.B,), bool)
             )
         self._adopt_params(params, version)
-        kv_cache_bytes = self.stats.kv_cache_bytes
-        prefix_enabled = self.stats.prefix_enabled
-        kv_blocks_total = self.stats.kv_blocks_total
-        decode_kernel_pallas = self.stats.decode_kernel_pallas
         self.stats = EngineStats(
-            kv_cache_bytes=kv_cache_bytes,
-            prefix_enabled=prefix_enabled,
-            kv_blocks_total=kv_blocks_total,
-            decode_kernel_pallas=decode_kernel_pallas,
+            kv_cache_bytes=self.stats.kv_cache_bytes,
+            prefix_enabled=self.stats.prefix_enabled,
+            kv_blocks_total=self.stats.kv_blocks_total,
+            decode_kernel_pallas=self.stats.decode_kernel_pallas,
+            prefill_kernel_pallas=self.stats.prefill_kernel_pallas,
         )
         if self.allocator is not None:
             # per-collection high-water, not lifetime
@@ -444,7 +544,11 @@ class ContinuousEngine(Engine):
         prefix KV under the old params must never seed a future row's
         prefill: a changed version flushes the prefix cache, exactly like
         ``begin_collection``. Returns True when the params actually
-        changed; a matching memoized version is a cheap no-op."""
+        changed; a matching memoized version is a cheap no-op. With
+        chunked prefill, a swap between a row's chunks makes its *prompt*
+        KV a bounded param-version mixture too — same contract as live
+        decode rows: the sampler's recorded behavior logprobs stay exact,
+        the mixture is what actually generated the sequence."""
         if not self._params_changed(params, version):
             self._params_version = version if version is not None else self._params_version
             return False
@@ -582,7 +686,10 @@ class ContinuousEngine(Engine):
         (the mirror must be pushed to device)."""
         dirty = False
         for slot in range(self.B):
-            if self._slots[slot] is None:
+            if self._slots[slot] is None or not self._seeded[slot]:
+                # still-prefilling slots decode nothing this segment — their
+                # prompt blocks were assigned at admission, decode blocks
+                # wait until the final span seeds them
                 continue
             need_cols = self.P + min(
                 self.N, self._steps_bound[slot] + segment_len
@@ -606,7 +713,43 @@ class ContinuousEngine(Engine):
 
     # -- the slot-refill state machine -----------------------------------
 
-    def _refill(self) -> None:
+    def _decoding(self) -> int:
+        """Slots holding a seeded (decoding or awaiting-harvest) sequence —
+        the population a prefill event stalls."""
+        return sum(
+            1
+            for s in range(self.B)
+            if self._slots[s] is not None and self._seeded[s]
+        )
+
+    def _note_prefill_event(self, waiting: int, t0: float, t1: float) -> None:
+        """Decode-stall accounting: one sample per prefill event that ran
+        while ``waiting`` seeded slots sat idle (docs/PERFORMANCE.md
+        "Chunked prefill") — under chunked scheduling no sample can exceed
+        one chunk's prefill, which is the whole point."""
+        self.stats.refill_s += t1 - t0
+        if waiting > 0:
+            self.stats.decode_stall_s += t1 - t0
+            self.stats.decode_stall_samples.append(t1 - t0)
+
+    def _note_refill_io(self, rows: int, gather_cols: int, span_cols: int) -> None:
+        """Analytic bytes of the transient dense view a gather-flavor
+        prefill program moves (pool → view on entry, written span → pool on
+        exit). The in-place prefill kernel moves none — the measured 0 the
+        ENGINE_PREFILL A/B commits."""
+        if self.stats.prefill_kernel_pallas:
+            return
+        self.stats.refill_gather_bytes += int(rows * gather_cols * self._col_bytes)
+        self.stats.refill_scatter_bytes += int(rows * span_cols * self._col_bytes)
+
+    def _admit(self) -> None:
+        """Move queued prompts into free slots. Dense backend: the whole
+        prompt prefills immediately (one grouped gather-prefill-scatter).
+        Paged backend: blocks are assigned (prefix hits → shared, rest
+        fresh) and the row's ``prefill_pos`` starts at its hit; the actual
+        prefill work runs in :meth:`_advance_prefill` — one span per step,
+        so with ``prefill_chunk`` set a long prompt is admitted instantly
+        but prefilled incrementally between decode segments."""
         free = [s for s in range(self.B) if self._slots[s] is None]
         if not free or not self._queue:
             return
@@ -617,10 +760,12 @@ class ContinuousEngine(Engine):
                 break
             req = self._queue.popleft()
             self._slots[slot] = req
+            self._seeded[slot] = False
             rows.append(req)
             slots.append(slot)
-        t0 = time.perf_counter()
         if self.spec is None:
+            waiting = self._decoding()
+            t0 = time.perf_counter()
             # gather-prefill-scatter: only the fresh rows run the prefill
             # (bucketed to a power of two inside refill_rows)
             self.state = self.fns.refill_rows(
@@ -631,51 +776,139 @@ class ContinuousEngine(Engine):
                 np.asarray(slots, np.int32),
                 np.stack([r.key for r in rows]),
             )
+            t1 = time.perf_counter()
             self.stats.refill_prefills += 1
             self.stats.prefill_tokens += self.P * len(rows)
-        else:
-            self._refill_paged(rows, slots)
-        t1 = time.perf_counter()
-        for req in rows:
-            # lifecycle bookkeeping: the whole refill event bounds each
-            # row's prefill window (per-bucket sub-calls are not split out)
-            req.t_refill0 = t0
-            req.t_refill1 = t1
-            self.stats.queue_wait_s += max(t0 - req.t_enqueue, 0.0)
-        self.stats.refill_s += t1 - t0
+            self._note_prefill_event(waiting, t0, t1)
+            for req, slot in zip(rows, slots):
+                req.t_refill0 = t0
+                req.t_refill1 = t1
+                self._seeded[slot] = True
+                self.stats.queue_wait_s += max(t0 - req.t_enqueue, 0.0)
+            self.stats.refilled_rows += len(rows)
+            return
+        for req, slot in zip(rows, slots):
+            hit = self._prepare_row(req, slot)
+            pos0 = hit
+            if self._chunk:
+                # skip all-masked leading pad columns: they are never
+                # attention-visible (slot mask 0 → exact-0.0 softmax
+                # terms), so committing their K/V is pure waste — start
+                # chunking at the chunk-grid point at or below the first
+                # real column (the final span must stay non-empty, hence
+                # the (P-1) clamp for degenerate all-pad rows)
+                first_real = self.P - int(np.sum(req.attention_mask))
+                pos0 = max(
+                    hit,
+                    min(
+                        (first_real // self._chunk) * self._chunk,
+                        ((self.P - 1) // self._chunk) * self._chunk,
+                    ),
+                )
+            req.prefill_pos = pos0
+            self.stats.prefix_tokens_saved += hit
         self.stats.refilled_rows += len(rows)
+        self._note_block_usage()
 
-    def _refill_paged(self, rows: List["_Request"], slots: List[int]) -> None:
-        """Paged refill: assign blocks (prefix hits → shared, rest fresh),
-        then one refill program per distinct hit length. Matching runs
-        against the cache as-is and insertion strictly AFTER the program
-        calls: blocks written by THIS refill event are not yet committed
-        when sibling rows gather their views."""
-        hits = [self._prepare_row(req, slot) for req, slot in zip(rows, slots)]
-        by_hit: Dict[int, List[int]] = {}
-        for i, h in enumerate(hits):
-            by_hit.setdefault(h, []).append(i)
-        for hit, idxs in sorted(by_hit.items()):
-            self.state = self.fns.refill_rows(
-                self.params,
-                self.state,
-                np.stack([rows[i].input_ids for i in idxs]),
-                np.stack([rows[i].attention_mask for i in idxs]),
-                np.asarray([slots[i] for i in idxs], np.int32),
-                np.stack([rows[i].key for i in idxs]),
-                table_rows=np.stack([self._tables[slots[i]] for i in idxs]),
-                hit=hit,
-            )
+    def _next_span(self, pos: int) -> int:
+        """End column of the prefill span starting at ``pos``: the whole
+        remaining prompt when chunking is off, else up to the next
+        ABSOLUTE multiple of the chunk size — prompts admitted at
+        different prefix-hit offsets converge onto one span grid after
+        their first chunk, so sibling rows group into one program and the
+        compiled-span variety stays bounded."""
+        if not self._chunk:
+            return self.P
+        return min(self.P, (pos // self._chunk + 1) * self._chunk)
+
+    def _advance_prefill(self) -> None:
+        """Run ONE prefill span for every still-prefilling slot, grouped by
+        identical (start, end): mid-prompt spans run the cache-only chunk
+        program; a span reaching ``P`` runs the ordinary refill program
+        with ``hit = start`` (columns below it are committed — by prefix
+        hits, earlier chunks, or both) and seeds the slot for decode.
+        Prefix-cache insertion stays strictly AFTER the program calls of
+        the event, exactly like the monolithic refill."""
+        pending = [
+            (s, self._slots[s].prefill_pos)
+            for s in range(self.B)
+            if self._slots[s] is not None
+            and self._slots[s].prefill_pos is not None
+        ]
+        if not pending:
+            return
+        waiting = self._decoding()
+        by_span: Dict[tuple, List[int]] = {}
+        for slot, pos in pending:
+            by_span.setdefault((pos, self._next_span(pos)), []).append(slot)
+        finished: List[int] = []
+        for (start, end), slots in sorted(by_span.items()):
+            rows = [self._slots[s] for s in slots]
+            t0 = time.perf_counter()
+            if end < self.P:
+                self.state = self.fns.prefill_chunk_rows(
+                    self.params,
+                    self.state,
+                    np.stack([r.input_ids for r in rows]),
+                    np.stack([r.attention_mask for r in rows]),
+                    np.stack([self._tables[s] for s in slots]),
+                    start=start,
+                    end=end,
+                )
+                self.stats.prefill_chunk_calls += 1
+                # the chunk program's gather (start > 0) covers the full
+                # S-wide view — key width matches the monolithic pass for
+                # bit-parity (ops/slot_refill.py chunk-program docstring)
+                self._note_refill_io(
+                    len(rows),
+                    (self.P + self.N) if start > 0 else 0,
+                    end - start,
+                )
+            else:
+                self.state = self.fns.refill_rows(
+                    self.params,
+                    self.state,
+                    np.stack([r.input_ids for r in rows]),
+                    np.stack([r.attention_mask for r in rows]),
+                    np.asarray(slots, np.int32),
+                    np.stack([r.key for r in rows]),
+                    table_rows=np.stack([self._tables[s] for s in slots]),
+                    hit=start,
+                )
+                self._note_refill_io(
+                    len(rows),
+                    (self.P + self.N) if start > 0 else 0,
+                    self.P - start,
+                )
+                finished.extend(slots)
+            t1 = time.perf_counter()
             self.stats.refill_prefills += 1
-            self.stats.prefill_tokens += (self.P - hit) * len(idxs)
-            self.stats.prefix_tokens_saved += hit * len(idxs)
-        if self.prefix is not None:
+            self.stats.prefill_tokens += (end - start) * len(rows)
+            self._note_prefill_event(waiting, t0, t1)
+            for req, slot in zip(rows, slots):
+                if req.t_refill0 == 0.0:
+                    req.t_refill0 = t0
+                    self.stats.queue_wait_s += max(t0 - req.t_enqueue, 0.0)
+                if self._tracer is not None and end < self.P:
+                    self._tracer.add_complete_event(
+                        "engine/prefill_chunk", t0, t1,
+                        track=f"engine/slot{slot}", index=req.index,
+                        start=start, end=end,
+                    )
+                if end < self.P:
+                    req.prefill_pos = end
+                else:
+                    req.prefill_pos = None
+                    req.t_refill1 = t1
+                    self._seeded[slot] = True
+        if self.prefix is not None and finished:
             # commit only blocks a later match could USE: _prepare_row caps
             # hits at (P-1)//bs (the last prompt block is always
             # recomputed), so when P is block-aligned the P//bs-th entry
             # would be permanently pinned yet never shareable
             n_full = (self.P - 1) // self._bs
-            for req, slot in zip(rows, slots):
+            for slot in finished:
+                req = self._slots[slot]
                 self.prefix.insert(
                     req.input_ids,
                     req.attention_mask,
@@ -687,7 +920,12 @@ class ContinuousEngine(Engine):
     def _harvest(self) -> List[CompletedSequence]:  # releases: row-block-ref(object)
         done = np.asarray(self.state.done)
         finished = [
-            s for s in range(self.B) if self._slots[s] is not None and done[s]
+            s
+            for s in range(self.B)
+            # unseeded (still-prefilling) slots read device done=True from
+            # their empty SlotState row — they are not finished, they have
+            # not started
+            if self._slots[s] is not None and self._seeded[s] and done[s]
         ]
         if not finished:
             return []
@@ -707,6 +945,7 @@ class ContinuousEngine(Engine):
         for j, slot in enumerate(finished):  # slot order: deterministic
             req = self._slots[slot]
             self._slots[slot] = None
+            self._seeded[slot] = False
             self._trace_request(req, slot, t_harvest)
             if self.spec is not None:
                 # free the row's block refs; blocks the prefix cache (or a
@@ -756,10 +995,16 @@ class ContinuousEngine(Engine):
         )
 
     def step(self) -> List[CompletedSequence]:
-        """One refill → segment → harvest turn; returns newly completed
-        sequences (possibly empty while long rows keep decoding)."""
-        self._refill()
-        if self.live == 0:
+        """One admit → prefill-span → segment → harvest turn; returns newly
+        completed sequences (possibly empty while long rows keep decoding).
+        With ``prefill_chunk`` set, the prefill work this step runs is at
+        most one chunk per still-prefilling slot, so live decode slots are
+        never stalled longer than one chunk's prefill before their next
+        segment (the decode-stall gauges measure exactly this)."""
+        self._admit()
+        if self.spec is not None:
+            self._advance_prefill()
+        if self._decoding() == 0:
             return []
         if self.spec is not None:
             # reserve writable blocks for the columns this segment may
@@ -792,7 +1037,7 @@ class ContinuousEngine(Engine):
         self.stats.live_slot_steps += live_steps
         if self.spec is not None:
             for slot in range(self.B):
-                if self._slots[slot] is not None:
+                if self._slots[slot] is not None and self._seeded[slot]:
                     self._steps_bound[slot] = min(
                         self.N, self._steps_bound[slot] + steps
                     )

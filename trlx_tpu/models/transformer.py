@@ -571,42 +571,65 @@ class Attention(nn.Module):
 
         paged = cache is not None and isinstance(cache, dict) and "block_table" in cache
         if paged:
-            # in-place paged decode (ops/paged_attention.py): K/V live in
+            # in-place paged attention (ops/paged_attention.py single-token
+            # decode; ops/paged_prefill.py chunked prefill): K/V live in
             # the block pool ({"k","v"} over [NB, bs, KV, D]) and this
-            # step's k/v commit straight through the per-row block table —
+            # call's k/v commit straight through the per-row block table —
             # no gathered dense view exists, before or after. Drop-mode
             # writes make poisoned (out-of-range) table rows — frozen slots,
-            # padding lanes — write nothing, mirroring scatter_steps'
-            # live-writes-only commit on the gather path.
-            if T != 1:
-                raise ValueError(
-                    "paged in-place attention is a single-token decode "
-                    f"path (got T={T}); prefill goes through the gather "
-                    "path (ops/slot_refill.py)"
-                )
+            # padding lanes — write nothing, mirroring scatter_steps'/
+            # scatter_span's live-writes-only commit on the gather path.
             table = cache["block_table"]
-            ci = jnp.asarray(cache_index)
-            if ci.ndim == 0:
-                ci = jnp.broadcast_to(ci, (B,))
+            ci = jnp.asarray(cache_index if cache_index is not None else 0)
             blk_size = cache["k"].shape[-3]
-            blk = jnp.take_along_axis(table, (ci // blk_size)[:, None], axis=1)[:, 0]
-            off = ci % blk_size
-            k_pool = cache["k"].at[blk, off].set(
-                k[:, 0].astype(cache["k"].dtype), mode="drop"
-            )
-            v_pool = cache["v"].at[blk, off].set(
-                v[:, 0].astype(cache["v"].dtype), mode="drop"
-            )
-            new_cache = {"k": k_pool, "v": v_pool, "block_table": table}
-            from trlx_tpu.ops.paged_attention import paged_attention_decode
+            if T == 1:
+                if ci.ndim == 0:
+                    ci = jnp.broadcast_to(ci, (B,))
+                blk = jnp.take_along_axis(table, (ci // blk_size)[:, None], axis=1)[:, 0]
+                off = ci % blk_size
+                k_pool = cache["k"].at[blk, off].set(
+                    k[:, 0].astype(cache["k"].dtype), mode="drop"
+                )
+                v_pool = cache["v"].at[blk, off].set(
+                    v[:, 0].astype(cache["v"].dtype), mode="drop"
+                )
+                new_cache = {"k": k_pool, "v": v_pool, "block_table": table}
+                from trlx_tpu.ops.paged_attention import paged_attention_decode
 
-            # the additive bias rows carry the full masking semantics
-            # (slot-causal + key validity + window/ALiBi) — identical to
-            # what the dense einsum path would consume. The head dim is 1
-            # (mask-only) or H (per-head ALiBi slopes) and is preserved.
-            out = paged_attention_decode(
-                q[:, 0], k_pool, v_pool, table, attention_bias[:, :, 0, :]
-            ).reshape(B, 1, H * D)
+                # the additive bias rows carry the full masking semantics
+                # (slot-causal + key validity + window/ALiBi) — identical to
+                # what the dense einsum path would consume. The head dim is 1
+                # (mask-only) or H (per-head ALiBi slopes) and is preserved.
+                out = paged_attention_decode(
+                    q[:, 0], k_pool, v_pool, table, attention_bias[:, :, 0, :]
+                ).reshape(B, 1, H * D)
+            else:
+                # prefill chunk: all rows share one static span [ci, ci+T)
+                # (the refill/chunk programs group rows per span), so the
+                # commit columns are a [T] vector broadcast over rows —
+                # every row writes its own table's blocks, shared prefix
+                # blocks sit strictly below ci and are only ever read
+                if ci.ndim != 0:
+                    raise ValueError(
+                        "paged in-place prefill takes a scalar cache_index "
+                        "(rows in one chunk program share the static span; "
+                        "per-row depths are a decode-path concept)"
+                    )
+                cols = ci + jnp.arange(T)  # [T]
+                blk = table[:, cols // blk_size]  # [B, T]
+                off = jnp.broadcast_to((cols % blk_size)[None, :], blk.shape)
+                k_pool = cache["k"].at[blk, off].set(
+                    k.astype(cache["k"].dtype), mode="drop"
+                )
+                v_pool = cache["v"].at[blk, off].set(
+                    v.astype(cache["v"].dtype), mode="drop"
+                )
+                new_cache = {"k": k_pool, "v": v_pool, "block_table": table}
+                from trlx_tpu.ops.paged_prefill import paged_prefill_attention
+
+                out = paged_prefill_attention(
+                    q, k_pool, v_pool, table, attention_bias
+                ).reshape(B, T, H * D)
             out = _dense(cfg, cfg.hidden_size, cfg.attn_bias, ("joined_kv", "embed"), "o_proj")(out)
             return out, new_cache
 
@@ -882,6 +905,21 @@ def router_aux_summary(aux: jax.Array) -> jax.Array:
     return aux[:2] / jnp.maximum(aux[2], 1.0)
 
 
+def _cache_is_paged(cache) -> bool:
+    """True when ``cache`` carries a block table (``paged_kv.attach_block_
+    table``): a per-layer list of dicts, or the scanned stacked dict."""
+    if cache is None:
+        return False
+    if isinstance(cache, dict):
+        return "block_table" in cache
+    if isinstance(cache, list):
+        return any(
+            isinstance(layer, dict) and "block_table" in layer
+            for layer in cache
+        )
+    return False
+
+
 def _query_slots(q_offset, B: int, T: int) -> jax.Array:
     """[B, T] slot indices of queries at ``q_offset`` (scalar, or [B] when
     rows sit at different cache depths — speculative decoding)."""
@@ -1137,9 +1175,18 @@ class CausalTransformer(nn.Module):
 
         x = self._embed(input_ids, positions)
         # flash kernels take a scalar slot offset; per-row cache depths
-        # (speculative decoding) go through the bias path (T is tiny there)
+        # (speculative decoding) go through the bias path (T is tiny there).
+        # Paged (block-table-carrying) caches always take the bias path too:
+        # the in-place paged kernels consume the additive bias rows, and
+        # their bit-parity reference is the dense einsum path.
         vector_ci = cache_index is not None and jnp.asarray(cache_index).ndim > 0
-        use_flash = cfg.resolved_attention_impl() == "pallas" and T > 1 and not vector_ci
+        paged_cache = _cache_is_paged(cache)
+        use_flash = (
+            cfg.resolved_attention_impl() == "pallas"
+            and T > 1
+            and not vector_ci
+            and not paged_cache
+        )
         pipe_mesh = None if self.is_initializing() else _maybe_pipeline_mesh(cfg)
         if pipe_mesh is not None:
             x, branch_input, new_cache, aux = self._pipelined_blocks(

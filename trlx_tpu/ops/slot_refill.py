@@ -121,6 +121,12 @@ class SlotRefillFns(NamedTuple):
     segment_len: int = 8  # decode steps per compiled segment
     paged: Optional[PagedSpec] = None  # None = dense per-slot cache
     decode_kernel: str = "xla"  # "pallas" = in-place paged decode kernel
+    prefill_kernel: str = "xla"  # "pallas" = in-place paged prefill kernel
+    # chunked-prefill programs (paged only): prefill a mid-prompt span
+    # [start, end) with end < P — cache-only, no SlotState row scatter
+    # (the final span [start, P) is the ordinary refill program)
+    prefill_chunk_rows: Optional[Callable[..., SlotState]] = None
+    prefill_chunk_program: Optional[Callable[..., Callable]] = None
 
 
 def _row_where(flag: jax.Array, new: Any, old: Any) -> Any:
@@ -158,6 +164,7 @@ def make_slot_refill_fns(
     jit: bool = True,
     paged: Optional[PagedSpec] = None,
     decode_kernel: str = "xla",
+    prefill_kernel: str = "xla",
 ) -> SlotRefillFns:
     """Build the (jitted) slot-refill programs for one shape bucket.
 
@@ -179,9 +186,19 @@ def make_slot_refill_fns(
     decode kernel + fused sampling (``ops/paged_attention.py``) — K/V read
     and written through the block table with no transient dense view.
     Bit-identical to the gather path by contract
-    (``tests/test_paged_attention.py``); refill prefills always take the
-    gather path (they run once per prompt — the per-segment gather is the
-    tax the kernel deletes).
+    (``tests/test_paged_attention.py``).
+
+    ``prefill_kernel`` selects the paged *refill prefill* compute
+    (``engine.prefill_kernel``): ``"xla"`` is the gather → dense prefill →
+    scatter reference; ``"pallas"`` runs the in-place paged-prefill kernel
+    (``ops/paged_prefill.py`` via ``models/transformer.py``) — the chunk's
+    K/V committed through the block table with no dense view on entry and
+    no scatter on exit, bit-identical to the gather path by contract.
+    With it (or without — the chunk programs exist for both flavors), the
+    ``prefill_chunk_rows`` programs prefill a mid-prompt span
+    ``[start, end)``, ``end < P``, committing K/V only: the host engine
+    interleaves these with decode segments (``engine.prefill_chunk``) so a
+    long prompt never stalls live decode slots longer than one chunk.
     """
     if decode_kernel not in ("xla", "pallas"):
         raise ValueError(
@@ -191,6 +208,16 @@ def make_slot_refill_fns(
         raise ValueError(
             "decode_kernel: pallas is the in-place *paged* decode kernel — "
             "it requires the paged KV backend (engine.backend: paged)"
+        )
+    if prefill_kernel not in ("xla", "pallas"):
+        raise ValueError(
+            f"unknown prefill_kernel '{prefill_kernel}' (xla | pallas)"
+        )
+    if prefill_kernel == "pallas" and paged is None:
+        raise ValueError(
+            "prefill_kernel: pallas is the in-place *paged* prefill kernel "
+            "(ops/paged_prefill.py) — it requires the paged KV backend "
+            "(engine.backend: paged)"
         )
     if not config.per_row_rng:
         config = dataclasses.replace(config, per_row_rng=True)
@@ -283,7 +310,16 @@ def make_slot_refill_fns(
             slot_mask_r = jnp.concatenate(
                 [prompt_mask, jnp.zeros((R, N), jnp.int32)], axis=1
             )
-            if paged is not None and hit > 0:
+            if paged is not None and prefill_kernel == "pallas":
+                # in-place paged prefill (ops/paged_prefill.py via the
+                # model's paged branch): the suffix's K/V commits through
+                # the table and attention reads pool blocks straight into
+                # VMEM — no dense view exists, before or after. Committed
+                # prefix blocks (hit > 0, or earlier prefill chunks) are
+                # read in place; everything else is bias-masked to an
+                # exact-0.0 softmax contribution.
+                row_cache = attach_block_table(state.cache.pool, table_rows)
+            elif paged is not None and hit > 0:
                 # dense view of the refilled rows: shared prefix blocks hold
                 # committed values; everything else reads the zero block or
                 # recycled slots the mask keeps out of attention (masked
@@ -319,12 +355,18 @@ def make_slot_refill_fns(
                 return big.at[:, slot_idx].set(rows.astype(big.dtype), mode="drop")
 
             if paged is not None:
-                # commit the recomputed span [hit, P) and point the slots'
-                # table rows at their (shared + fresh) blocks
-                new_cache = PagedKV(
-                    pool=scatter_span(
+                if prefill_kernel == "pallas":
+                    # the forward already committed the span [hit, P) into
+                    # the pool through the table (drop-mode writes inside
+                    # the model's paged branch) — nothing to scatter
+                    new_pool = detach_block_table(out["cache"])
+                else:
+                    # commit the recomputed span [hit, P) from the dense view
+                    new_pool = scatter_span(
                         state.cache.pool, table_rows, out["cache"], hit, P - hit
-                    ),
+                    )
+                new_cache = PagedKV(
+                    pool=new_pool,
                     block_table=state.cache.block_table.at[slot_idx].set(
                         table_rows, mode="drop"
                     ),
@@ -367,6 +409,115 @@ def make_slot_refill_fns(
             _refill_cache[(bucket, hit)] = jax.jit(fn) if jit else fn
         return _refill_cache[(bucket, hit)]
 
+    def _make_prefill_chunk(R: int, start: int, end: int):
+        def prefill_chunk(
+            params: Any,
+            state: SlotState,
+            input_ids: jax.Array,  # [R, P] left-padded fresh prompts
+            prompt_mask: jax.Array,  # [R, P]
+            table_rows: jax.Array,  # [R, TB] the rows' block tables
+        ) -> SlotState:
+            """Prefill the mid-prompt span ``[start, end)`` of ``R`` rows,
+            committing K/V into their pool blocks only — no logits, no
+            SlotState row scatter (the rows stay empty/done until the final
+            span ``[x, P)`` runs the ordinary refill program and seeds the
+            sampler). Keys keep the FULL cache width ``S`` with columns
+            ``>= end`` masked out: not-yet-prefilled (and response-region)
+            columns contribute exact-0.0 softmax terms, and keeping the
+            key width identical to the monolithic pass's keeps the score
+            dots' shapes identical too — truncating the key axis changes
+            the dot's lowering at some shapes (1-ulp contraction drift,
+            same genre as the kernel's batch-dim landmine), which would
+            break the chunked ≡ unchunked bit-parity the suite pins.
+            Tables are taken as an argument (host mirror) — the device
+            block-table rows of still-prefilling slots are stale by
+            design."""
+            input_ids = input_ids.astype(jnp.int32)
+            prompt_mask = prompt_mask.astype(jnp.int32)
+            # visibility: committed prompt columns [0, end) only
+            span_mask = prompt_mask * (jnp.arange(P)[None, :] < end)
+            key_mask = jnp.concatenate(
+                [span_mask, jnp.zeros((R, N), jnp.int32)], axis=1
+            )
+            if prefill_kernel == "pallas":
+                row_cache = attach_block_table(state.cache.pool, table_rows)
+            elif start > 0:
+                row_cache = gather_view(state.cache.pool, table_rows, S)
+            else:
+                # first chunk: nothing committed below column 0 — a zero
+                # cache is equivalent and skips the gather (the cold-refill
+                # shortcut)
+                row_cache = init_cache_fn(R, S)
+            out = apply_fn(
+                params,
+                input_ids[:, start:end],
+                attention_mask=key_mask,
+                positions=None,
+                cache=row_cache,
+                cache_index=jnp.asarray(start, jnp.int32),
+                logits_span=(0, 0),  # mid-prompt: no sampler to seed
+            )
+            if prefill_kernel == "pallas":
+                pool = detach_block_table(out["cache"])
+            else:
+                pool = scatter_span(
+                    state.cache.pool, table_rows, out["cache"], start,
+                    end - start,
+                )
+            return state._replace(
+                cache=PagedKV(pool, state.cache.block_table)
+            )
+
+        return prefill_chunk
+
+    _chunk_cache: Dict[Tuple[int, int, int], Callable] = {}
+
+    def prefill_chunk_program(bucket: int, start: int, end: int) -> Callable:
+        """The compiled mid-chunk prefill program for one (bucket, span)
+        triple. Spans are engine-aligned to absolute multiples of the
+        chunk size (plus block-aligned prefix-hit starts), so the variant
+        count stays bounded; they compile lazily on first use — their set
+        depends on the prompt stream and ``engine.prefill_chunk``."""
+        if paged is None:
+            raise ValueError(
+                "chunked prefill requires the paged KV backend "
+                "(engine.backend: paged) — dense per-slot caches have no "
+                "span-committing chunk program"
+            )
+        if not 0 <= start < end < P:
+            raise ValueError(
+                f"mid-chunk span [{start}, {end}) must sit strictly inside "
+                f"the prompt region [0, {P}) — the final span is the "
+                "refill program"
+            )
+        if (bucket, start, end) not in _chunk_cache:
+            fn = _make_prefill_chunk(bucket, start, end)
+            _chunk_cache[(bucket, start, end)] = jax.jit(fn) if jit else fn
+        return _chunk_cache[(bucket, start, end)]
+
+    def prefill_chunk_rows(
+        params: Any,
+        state: SlotState,
+        input_ids: Any,  # [r, P] host or device rows, r <= B
+        prompt_mask: Any,
+        table_rows: Any,  # [r, TB]
+        start: int,
+        end: int,
+    ) -> SlotState:
+        """Host wrapper for one mid-chunk span: the shared bucket+pad
+        protocol (``_bucket_pad`` — padding rows carry all-out-of-range
+        tables, so their commits drop), then the cached compiled program."""
+        bucket, _, input_ids, prompt_mask, table_rows = _bucket_pad(
+            input_ids, prompt_mask, table_rows
+        )
+        return prefill_chunk_program(bucket, start, end)(
+            params,
+            state,
+            jnp.asarray(input_ids),
+            jnp.asarray(prompt_mask),
+            jnp.asarray(table_rows),
+        )
+
     def prewarm(params: Any, state: SlotState) -> SlotState:
         """Compile every cold (hit = 0) refill bucket with dropped no-op
         calls (all ``slot_idx = B``) so a collection's completion pattern
@@ -405,6 +556,45 @@ def make_slot_refill_fns(
         _warmed["done"] = True
         return state
 
+    def _bucket_pad(input_ids: Any, prompt_mask: Any, table_rows: Any):
+        """The shared bucket+pad protocol behind the refill and chunk host
+        wrappers: round ``r`` up to the next power-of-two bucket; padding
+        rows carry pad tokens, all-zero masks, and ``max_blocks``-poisoned
+        block tables (every commit drops). Returns
+        ``(bucket, pad, input_ids, prompt_mask, table_rows)``."""
+        import numpy as np
+
+        input_ids = np.asarray(input_ids, np.int32)
+        prompt_mask = np.asarray(prompt_mask, np.int32)
+        if table_rows is not None:
+            table_rows = np.asarray(table_rows, np.int32)
+        r = input_ids.shape[0]
+        bucket = 1
+        while bucket < r:
+            bucket *= 2
+        bucket = min(bucket, max(B, 1))
+        if bucket < r:  # r > B cannot happen (more rows than slots)
+            raise ValueError(f"refilling {r} rows into {B} slots")
+        pad = bucket - r
+        if pad:
+            input_ids = np.concatenate(
+                [input_ids, np.full((pad, P), config.pad_token_id, np.int32)]
+            )
+            prompt_mask = np.concatenate(
+                [prompt_mask, np.zeros((pad, P), np.int32)]
+            )
+            if table_rows is not None:
+                table_rows = np.concatenate(
+                    [
+                        table_rows,
+                        np.full(
+                            (pad, table_rows.shape[1]), paged.max_blocks,
+                            np.int32,
+                        ),
+                    ]
+                )
+        return bucket, pad, input_ids, prompt_mask, table_rows
+
     def refill_rows(
         params: Any,
         state: SlotState,
@@ -421,38 +611,16 @@ def make_slot_refill_fns(
         prefill cost stays within 2× of the rows actually refilled."""
         import numpy as np
 
-        input_ids = np.asarray(input_ids, np.int32)
-        prompt_mask = np.asarray(prompt_mask, np.int32)
         slot_idx = np.asarray(slot_idx, np.int32)
         new_keys = np.asarray(new_keys)
-        r = input_ids.shape[0]
-        bucket = 1
-        while bucket < r:
-            bucket *= 2
-        bucket = min(bucket, max(B, 1))
-        if bucket < r:  # r > B cannot happen (more rows than slots)
-            raise ValueError(f"refilling {r} rows into {B} slots")
-        if paged is not None:
-            table_rows = np.asarray(table_rows, np.int32)
-        if bucket > r:
-            pad = bucket - r
-            input_ids = np.concatenate(
-                [input_ids, np.full((pad, P), config.pad_token_id, np.int32)]
-            )
-            prompt_mask = np.concatenate([prompt_mask, np.zeros((pad, P), np.int32)])
+        bucket, pad, input_ids, prompt_mask, table_rows = _bucket_pad(
+            input_ids, prompt_mask, table_rows if paged is not None else None
+        )
+        if pad:
             slot_idx = np.concatenate([slot_idx, np.full((pad,), B, np.int32)])
             new_keys = np.concatenate(
                 [new_keys, np.zeros((pad, 2), new_keys.dtype)]
             )
-            if paged is not None:
-                table_rows = np.concatenate(
-                    [
-                        table_rows,
-                        np.full(
-                            (pad, table_rows.shape[1]), paged.max_blocks, np.int32
-                        ),
-                    ]
-                )
         args = [
             params, state, jnp.asarray(input_ids), jnp.asarray(prompt_mask),
             jnp.asarray(slot_idx), jnp.asarray(new_keys),
@@ -622,4 +790,9 @@ def make_slot_refill_fns(
         segment_len=segment_len,
         paged=paged,
         decode_kernel=decode_kernel,
+        prefill_kernel=prefill_kernel,
+        prefill_chunk_rows=prefill_chunk_rows if paged is not None else None,
+        prefill_chunk_program=(
+            prefill_chunk_program if paged is not None else None
+        ),
     )

@@ -28,7 +28,14 @@ Exactness properties (tested in ``tests/test_speculative.py``):
   :func:`trlx_tpu.ops.sampling.generate` (behavior logprob of the chosen
   token under the unfiltered target distribution; value of the state the
   token was sampled from), so PPO's ``make_experience`` is agnostic to
-  which sampler produced the rollout.
+  which sampler produced the rollout;
+- ``per_row_rng=True`` threads [B, 2] per-row key chains through every
+  draw site (draft proposals, acceptance uniforms, residual/bonus), so a
+  batched run is BIT-IDENTICAL per row to running each row alone with its
+  chain — batch composition invariance, the property continuous batching
+  needs to host a speculative slot (ROADMAP item 2's named blocker,
+  removed). The per-row sampled streams differ from the batch-wide mode's
+  by construction; both are exact draws from the target distribution.
 
 Transition logit masks (the trainer's ``logit_mask``, e.g. randomwalks'
 allowed-moves table) compose natively: the mask is applied to the draft AND
@@ -52,7 +59,9 @@ from trlx_tpu.ops.sampling import (
     GenerationConfig,
     GenerationOutput,
     apply_transition_mask,
+    per_row_keys,
     process_logits,
+    split_row_keys,
 )
 
 
@@ -85,15 +94,28 @@ def accept_and_extra(
     (machine-checked against enumerated distributions in
     ``tests/test_speculative.py::test_acceptance_rule_is_distribution_exact``).
     Greedy: accept iff ``d_i == argmax p_{i-1}``; extra = ``argmax p_k``.
+
+    ``rng`` may be one batch-wide key (``[2]``, historical behavior) or a
+    ``[B, 2]`` stack of per-row key chains (``per_row_rng``): each row then
+    draws its acceptance uniforms and residual/bonus token from its OWN
+    chain — one ``split_row_keys`` advance per draw site, so a row's
+    stream depends only on (its chain, its round), never on batch
+    composition. That is what makes a batched per-row run bit-identical to
+    running each row alone (the B=1-loop parity test).
     """
     B, G = d_toks.shape
+    per_row = rng.ndim == 2
     q_sel = jnp.take_along_axis(q_probs, d_toks[..., None], axis=-1)[..., 0]
     p_sel = jnp.take_along_axis(
         p_probs[:, :G, :], d_toks[..., None], axis=-1
     )[..., 0]  # p_{i-1}(d_i)
     if do_sample:
-        rng, ru = jax.random.split(rng)
-        u = jax.random.uniform(ru, (B, G))
+        if per_row:
+            rng, ru = split_row_keys(rng)
+            u = jax.vmap(lambda kk: jax.random.uniform(kk, (G,)))(ru)
+        else:
+            rng, ru = jax.random.split(rng)
+            u = jax.random.uniform(ru, (B, G))
         # strict <: u ∈ [0,1) can be exactly 0, and `0·q <= 0` would accept
         # a token with ZERO target probability. Accept iff u < p/q.
         accept = u * q_sel < p_sel
@@ -115,10 +137,17 @@ def accept_and_extra(
             res_at_k / jnp.maximum(res_sum, 1e-20),
             p_row_at_k,
         )
-        rng, re = jax.random.split(rng)
-        extra_tok = jax.random.categorical(
-            re, jnp.log(jnp.maximum(extra_dist, 1e-30)), axis=-1
-        ).astype(jnp.int32)
+        extra_logits = jnp.log(jnp.maximum(extra_dist, 1e-30))
+        if per_row:
+            rng, re = split_row_keys(rng)
+            extra_tok = jax.vmap(
+                lambda kk, row: jax.random.categorical(kk, row)
+            )(re, extra_logits).astype(jnp.int32)
+        else:
+            rng, re = jax.random.split(rng)
+            extra_tok = jax.random.categorical(
+                re, extra_logits, axis=-1
+            ).astype(jnp.int32)
     else:
         # greedy: the target would deterministically pick argmax p_k
         extra_tok = jnp.argmax(p_row_at_k, axis=-1).astype(jnp.int32)
@@ -159,26 +188,22 @@ def generate_speculative(
     static ``config``/``gamma``.
     """
     B, P = input_ids.shape
-    if config.per_row_rng and B > 1:
-        # A single row is exempt: per-row chains exist to make a row's
-        # sample stream independent of batch composition and slot
-        # position, and with n_rows == 1 there is no other row to depend
-        # on — the shared stream already carries the per-row guarantee
-        # (greedy outputs are bit-identical either way; sampled streams
-        # are both exact draws from the target distribution). That is the
-        # seam the speculative × continuous-batching composition grows
-        # through: single-slot speculative decode inside a slot engine.
-        raise ValueError(
-            "gen_kwargs.per_row_rng=True (implied by "
-            "train.continuous_batching) is incompatible with speculative "
-            f"decoding (model.draft_model_path) at batch size {B}: the "
-            "accept/reject stream consumes one batch-wide uniform draw "
-            "per ROUND (a variable number of committed tokens), so there "
-            "is no per-step per-row key chain that reproduces plain "
-            "generate's stream row-independently. Drop "
-            "model.draft_model_path, set per_row_rng=False, or generate "
-            "row-by-row (n_rows == 1 is accepted)."
-        )
+    per_row = bool(config.per_row_rng)
+    if per_row:
+        # Per-row key chains (the continuous-batching composition seam,
+        # ROADMAP item 2): every rng consumer below — each round's G draft
+        # proposals, the acceptance uniforms, the residual/bonus draw —
+        # advances a [B, 2] per-row chain by a FIXED number of
+        # split_row_keys steps per round, so a row's sample stream depends
+        # only on (its chain start, its round index), never on batch
+        # composition. Rounds are batch-synchronized (done rows burn
+        # rounds without touching their committed outputs), hence a
+        # batched run is BIT-IDENTICAL per row to running that row alone
+        # with its chain (tests/test_speculative.py B=1-loop parity).
+        # ``rng`` may be one key (chains derived via per_row_keys — the
+        # plain sampler's convention) or an already-stacked [B, 2] chain
+        # set (the slot engine's convention).
+        rng = per_row_keys(rng, B) if jnp.asarray(rng).ndim == 1 else rng
     N = config.max_new_tokens
     G = gamma
     NB = N + G + 1  # token buffer padded so block writes never clip
@@ -250,11 +275,20 @@ def generate_speculative(
                     logits_j,
                 )
             probs_j = _filtered_probs(logits_j, config)
-            rng, rj = jax.random.split(rng)
+            if per_row:
+                rng, rj = split_row_keys(rng)
+            else:
+                rng, rj = jax.random.split(rng)
             if config.do_sample:
-                tok_r = jax.random.categorical(
-                    rj, jnp.log(jnp.maximum(probs_j, 1e-30)), axis=-1
-                ).astype(jnp.int32)
+                log_probs_j = jnp.log(jnp.maximum(probs_j, 1e-30))
+                if per_row:
+                    tok_r = jax.vmap(
+                        lambda kk, row: jax.random.categorical(kk, row)
+                    )(rj, log_probs_j).astype(jnp.int32)
+                else:
+                    tok_r = jax.random.categorical(
+                        rj, log_probs_j, axis=-1
+                    ).astype(jnp.int32)
             else:
                 tok_r = jnp.argmax(probs_j, axis=-1).astype(jnp.int32)
             if q_probs is None:

@@ -66,13 +66,30 @@ def _engine_programs(config: TRLConfig) -> Tuple[str, ...]:
     """The rollout programs ``train.continuous_batching`` adds, resolved
     from the engine config — the single selection point for
     ``_config_programs`` and ``hot_program_costs`` (a new engine program
-    variant must be added exactly here)."""
+    variant must be added exactly here). Paged program names compose from
+    the two kernel knobs: the refill prefill is ``paged_refill`` (gather →
+    dense prefill → scatter) or ``paged_prefill_kernel`` (the in-place
+    Pallas prefill, ops/paged_prefill.py — no dense view in the program);
+    ``engine.prefill_chunk`` adds the mid-chunk cache-only program
+    ``paged_prefill_chunk``; the decode segment is ``paged_decode`` or
+    ``paged_decode_kernel``."""
     if not bool(getattr(config.train, "continuous_batching", False)):
         return ()
     if config.engine.backend == "paged":
-        if config.engine.decode_kernel == "pallas":
-            return PAGED_KERNEL_PROGRAMS
-        return PAGED_ENGINE_PROGRAMS
+        refill = (
+            "paged_prefill_kernel"
+            if config.engine.prefill_kernel == "pallas"
+            else "paged_refill"
+        )
+        decode = (
+            "paged_decode_kernel"
+            if config.engine.decode_kernel == "pallas"
+            else "paged_decode"
+        )
+        progs = (refill,)
+        if int(getattr(config.engine, "prefill_chunk", 0)):
+            progs = progs + ("paged_prefill_chunk",)
+        return progs + (decode,)
     return CONTINUOUS_BATCHING_PROGRAMS
 
 
@@ -280,6 +297,7 @@ def hot_program_costs(
             CONTINUOUS_BATCHING_PROGRAMS
             + PAGED_ENGINE_PROGRAMS
             + PAGED_KERNEL_PROGRAMS
+            + ("paged_prefill_kernel", "paged_prefill_chunk")
         )
         if any(p in programs for p in cb_all):
             # the continuous-batching rollout programs: the on-demand refill
@@ -302,7 +320,8 @@ def hot_program_costs(
             )
             fns = trainer._get_slot_refill_fns(gen_config, (), B, P, seg)
             state_sds = jax.eval_shape(fns.init_state)
-            if "cb_refill" in programs or "paged_refill" in programs:
+            refill_names = ("cb_refill", "paged_refill", "paged_prefill_kernel")
+            if any(p in programs for p in refill_names):
                 # the full-bucket (R = B) cold refill program: worst-case
                 # refill cost; smaller buckets / prefix hits are cheaper
                 refill_args = [
@@ -315,11 +334,33 @@ def hot_program_costs(
                 ]
                 name = "cb_refill"
                 if fns.paged is not None:
-                    name = "paged_refill"
+                    name = (
+                        "paged_prefill_kernel"
+                        if getattr(fns, "prefill_kernel", "xla") == "pallas"
+                        else "paged_refill"
+                    )
                     TB = state_sds.cache.block_table.shape[1]
                     refill_args.append(SDS((B, TB), np.int32))
                 results[name] = _costs_of(
                     fns.refill_program(B).lower(*refill_args)
+                )
+            if "paged_prefill_chunk" in programs:
+                # one mid-chunk cache-only program at the configured chunk
+                # size: span [0, chunk) over the full bucket — the program
+                # the chunked-prefill scheduler dispatches between decode
+                # segments (no logits, no SlotState row scatter)
+                chunk = min(
+                    max(int(config.engine.prefill_chunk), 1), max(P - 1, 1)
+                )
+                TB = state_sds.cache.block_table.shape[1]
+                results["paged_prefill_chunk"] = _costs_of(
+                    fns.prefill_chunk_program(B, 0, chunk).lower(
+                        params,
+                        state_sds,
+                        batch_sds((B, P), np.int32),
+                        batch_sds((B, P), np.int32),
+                        SDS((B, TB), np.int32),
+                    )
                 )
             if (
                 "cb_segment" in programs
@@ -497,6 +538,27 @@ def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
                 engine=dict(
                     backend="paged", kv_block_size=8, prefix_cache=True,
                     decode_kernel="pallas",
+                ),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "gpt2_test_paged_prefill": (
+            # the fully in-place paged engine with chunked-prefill
+            # scheduling: paged_prefill_kernel (refill prefill through the
+            # block table, no dense view — ops/paged_prefill.py),
+            # paged_prefill_chunk (the mid-chunk cache-only span program
+            # the scheduler interleaves with decode segments), and
+            # paged_decode_kernel. Together with gpt2_test_paged this is
+            # the standing program-level record that the prefill kernel
+            # path carries no pool-sized gather/scatter temporaries.
+            base.evolve(
+                train=dict(continuous_batching=True),
+                model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+                engine=dict(
+                    backend="paged", kv_block_size=8, prefix_cache=True,
+                    decode_kernel="pallas", prefill_kernel="pallas",
+                    prefill_chunk=8,
                 ),
             ),
             dict(batch_size=8, prompt_len=32, gen_len=16),

@@ -174,6 +174,31 @@ class TPUBaseTrainer(BaseRLTrainer):
                 "decode kernel (ops/paged_attention.py) — it requires "
                 "engine.backend: paged"
             )
+        if config.engine.prefill_kernel not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown engine.prefill_kernel "
+                f"'{config.engine.prefill_kernel}' (xla | pallas)"
+            )
+        if (
+            config.engine.prefill_kernel == "pallas"
+            and config.engine.backend != "paged"
+        ):
+            raise ValueError(
+                "engine.prefill_kernel: pallas is the in-place *paged* "
+                "prefill kernel (ops/paged_prefill.py) — it requires "
+                "engine.backend: paged"
+            )
+        if int(config.engine.prefill_chunk) < 0:
+            raise ValueError(
+                f"engine.prefill_chunk {config.engine.prefill_chunk} "
+                "must be >= 0 (0 = monolithic prefill)"
+            )
+        if int(config.engine.prefill_chunk) and config.engine.backend != "paged":
+            raise ValueError(
+                "engine.prefill_chunk (chunked-prefill scheduling) "
+                "requires engine.backend: paged — the chunk programs "
+                "commit prompt spans through the block table"
+            )
         self.mesh = make_mesh(config.parallel)
         set_global_mesh(self.mesh)  # model code reads this for sequence-parallel ops
         # NOTE: the global mesh is process-wide; entry points re-assert it so
@@ -910,9 +935,13 @@ class TPUBaseTrainer(BaseRLTrainer):
         if self.draft_module is not None:
             raise NotImplementedError(
                 "train.continuous_batching and speculative decoding "
-                "(model.draft_model_path) are mutually exclusive: the "
-                "accept/reject stream is not per-row-RNG invariant. Drop "
-                "one of the two."
+                "(model.draft_model_path) are not composed yet: the "
+                "sampler now supports per-row RNG chains "
+                "(ops/speculative.py, per_row_rng=True), but the slot "
+                "engine has no speculative decode-segment program — "
+                "rounds commit a variable number of tokens per row, which "
+                "the fixed-size segment decode does not express. Drop one "
+                "of the two (ROADMAP item 2 tracks the composition)."
             )
         import dataclasses as _dc
 
@@ -921,9 +950,12 @@ class TPUBaseTrainer(BaseRLTrainer):
         decode_kernel = (
             self.config.engine.decode_kernel if paged is not None else "xla"
         )
+        prefill_kernel = (
+            self.config.engine.prefill_kernel if paged is not None else "xla"
+        )
         key = (
             "slot_refill", gen_config, extra_kwargs, batch_size, prompt_len,
-            segment_len, paged, decode_kernel,
+            segment_len, paged, decode_kernel, prefill_kernel,
         )
         if key not in self._generate_fns:
             from trlx_tpu.ops.slot_refill import make_slot_refill_fns
@@ -941,6 +973,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                 params_example=self.state.params,
                 paged=paged,
                 decode_kernel=decode_kernel,
+                prefill_kernel=prefill_kernel,
             )
         return self._generate_fns[key]
 

@@ -750,6 +750,9 @@ class PPOTrainer(TPUBaseTrainer):
                 tracer=self.obs.tracer,
                 prefix_cache=self._prefix_cache_enabled(),
                 prefix_capacity_blocks=int(self.config.engine.prefix_cache_blocks),
+                # chunked-prefill scheduling: long prompts admit instantly
+                # and prefill one span per step between decode segments
+                prefill_chunk=int(self.config.engine.prefill_chunk),
             )
             self._generate_fns[key] = engine
         engine.begin_collection(
