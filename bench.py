@@ -600,6 +600,11 @@ def main():
             async_rl=dict(
                 enabled=True, mode="thread", num_actors=1,
                 max_staleness=updates_per_cycle,
+                # default to the collective fleet transport so the headline
+                # measures the dissemination tree (BENCH_ASYNC_TRANSPORT=file
+                # falls back to the in-memory/file channel); the committed
+                # file-vs-collective A/B is benchmarks/ASYNC_TRANSPORT_cpu.json
+                transport=os.environ.get("BENCH_ASYNC_TRANSPORT", "collective"),
             ),
             method=dict(iw_correction="clip"),
         )
@@ -827,6 +832,16 @@ def main():
     line["actor_idle_frac"] = round(float(idle), 4) if idle is not None else None
     stale = trainer.make_experience_stats.get("async/staleness_mean")
     line["mean_staleness"] = round(float(stale), 4) if stale is not None else None
+    # collective fleet-transport gauges (docs/ASYNC_RL.md "Transports"):
+    # ack-measured dissemination-tree latency and the learner's delta-publish
+    # egress for the last cycle's collection; null unless BENCH_ASYNC=1 with
+    # the collective transport
+    diss = trainer.make_experience_stats.get("async/dissemination_latency_s")
+    line["dissemination_latency_s"] = (
+        round(float(diss), 6) if diss is not None else None
+    )
+    pub = trainer.make_experience_stats.get("async/publish_bytes")
+    line["publish_bytes"] = int(pub) if pub is not None else None
     # resilience proof (docs/RESILIENCE.md): "ok" when the warmup cycle's
     # injected reward outage was retried away AND the injected NaN step left
     # the weights finite (update guard); null when BENCH_FAULTS=0

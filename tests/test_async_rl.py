@@ -233,6 +233,87 @@ class TestWeightChannel:
         assert ready == [True]
 
 
+class TestFileChannelFetchDeadline:
+    """``FileWeightChannel.fetch``'s retry is DEADLINE-based: a healthy but
+    slow writer (a model-scale npz write outlasting the old fixed 50 ×
+    poll ≈ 1 s budget) must not crash the actor with "writer dead"."""
+
+    def test_slow_writer_within_deadline_succeeds(self, tmp_path):
+        from trlx_tpu.async_rl.channel import FileWeightChannel
+
+        root = str(tmp_path / "weights")
+        writer = FileWeightChannel(root, poll_interval_s=0.01)
+        reader = FileWeightChannel(root, poll_interval_s=0.01)
+        # manifest promises version 1 while the payload still carries 0 —
+        # exactly what a reader sees while the writer's npz replace is in
+        # flight; the writer lands 2s in, far past the old 50-attempt cap
+        writer.publish({"w": np.zeros(4)}, version=0, force=True)
+        writer._write_manifest({"version": 1, "target": 0})
+        done = []
+
+        def land_late():
+            time.sleep(2.0)
+            manifest = writer._read_manifest()
+            writer._write_manifest({**manifest, "version": 0})  # heal below
+            writer.publish({"w": np.ones(4)}, version=1, force=True)
+            done.append(True)
+
+        t = threading.Thread(target=land_late, daemon=True)
+        t.start()
+        params, version = reader.fetch(template={"w": np.zeros(4)})
+        t.join(timeout=10)
+        assert done and version == 1
+        np.testing.assert_array_equal(params["w"], np.ones(4))
+
+    def test_dead_writer_raises_after_deadline(self, tmp_path, monkeypatch):
+        import trlx_tpu.async_rl.channel as channel_mod
+        from trlx_tpu.async_rl.channel import FileWeightChannel
+
+        root = str(tmp_path / "weights")
+        writer = FileWeightChannel(root, poll_interval_s=0.01)
+        writer.publish({"w": np.zeros(4)}, version=0, force=True)
+        writer._write_manifest({"version": 5, "target": 0})  # writer died
+        reader = FileWeightChannel(root, poll_interval_s=0.0)
+        # fast-forward the deadline clock instead of sleeping 30s of wall
+        now = channel_mod.time.monotonic()
+        ticks = iter([now, now + reader.fetch_timeout_s + 1])
+        monkeypatch.setattr(
+            channel_mod.time, "monotonic", lambda: next(ticks, now + 1e9)
+        )
+        with pytest.raises(RuntimeError, match="writer dead"):
+            reader.fetch(template={"w": np.zeros(4)})
+
+    def test_deadline_floor_and_config_field(self):
+        from trlx_tpu.async_rl.channel import FileWeightChannel
+        from trlx_tpu.data.configs import AsyncRLConfig
+
+        assert FileWeightChannel("/tmp/_unused_floor").fetch_timeout_s >= 30.0
+        assert FileWeightChannel(
+            "/tmp/_unused_floor", fetch_timeout_s=1.0
+        ).fetch_timeout_s == 30.0  # the floor wins
+        assert AsyncRLConfig().fetch_timeout_s >= 30.0
+
+
+def test_flatten_payload_rejects_dotted_keys():
+    """A '.' in a payload key is the nesting separator: it used to
+    round-trip silently into a NESTED dict through unflatten_payload,
+    corrupting the chunk structure — now it raises at flatten time."""
+    from trlx_tpu.async_rl.queue import flatten_payload, unflatten_payload
+
+    with pytest.raises(ValueError, match="flatten separator"):
+        flatten_payload({"stats.time": 1.0})
+    with pytest.raises(ValueError, match="flatten separator"):
+        flatten_payload({"outer": {"inner.dotted": np.zeros(2)}})
+    # the corruption this guards against: a dotted key would NOT round-trip
+    flat = {"a.b": np.asarray(1.0)}
+    assert unflatten_payload(flat) == {"a": {"b": 1.0}}
+    # clean nested payloads still round-trip exactly
+    payload = {"a": {"b": np.arange(3)}, "c": 2.5}
+    out = unflatten_payload(flatten_payload(payload))
+    np.testing.assert_array_equal(out["a"]["b"], payload["a"]["b"])
+    assert out["c"] == 2.5
+
+
 def test_fault_plan_new_triggers():
     plan = FaultPlan.parse("actor_crash@collection:2; weight_sync_drop@version:3*2")
     assert not plan.poll("actor_crash", collection=1)

@@ -1,0 +1,478 @@
+"""Collective actor-fleet transport (``async_rl/transport.py``,
+docs/ASYNC_RL.md "Transports"): the param-dissemination tree, the sharded
+experience queue, and elastic membership.
+
+Four contract groups:
+
+- **fabric units** — tree layout, delta encode/decode exactness, endpoint
+  bootstrap (no trainer, no device work);
+- **fleet integration** — a coordinator + clients over loopback: join
+  snapshots, delta publishes with unchanged-leaf skipping, chain relay at
+  fanout 1, point-to-point chunk commits, lease requeue on member death,
+  mid-run elastic join, clean shutdown (no leaked ``trlx-fleet-*``
+  threads — the conftest sentinel enforces it);
+- **bit-equivalence** — thread mode over the collective transport with
+  ``max_staleness: 0`` produces a store bit-identical to the serial
+  reference, INCLUDING across an injected actor crash where the fleet
+  SHRINKS (restarts exhausted, survivors take over) instead of stalling;
+- **process mode (slow)** — a learner + TWO remote actor processes over
+  the collective fabric; one actor is killed mid-run by ``actor_crash``
+  and is never relaunched — the fleet shrinks, the survivor takes over
+  the dead member's leases, the run completes, staleness stays 0, and the
+  collection-1 store is bit-identical to the serial reference.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from trlx_tpu.async_rl.queue import ExperienceChunk, QueueClosed
+from trlx_tpu.async_rl.transport import (
+    FleetActorClient,
+    FleetCoordinator,
+    _decode_delta,
+    _encode_delta,
+    read_endpoint,
+    tree_parent_slot,
+    write_endpoint,
+)
+
+
+class _Metrics:
+    def __init__(self):
+        self.counts = {}
+
+    def inc(self, name, value=1.0):
+        self.counts[name] = self.counts.get(name, 0.0) + value
+
+    def observe(self, name, value):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# fabric units
+# ---------------------------------------------------------------------------
+
+
+def test_tree_layout():
+    # fanout 2: slots 0,1 hang off the root; 2,3 relay through slot 0
+    assert tree_parent_slot(0, 2) is None
+    assert tree_parent_slot(1, 2) is None
+    assert tree_parent_slot(2, 2) == 0
+    assert tree_parent_slot(3, 2) == 0
+    assert tree_parent_slot(4, 2) == 1
+    # fanout 1 is a chain — every hop relays
+    assert tree_parent_slot(0, 1) is None
+    assert tree_parent_slot(1, 1) == 0
+    assert tree_parent_slot(2, 1) == 1
+
+
+def test_delta_roundtrip_bit_exact():
+    """Delta blobs preserve dtype and bits — including bf16, whose npz
+    path in the FILE channel widens to f32."""
+    import jax.numpy as jnp
+
+    leaves = [
+        (0, np.arange(6, dtype=np.float32).reshape(2, 3)),
+        (3, np.asarray(jnp.asarray([1.5, -2.25], jnp.bfloat16))),
+        (5, np.asarray(7, np.int64)),
+    ]
+    out = _decode_delta(_encode_delta(leaves))
+    assert [i for i, _ in out] == [0, 3, 5]
+    for (_, a), (_, b) in zip(leaves, out):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(a, b)
+
+
+def test_endpoint_roundtrip_and_timeout(tmp_path):
+    with pytest.raises(TimeoutError, match="no fleet endpoint"):
+        read_endpoint(str(tmp_path), timeout_s=0.1, poll_interval_s=0.01)
+    write_endpoint(str(tmp_path), ("127.0.0.1", 12345), b"\x01\x02")
+    address, authkey = read_endpoint(str(tmp_path), timeout_s=1)
+    assert address == ("127.0.0.1", 12345)
+    assert authkey == b"\x01\x02"
+
+
+# ---------------------------------------------------------------------------
+# fleet integration (loopback, no trainer)
+# ---------------------------------------------------------------------------
+
+
+def _wait(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestFleetFabric:
+    def test_tree_dissemination_delta_skipping_and_chunks(self):
+        """The whole fabric over a fanout-1 CHAIN (root → c1 → c2, so the
+        second hop is a genuine actor relay): join snapshot, delta publish
+        reaching both members bit-exactly, unchanged-leaf skipping making
+        the delta smaller than the snapshot, point-to-point chunk commit,
+        lease requeue onto the survivor, and a mid-run elastic join."""
+        metrics = _Metrics()
+        coord = FleetCoordinator(fanout=1, capacity=8, metrics=metrics)
+        params_a = {
+            "w": np.arange(6, dtype=np.float32).reshape(2, 3),
+            # a large never-updated leaf (a frozen layer): ships in join
+            # snapshots, must NEVER ride a delta publish
+            "frozen": np.ones(10_000, np.float32),
+        }
+        clients = []
+        try:
+            coord.publish(params_a, version=0, force=True)
+            coord.announce(0, 1)
+            snapshot_bytes = coord.window_stats()["async/publish_bytes"]
+            assert snapshot_bytes == 0.0  # nobody joined yet: zero egress
+
+            c1 = FleetActorClient(coord.address, coord.authkey, template=params_a)
+            clients.append(c1)
+            c2 = FleetActorClient(coord.address, coord.authkey, template=params_a)
+            clients.append(c2)
+            assert (c1.slot, c2.slot) == (0, 1)
+            p1, v1 = c1.fetch()
+            assert v1 == 0
+            np.testing.assert_array_equal(p1["w"], params_a["w"])
+            join_bytes = coord.window_stats()["async/publish_bytes"]
+            assert join_bytes > 0  # two WELCOME snapshots
+            # wait for c2's relay feed to attach to c1 — otherwise the
+            # delta below legitimately heals through a full-snapshot
+            # resync, which is correct but not the path under test
+            assert _wait(lambda: len(c1._children) == 1)
+
+            # delta publish: only "w" changed — both members converge
+            # bit-exactly (c2 through c1's relay), and the delta is far
+            # smaller than the full snapshot ("frozen" never moves)
+            params_b = {"w": params_a["w"] + 1, "frozen": params_a["frozen"]}
+            coord.publish(params_b, version=1)
+            assert _wait(
+                lambda: c1.fetch()[1] == 1 and c2.fetch()[1] == 1
+            ), (c1.fetch()[1], c2.fetch()[1])
+            np.testing.assert_array_equal(c2.fetch()[0]["w"], params_b["w"])
+            stats = coord.window_stats()
+            assert stats["async/fleet_size"] == 2.0
+            assert 0 < stats["async/publish_bytes"] < join_bytes / 2
+            assert stats.get("async/dissemination_latency_s", 0) > 0
+
+            # lease → point-to-point chunk commit: payload arrives bit-exact
+            payload = {
+                "tokens": np.arange(4, dtype=np.int32),
+                "nested": {"x": np.full(2, 0.5)},
+            }
+            i0 = c2.request_work(timeout=10)
+            assert i0 == 0
+            c2.put(ExperienceChunk(i0, version=1, payload=payload))
+            chunk = coord.get(timeout=10)
+            assert (chunk.index, chunk.version) == (0, 1)
+            np.testing.assert_array_equal(chunk.payload["tokens"], payload["tokens"])
+            np.testing.assert_array_equal(
+                chunk.payload["nested"]["x"], payload["nested"]["x"]
+            )
+
+            # lease requeue on death: c1 leases the next index and dies
+            # without producing — the SURVIVOR is handed the same index
+            leased = c1.request_work(timeout=10)
+            assert leased == 1
+            clients.remove(c1)
+            c1.close(graceful=False)
+            assert _wait(lambda: coord.fleet_size() == 1)
+            assert c2.request_work(timeout=10) == leased
+            assert metrics.counts.get("async/fleet_shrinks") == 1.0
+            assert metrics.counts.get("async/requeued_chunks") == 1.0
+
+            # elastic mid-run join: the newcomer bootstraps at the CURRENT
+            # version straight from its WELCOME snapshot
+            c3 = FleetActorClient(coord.address, coord.authkey, template=params_a)
+            clients.append(c3)
+            p3, v3 = c3.fetch()
+            assert v3 == 1
+            np.testing.assert_array_equal(p3["w"], params_b["w"])
+            assert coord.fleet_size() == 2
+            assert metrics.counts["async/fleet_joins"] == 3.0
+        finally:
+            coord.close()
+            for client in clients:
+                client.close()
+
+    def test_staleness_gate_contract(self):
+        """The collective channel keeps the WeightChannel gate math: a
+        member may not start a collection past the announcement, nor under
+        a payload staler than target − max_staleness."""
+        coord = FleetCoordinator(fanout=2, capacity=4)
+        params = {"w": np.zeros(2)}
+        client = None
+        try:
+            coord.publish(params, version=1, force=True)
+            client = FleetActorClient(coord.address, coord.authkey, template=params)
+            assert not client.ready(0, collection=1)  # nothing announced
+            coord.announce(3, collection=1)
+            assert _wait(lambda: not client.ready(1, 1) and client._target == 3)
+            coord.publish(params, version=2)
+            assert _wait(lambda: client.ready(1, 1))
+            assert not client.ready(0, 1)
+            coord.publish(params, version=3)
+            assert _wait(lambda: client.ready(0, 1))
+            # a later collection stays gated until announced
+            assert not client.ready(8, collection=2)
+        finally:
+            coord.close()
+            if client is not None:
+                client.close()
+
+    def test_done_broadcast_unblocks_members(self):
+        coord = FleetCoordinator(fanout=2, capacity=4)
+        coord.publish({"w": np.zeros(2)}, version=0, force=True)
+        client = FleetActorClient(
+            coord.address, coord.authkey, template={"w": np.zeros(2)}
+        )
+        try:
+            coord.close()
+            assert _wait(lambda: client.closed)
+            assert client.request_work(timeout=1) is None
+            assert not client.wait_ready(0, 1)
+            with pytest.raises(QueueClosed):
+                client.put(ExperienceChunk(0, 0, {"x": np.zeros(1)}))
+        finally:
+            client.close()
+
+
+# ---------------------------------------------------------------------------
+# bit-equivalence: thread mode over the collective transport (tier-1)
+# ---------------------------------------------------------------------------
+
+
+class TestCollectiveThreadMode:
+    def test_max_staleness_zero_bit_identical_to_serial(self, tmp_path):
+        """The standing bit-equivalence constraint over the NEW transport:
+        two fleet members on a fanout-1 chain (one genuine relay hop),
+        ``max_staleness: 0`` — same store as the serial reference."""
+        from test_async_rl import _assert_stores_identical, _ppo_trainer
+
+        serial = _ppo_trainer(tmp_path, "serial")
+        asy = _ppo_trainer(
+            tmp_path, "collective",
+            async_rl=dict(enabled=True, mode="thread", num_actors=2,
+                          max_staleness=0, transport="collective", fanout=1),
+        )
+        try:
+            serial.make_experience(16)
+            asy.make_experience(16)
+            _assert_stores_identical(serial.store, asy.store)
+            stats = asy.make_experience_stats
+            assert stats["async/staleness_max"] == 0.0
+            assert stats["async/chunks"] == 4.0
+            assert stats["async/fleet_size"] == 2.0
+            assert stats["async/publish_bytes"] > 0  # join snapshots moved
+        finally:
+            asy._shutdown_collectors()
+
+    def test_actor_crash_shrinks_fleet_still_bit_identical(self, tmp_path):
+        """Elastic membership under a crash with restarts EXHAUSTED
+        (``max_actor_restarts: 0``): the fleet shrinks to the survivor
+        instead of killing the run, the dead member's chunk requeues onto
+        it, and the store stays bit-identical to serial — the crash is
+        invisible in the data."""
+        from test_async_rl import _assert_stores_identical, _ppo_trainer
+
+        serial = _ppo_trainer(tmp_path, "serial")
+        crash = _ppo_trainer(
+            tmp_path, "shrink",
+            async_rl=dict(enabled=True, mode="thread", num_actors=2,
+                          max_staleness=0, transport="collective",
+                          max_actor_restarts=0),
+            resilience=dict(fault_plan="actor_crash@collection:1"),
+        )
+        try:
+            serial.make_experience(16)
+            crash.make_experience(16)
+            _assert_stores_identical(serial.store, crash.store)
+            snap = crash.obs.metrics.snapshot(reset_histograms=False)
+            assert snap.get("async/fleet_shrinks") == 1.0, snap
+            assert snap.get("async/requeued_chunks", 0) >= 1.0, snap
+            assert not snap.get("async/actor_restarts"), snap
+            assert crash.make_experience_stats["async/fleet_size"] == 1.0
+        finally:
+            crash._shutdown_collectors()
+
+    def test_collective_rejects_drop_oldest(self, tmp_path):
+        from test_async_rl import _ppo_trainer
+
+        trainer = _ppo_trainer(
+            tmp_path, "reject",
+            async_rl=dict(enabled=True, mode="thread", num_actors=1,
+                          transport="collective", queue_policy="drop_oldest"),
+        )
+        with pytest.raises(ValueError, match="drop_oldest"):
+            trainer._ensure_async_collector()
+
+    def test_unknown_transport_rejected(self, tmp_path):
+        from test_async_rl import _ppo_trainer
+
+        trainer = _ppo_trainer(
+            tmp_path, "unknown",
+            async_rl=dict(enabled=True, mode="thread", transport="carrier-pigeon"),
+        )
+        with pytest.raises(ValueError, match="carrier-pigeon"):
+            trainer._ensure_async_collector()
+
+
+# ---------------------------------------------------------------------------
+# process mode: learner + two remote actors, kill one → fleet shrinks (slow)
+# ---------------------------------------------------------------------------
+
+_COMMON = textwrap.dedent(
+    """
+    import os, sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, {repo!r})
+    import hashlib
+    import numpy as np
+
+    PROMPTS = ["hello world", "the quick brown fox", "lorem ipsum", "foo bar"] * 4
+
+    def reward_fn(samples=None, prompts=None, outputs=None, **kw):
+        return [float(sum(c in "aeiou" for c in o)) for o in outputs]
+
+    def base_config(ckpt_dir, fault=None):
+        from trlx_tpu.data.default_configs import default_ppo_config
+        return default_ppo_config().evolve(
+            train=dict(seq_length=48, batch_size=8, total_steps=4,
+                       checkpoint_interval=1000, eval_interval=1000,
+                       checkpoint_dir=ckpt_dir, tracker=None, epochs=2),
+            model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+            method=dict(num_rollouts=16, chunk_size=4, ppo_epochs=1,
+                        gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                                        do_sample=True)),
+            async_rl=dict(enabled=True, mode="process", max_staleness=0,
+                          transport="collective", root_dir={root!r},
+                          actor_timeout_s=240.0),
+            resilience=dict(fault_plan=fault),
+        )
+
+    def store_hash(store):
+        h = hashlib.sha256()
+        for e in store.history:
+            for f in ("query_tensor", "response_tensor", "logprobs", "values",
+                      "rewards"):
+                h.update(np.ascontiguousarray(
+                    np.asarray(getattr(e, f), np.float64)).tobytes())
+        return h.hexdigest()
+    """
+)
+
+# Actor worker: crashes deterministically in collection 1 when given the
+# fault (rc != 0) and is NEVER relaunched — the elastic-shrink exercise.
+ACTOR_WORKER = _COMMON + textwrap.dedent(
+    """
+    from trlx_tpu.async_rl.actor import run_actor
+
+    cfg = base_config({ckpt!r}, fault={fault!r})
+    n = run_actor(cfg, reward_fn=reward_fn, prompts=PROMPTS)
+    print("ACTOR_DONE", n, flush=True)
+    """
+)
+
+LEARNER_WORKER = _COMMON + textwrap.dedent(
+    """
+    import trlx_tpu.trlx as trlx
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401
+    import trlx_tpu.trainer.ppo  # noqa: F401
+    from trlx_tpu.pipeline import get_pipeline
+    from trlx_tpu.trainer import get_trainer
+
+    # serial reference for collection 1 (async off, same seed): at
+    # max_staleness 0 the collective store must match it bit-for-bit —
+    # crash, shrink, and all
+    ref_cfg = base_config({ckpt!r} + "_ref").evolve(async_rl=dict(enabled=False))
+    ref = get_trainer(ref_cfg.train.trainer)(
+        config=ref_cfg, reward_fn=reward_fn, metric_fn=None, stop_sequences=[])
+    ref.add_prompt_pipeline(
+        get_pipeline(ref_cfg.train.pipeline)(PROMPTS, 40, ref.tokenizer))
+    ref.make_experience(16)
+    ref_hash = store_hash(ref.store)
+
+    cfg = base_config({ckpt!r})
+    captured = {{}}
+    orig = None
+    def hook(trainer):
+        global orig
+        orig = type(trainer).make_experience
+        def capture(self, num_rollouts=1024, iter_count=0):
+            orig(self, num_rollouts, iter_count)
+            captured.setdefault("first_hash", store_hash(self.store))
+            captured.setdefault("staleness", []).append(
+                self.make_experience_stats.get("async/staleness_max"))
+            captured.setdefault("fleet", []).append(
+                self.make_experience_stats.get("async/fleet_size"))
+        type(trainer).make_experience = capture
+    t = trlx.train(reward_fn=reward_fn, prompts=PROMPTS, config=cfg,
+                   init_trainer_hook=hook)
+    type(t).make_experience = orig
+    assert captured["first_hash"] == ref_hash, (
+        "collective collection-1 store diverged from the serial reference")
+    assert all(s == 0.0 for s in captured["staleness"]), captured
+    snap = t.obs.metrics.snapshot(reset_histograms=False)
+    assert snap.get("async/fleet_shrinks", 0) >= 1, snap
+    assert snap.get("async/fleet_joins", 0) >= 2, snap
+    print("LEARNER_OK", captured["staleness"], captured["fleet"], flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_process_mode_fleet_shrinks_on_actor_kill(tmp_path):
+    """The elastic-membership e2e acceptance: a learner and TWO remote
+    actor processes over the collective fabric; ``actor_crash@collection:1``
+    kills actor A mid-run and nothing relaunches it — the coordinator
+    requeues its leases onto the survivor, the fleet shrinks, the run
+    completes, staleness stays at the 0 bound, and the collection-1 store
+    is bit-identical to the serial reference."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = str(tmp_path / "fleet")
+    os.makedirs(root, exist_ok=True)
+    fmt = dict(repo=repo, root=root, ckpt=str(tmp_path / "ckpt"))
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def spawn(src, **extra):
+        return subprocess.Popen(
+            [sys.executable, "-c", src.format(**fmt, **extra)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+
+    learner = spawn(LEARNER_WORKER)
+    doomed = spawn(ACTOR_WORKER, fault="actor_crash@collection:1")
+    survivor = spawn(ACTOR_WORKER, fault=None)
+    procs = [learner, doomed, survivor]
+    try:
+        doomed_out = doomed.communicate(timeout=600)[0]
+        learner_out = learner.communicate(timeout=600)[0]
+        survivor_out = survivor.communicate(timeout=600)[0]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                pass
+            if p.stdout is not None:
+                p.stdout.close()
+    assert doomed.returncode != 0, doomed_out[-2000:]
+    assert "actor_crash@collection:1" in doomed_out, doomed_out[-2000:]
+    assert learner.returncode == 0, learner_out[-3000:]
+    assert "LEARNER_OK" in learner_out, learner_out[-3000:]
+    assert survivor.returncode == 0, survivor_out[-2000:]
+    assert "ACTOR_DONE" in survivor_out, survivor_out[-2000:]

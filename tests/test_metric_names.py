@@ -86,6 +86,15 @@ def test_scanner_sees_the_codebase():
     assert "async/staleness_mean" in keys
     assert "async/actor_restarts" in keys
     assert "async/weight_syncs" in keys
+    # collective fleet-transport keys (docs/ASYNC_RL.md "Transports"):
+    # dissemination-tree egress/latency, membership, and the beat's
+    # fleet gauge — all literal sites in transport.py / distributed.py
+    assert "async/dissemination_latency_s" in keys
+    assert "async/publish_bytes" in keys
+    assert "async/fleet_size" in keys
+    assert "async/fleet_joins" in keys
+    assert "async/fleet_shrinks" in keys
+    assert "cluster/fleet_size" in keys
 
 
 def test_engine_keys_registered_and_namespaced():

@@ -86,6 +86,14 @@ RANK_UNIFORM_FIELDS = frozenset({
     "checkpoint_interval",
     "eval_interval",
     "save_best",
+    # async_rl: the fleet transport selection and its tree fanout. The
+    # collective fleet's membership gauges ride the telemetry-beat
+    # allgather's packed vector, and the coordinator/endpoint is authored
+    # once per fleet — learner ranks disagreeing on the transport (or its
+    # tree shape) would build mismatched fleets around the same beat
+    # (docs/ASYNC_RL.md "Transports", docs/STATIC_ANALYSIS.md)
+    "transport",
+    "fanout",
 })
 
 

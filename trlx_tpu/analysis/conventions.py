@@ -140,6 +140,7 @@ CLUSTER_KEYS = frozenset({
     "cluster/tokens_per_sec_sum",
     "cluster/device_bytes_in_use_max",
     "cluster/straggler_rank",
+    "cluster/fleet_size",
 })
 
 # Canonical async actor/learner keys (trlx_tpu/async_rl/, docs/ASYNC_RL.md):
@@ -159,6 +160,14 @@ ASYNC_KEYS = frozenset({
     "async/actor_restarts",
     "async/weight_syncs",
     "async/weight_sync_drops",
+    # collective fleet transport (async_rl/transport.py, docs/ASYNC_RL.md
+    # "Transports"): dissemination-tree publish egress + ack latency,
+    # live membership, and elastic join/shrink counters
+    "async/dissemination_latency_s",
+    "async/publish_bytes",
+    "async/fleet_size",
+    "async/fleet_joins",
+    "async/fleet_shrinks",
 })
 
 # Canonical async span names (GL502-namespaced; the actor's per-chunk span

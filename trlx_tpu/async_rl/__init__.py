@@ -13,9 +13,14 @@ training into one **learner** and N **generation actors**:
   on collection k.
 - **process mode** (``async_rl.mode: process``): actors are separate
   processes (their own JAX runtime, their own devices — on a pod, their
-  own slice) connected through a filesystem transport: an atomic
-  weight-dissemination directory (RLAX-style param path) and a chunk spool
-  the learner consumes. Provable on the 2-process CPU harness.
+  own slice). The transport between them is selectable
+  (``async_rl.transport``): the filesystem fallback (atomic weights file +
+  chunk spool), or the **collective fleet fabric**
+  (``async_rl/transport.py``) — a param-dissemination tree shipping
+  versioned deltas with unchanged-leaf skipping over a
+  configurable-fanout relay, in-fabric chunk commits, and elastic
+  join/leave membership (RLAX's tree, Podracer's in-fabric pairs; see
+  docs/ASYNC_RL.md "Transports"). Provable on the 2-process CPU harness.
 
 The two halves meet at two seams:
 
@@ -59,14 +64,24 @@ from trlx_tpu.async_rl.queue import (
     QueueClosed,
 )
 from trlx_tpu.async_rl.runtime import AsyncCollector, ChunkSpec
+from trlx_tpu.async_rl.transport import (
+    CollectiveExperienceQueue,
+    CollectiveWeightChannel,
+    FleetActorClient,
+    FleetCoordinator,
+)
 
 __all__ = [
     "AsyncCollector",
     "ChunkSpec",
+    "CollectiveExperienceQueue",
+    "CollectiveWeightChannel",
     "ExperienceChunk",
     "ExperienceQueue",
     "FileExperienceQueue",
     "FileWeightChannel",
+    "FleetActorClient",
+    "FleetCoordinator",
     "QueueClosed",
     "WeightChannel",
 ]

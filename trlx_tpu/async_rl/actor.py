@@ -28,6 +28,7 @@ The actor exits cleanly when the learner marks the spool DONE.
 """
 
 import os
+import sys
 import time
 from typing import Any, Callable, List, Optional
 
@@ -49,6 +50,118 @@ def chunks_per_collection(config: Any) -> int:
     rollouts = int(config.method.num_rollouts)
     chunk = max(1, int(config.method.chunk_size))
     return max(1, -(-rollouts // chunk))
+
+
+def _maybe_crash(plan: Any, root_dir: str, spec: ChunkSpec) -> None:
+    """The deterministic ``actor_crash@collection:N`` fault, process
+    flavor: a marker file under the shared root stops a respawned (or
+    surviving) actor from re-firing the same collection's crash."""
+    if not plan:
+        return
+    marker = os.path.join(root_dir, f"actor_crash_fired_{spec.collection}")
+    if os.path.exists(marker) or not plan.poll(
+        "actor_crash", collection=spec.collection
+    ):
+        return
+    with open(marker, "w") as f:
+        f.write("fired\n")
+    from trlx_tpu.resilience.faults import InjectedFault
+
+    logger.warning(
+        f"fault plan: actor crashing in collection {spec.collection} "
+        f"(chunk {spec.index})"
+    )
+    raise InjectedFault(
+        f"actor_crash@collection:{spec.collection} (chunk {spec.index})"
+    )
+
+
+def _run_actor_collective(
+    trainer: Any,
+    config: Any,
+    max_chunks: Optional[int],
+) -> int:
+    """Collective-transport actor main loop: join the fleet (HELLO →
+    WELCOME param snapshot + tree position), lease chunk indices from the
+    coordinator, and commit payloads in-fabric. The spec stream (prompt
+    batches + per-chunk RNG) is still seed-derived and index-addressed, so
+    ANY member can regenerate ANY chunk — a lease requeued from a departed
+    member lands on a survivor and produces the identical chunk. Specs are
+    cached from the local draw position down to the learner's broadcast
+    finalize cursor (requeues below the cursor are impossible), so the
+    cache stays bounded by the production window."""
+    import jax
+
+    from trlx_tpu.async_rl.transport import FleetActorClient, read_endpoint
+    from trlx_tpu.parallel.mesh import get_global_mesh, mesh_descriptor
+
+    acfg = config.async_rl
+    plan = trainer.resilience.plan
+    per_collection = chunks_per_collection(config)
+    max_staleness = max(0, int(acfg.max_staleness))
+    address, authkey = read_endpoint(
+        acfg.root_dir,
+        timeout_s=acfg.actor_timeout_s,
+        poll_interval_s=acfg.poll_interval_s,
+    )
+    mesh = get_global_mesh()
+    client = FleetActorClient(
+        address,
+        authkey,
+        template=trainer.state.params,
+        mesh_descriptor=mesh_descriptor(mesh) if mesh is not None else None,
+        bind_host=acfg.bind_host,
+    )
+    rng = trainer._rollout_rng
+    produced = 0
+    local_pos = 0
+    cache = {}
+    try:
+        while max_chunks is None or produced < max_chunks:
+            index = client.request_work()
+            if index is None:
+                break  # drained: the coordinator is shutting the fleet down
+            # advance the deterministic spec stream to the assigned index —
+            # every index's draws are burned exactly once, in order, so the
+            # stream position matches the serial path's regardless of which
+            # indices this member ends up producing
+            while local_pos <= index:
+                batch = next(trainer.prompt_iterator)
+                rng, chunk_rng = jax.random.split(rng)
+                cache[local_pos] = (
+                    np.asarray(batch["input_ids"], np.int32),
+                    np.asarray(batch["attention_mask"], np.int32),
+                    chunk_rng,
+                )
+                local_pos += 1
+            ids, mask, chunk_rng = cache[index]
+            cursor = client.cursor_view()
+            for stale in [k for k in sorted(cache) if k < cursor and k != index]:
+                del cache[stale]
+            spec = ChunkSpec(
+                index=index,
+                collection=index // per_collection + 1,
+                prompt_ids=ids,
+                prompt_mask=mask,
+                rng=chunk_rng,
+            )
+            if not client.wait_ready(max_staleness, spec.collection):
+                break
+            params, version = client.fetch()
+            _maybe_crash(plan, acfg.root_dir, spec)
+            payload = trainer._async_produce_chunk(spec, params, version, client)
+            try:
+                client.put(ExperienceChunk(spec.index, version, payload))
+            except QueueClosed:
+                break
+            trainer.obs.metrics.inc("async/chunks")
+            produced += 1
+    finally:
+        # a crash (e.g. the injected actor_crash fault) must read as a
+        # member DEATH at the coordinator (fleet shrink + lease requeue),
+        # not a polite leave
+        client.close(graceful=sys.exc_info()[0] is None)
+    return produced
 
 
 def run_actor(
@@ -92,6 +205,9 @@ def run_actor(
         get_pipeline(config.train.pipeline)(prompts, max_prompt_length, trainer.tokenizer)
     )
 
+    if acfg.transport == "collective":
+        return _run_actor_collective(trainer, config, max_chunks)
+
     queue = FileExperienceQueue(
         os.path.join(acfg.root_dir, "spool"),
         capacity=trainer._async_queue_capacity(),
@@ -100,6 +216,7 @@ def run_actor(
     channel = FileWeightChannel(
         os.path.join(acfg.root_dir, "weights"),
         poll_interval_s=acfg.poll_interval_s,
+        fetch_timeout_s=acfg.fetch_timeout_s,
     )
     plan = trainer.resilience.plan
     per_collection = chunks_per_collection(config)
@@ -137,24 +254,7 @@ def run_actor(
                 return produced
             time.sleep(channel.poll)
         params, version = channel.fetch(template=trainer.state.params)
-        if plan:
-            marker = os.path.join(
-                acfg.root_dir, f"actor_crash_fired_{spec.collection}"
-            )
-            if not os.path.exists(marker) and plan.poll(
-                "actor_crash", collection=spec.collection
-            ):
-                with open(marker, "w") as f:
-                    f.write("fired\n")
-                from trlx_tpu.resilience.faults import InjectedFault
-
-                logger.warning(
-                    f"fault plan: actor crashing in collection {spec.collection} "
-                    f"(chunk {spec.index})"
-                )
-                raise InjectedFault(
-                    f"actor_crash@collection:{spec.collection} (chunk {spec.index})"
-                )
+        _maybe_crash(plan, acfg.root_dir, spec)
         payload = trainer._async_produce_chunk(spec, params, version, channel)
         try:
             queue.put(ExperienceChunk(spec.index, version, payload))

@@ -178,12 +178,17 @@ class FileWeightChannel:
         metrics: Any = None,
         sync_every: int = 1,
         poll_interval_s: float = 0.02,
+        fetch_timeout_s: float = 60.0,
     ):
         self.root = root
         self._plan = plan
         self.metrics = metrics
         self.sync_every = max(1, int(sync_every))
         self.poll = float(poll_interval_s)
+        # fetch retry is DEADLINE-based, never attempt-count-based: the
+        # learner's npz write scales with the model, and a healthy slow
+        # writer must not read as "writer dead" (floor 30s)
+        self.fetch_timeout_s = max(30.0, float(fetch_timeout_s))
         os.makedirs(root, exist_ok=True)
         self._cache: Tuple[Any, int] = (None, -1)
         self._closed = False
@@ -271,16 +276,21 @@ class FileWeightChannel:
 
         path = os.path.join(self.root, self.WEIGHTS)
         leaves = None
-        for _attempt in range(50):
+        deadline = time.monotonic() + self.fetch_timeout_s
+        # the retry sleep is floored: with poll_interval_s ≈ 0 a deadline
+        # this long would otherwise busy-spin re-deserializing the full
+        # npz at 100% CPU until the writer lands
+        retry_pause = max(self.poll, 0.005)
+        while time.monotonic() < deadline:
             try:
                 with np.load(path) as data:
                     stamped = int(data["__version__"])
                     read = [data[k] for k in sorted(data.files) if k.startswith("leaf_")]
             except (OSError, ValueError, KeyError, zipfile.BadZipFile):
-                time.sleep(self.poll)  # mid-replace read; retry
+                time.sleep(retry_pause)  # mid-replace read; retry
                 continue
             if stamped < version:
-                time.sleep(self.poll)  # manifest ahead of a racing writer
+                time.sleep(retry_pause)  # manifest ahead of a racing writer
                 continue
             # a payload at least as new as the manifest promised: adopt it
             # under ITS stamped version (never mislabel old leaves new)
@@ -290,8 +300,9 @@ class FileWeightChannel:
         if leaves is None:
             raise RuntimeError(
                 f"weight channel: no readable payload >= version {version} "
-                f"at {path} after 50 attempts — writer dead or directory "
-                "corrupted?"
+                f"at {path} after {self.fetch_timeout_s:.0f}s — writer dead "
+                "or directory corrupted? (a slow large-model write needs a "
+                "larger async_rl.fetch_timeout_s)"
             )
         if template is not None:
             treedef = jax.tree_util.tree_structure(template)

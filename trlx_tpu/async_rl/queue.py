@@ -149,9 +149,18 @@ def _atomic_write_json(path: str, payload: Dict[str, Any]) -> None:
 def flatten_payload(payload: Dict[str, Any], prefix: str = "") -> Dict[str, np.ndarray]:
     """A (possibly nested) payload dict as flat ``a.b`` → ndarray pairs for
     npz round-tripping. Scalars become 0-d arrays; strings are rejected
-    (chunk payloads are numeric by construction)."""
+    (chunk payloads are numeric by construction). Keys containing ``"."``
+    are rejected outright: the dot is the nesting separator, so a dotted
+    leaf key would silently round-trip through :func:`unflatten_payload`
+    as a *nested dict*, corrupting the chunk structure."""
     out: Dict[str, np.ndarray] = {}
     for key, value in payload.items():
+        if "." in key:
+            raise ValueError(
+                f"payload key {key!r} contains '.', the flatten separator — "
+                "it would unflatten into a nested dict and corrupt the "
+                "chunk structure; rename the field"
+            )
         name = f"{prefix}{key}"
         if isinstance(value, dict):
             out.update(flatten_payload(value, prefix=f"{name}."))

@@ -80,6 +80,8 @@ class AsyncCollector:
         metrics: Any = None,
         tracer: Any = None,
         span: Any = None,
+        member_factory: Any = None,
+        transport: Any = None,
     ):
         self._trainer = trainer
         self.queue = queue
@@ -94,6 +96,13 @@ class AsyncCollector:
         self.metrics = metrics
         self._tracer = tracer
         self._span = span
+        # collective transport (async_rl.transport: collective): each actor
+        # thread joins the fleet as its own member through member_factory;
+        # `transport` is the learner-side FleetCoordinator (stats + elastic
+        # membership). With the file/in-memory transports both stay None.
+        self._member_factory = member_factory
+        self._transport = transport
+        self._elastic = transport is not None
 
         # dispatcher state: prompt/RNG draws happen in spec-index order under
         # this lock, so the draw stream is identical to the serial path's
@@ -106,6 +115,7 @@ class AsyncCollector:
         self._rng = trainer._rollout_rng  # guarded-by: _dispatch_lock
         self._crash_fired: set = set()  # guarded-by: _dispatch_lock
         self._restarts = 0  # guarded-by: _dispatch_lock
+        self._active_actors = 0  # guarded-by: _dispatch_lock
         self._fatal: Optional[BaseException] = None  # guarded-by: _dispatch_lock
         # actor busy/idle accounting (actor_idle_frac)
         self._idle_s = 0.0  # guarded-by: _dispatch_lock
@@ -194,62 +204,106 @@ class AsyncCollector:
     def _actor_loop(self, actor_id: int) -> None:
         if self._tracer is not None and hasattr(self._tracer, "alias_current_thread"):
             self._tracer.alias_current_thread(f"async actor {actor_id}")
-        while not self._stop.is_set():
-            spec = self._next_spec()
-            t_gate = time.perf_counter()
-            if not self.channel.wait_ready(
-                self.max_staleness, spec.collection, stop=self._stop
-            ):
-                self._requeue(spec)  # shutdown: leave the spec for nobody
-                return
-            params, version = self.channel.fetch()
-            gate_s = time.perf_counter() - t_gate
-            try:
-                self._maybe_inject_crash(spec)
-                t0 = time.perf_counter()
-                if self._span is not None:
-                    with self._span(
-                        "async/actor_chunk", index=spec.index, version=version
-                    ):
+        # collective transport: this thread joins the fleet as its own
+        # member — the in-process fleet exercises the same wire protocol
+        # (tree deltas, in-fabric commits) as a pod's actor processes. A
+        # failed join is an actor death (supervised: restart/shrink/fatal),
+        # not a silently-vanished thread.
+        client = None
+        try:
+            if self._member_factory is not None:
+                client = self._member_factory(actor_id)
+        except BaseException as e:
+            raise _ActorDied(
+                f"actor {actor_id} failed to join the fleet"
+            ) from e
+        channel = client if client is not None else self.channel
+        queue = client if client is not None else self.queue
+        try:
+            while not self._stop.is_set():
+                spec = self._next_spec()
+                t_gate = time.perf_counter()
+                if not channel.wait_ready(
+                    self.max_staleness, spec.collection, stop=self._stop
+                ):
+                    self._requeue(spec)  # shutdown: leave the spec for nobody
+                    return
+                params, version = channel.fetch()
+                gate_s = time.perf_counter() - t_gate
+                try:
+                    self._maybe_inject_crash(spec)
+                    t0 = time.perf_counter()
+                    if self._span is not None:
+                        with self._span(
+                            "async/actor_chunk", index=spec.index, version=version
+                        ):
+                            payload = self._trainer._async_produce_chunk(
+                                spec, params, version, channel
+                            )
+                    else:
                         payload = self._trainer._async_produce_chunk(
-                            spec, params, version, self.channel
+                            spec, params, version, channel
                         )
-                else:
-                    payload = self._trainer._async_produce_chunk(
-                        spec, params, version, self.channel
-                    )
-                busy_s = time.perf_counter() - t0
-            except BaseException as e:
-                self._requeue(spec)
-                raise _ActorDied(f"actor {actor_id} died on chunk {spec.index}") from e
-            t_put = time.perf_counter()
-            try:
-                self.queue.put(ExperienceChunk(spec.index, version, payload))
-            except QueueClosed:
-                return
-            with self._dispatch_lock:
-                self._idle_s += gate_s + (time.perf_counter() - t_put)
-                self._busy_s += busy_s
-            if self.metrics is not None:
-                self.metrics.inc("async/chunks")
+                    busy_s = time.perf_counter() - t0
+                except BaseException as e:
+                    self._requeue(spec)
+                    raise _ActorDied(
+                        f"actor {actor_id} died on chunk {spec.index}"
+                    ) from e
+                t_put = time.perf_counter()
+                try:
+                    queue.put(ExperienceChunk(spec.index, version, payload))
+                except QueueClosed:
+                    return
+                with self._dispatch_lock:
+                    self._idle_s += gate_s + (time.perf_counter() - t_put)
+                    self._busy_s += busy_s
+                if self.metrics is not None and client is None:
+                    # collective transport counts arrivals coordinator-side
+                    self.metrics.inc("async/chunks")
+        finally:
+            if client is not None:
+                client.close()
 
     def _actor_main(self, actor_id: int) -> None:
+        died: Optional[_ActorDied] = None
         try:
             self._actor_loop(actor_id)
         except _ActorDied as e:
-            if self._stop.is_set():
-                return
+            died = e
+        except QueueClosed:
+            pass
+        respawn = shrink = False
+        with self._dispatch_lock:
+            # the live-actor count is maintained under THIS lock (never
+            # inferred from thread liveness): two actors dying at once
+            # serialize here, so the second one to arrive sees an empty
+            # fleet and goes fatal instead of both "shrinking" to zero
+            self._active_actors -= 1
+            if died is not None and not self._stop.is_set():
+                self._restarts += 1
+                if self._restarts <= self._max_actor_restarts:
+                    respawn = True
+                elif self._elastic and self._active_actors > 0:
+                    # elastic membership: restarts are exhausted but the
+                    # fleet still has live members — SHRINK instead of
+                    # killing the run. The dead actor's spec is already
+                    # requeued; a survivor regenerates it identically.
+                    shrink = True
+                else:
+                    self._fatal = died.__cause__ or died
+        if respawn:
             if self.metrics is not None:
                 self.metrics.inc("async/actor_restarts")
-            with self._dispatch_lock:
-                self._restarts += 1
-                too_many = self._restarts > self._max_actor_restarts
-                if too_many:
-                    self._fatal = e.__cause__ or e
-            if not too_many:
-                self._spawn(actor_id)
-        except QueueClosed:
-            return
+            self._spawn(actor_id)
+        elif shrink:
+            if self.metrics is not None:
+                self.metrics.inc("async/fleet_shrinks")
+            logger.warning(
+                f"async_rl: actor {actor_id} died with restarts "
+                "exhausted; fleet shrinks and survivors take over its "
+                "chunks"
+            )
 
     def _spawn(self, actor_id: int) -> None:
         thread = threading.Thread(
@@ -260,6 +314,7 @@ class AsyncCollector:
         )
         with self._dispatch_lock:
             self._threads.append(thread)
+            self._active_actors += 1
         thread.start()
 
     def _ensure_started(self) -> None:
@@ -344,6 +399,10 @@ class AsyncCollector:
         with self._dispatch_lock:
             self._inflight_specs.pop(self._next_finalize, None)
         self._next_finalize += 1
+        if hasattr(self.queue, "note_finalized"):
+            # collective transport: the finalize floor widens the fleet's
+            # production window and prunes remote spec caches
+            self.queue.note_finalized(self._next_finalize)
         staleness = float(max(self.version - chunk.version, 0))
         self._col_stats["chunks"] += 1
         self._col_stats["staleness_sum"] += staleness
@@ -370,7 +429,18 @@ class AsyncCollector:
         stats["async/queue_depth"] = float(self.queue.depth)
         if idle + busy > 0:
             stats["async/actor_idle_frac"] = idle / (idle + busy)
+        if self._transport is not None:
+            # fleet transport gauges (docs/ASYNC_RL.md "Transports"):
+            # fleet size, learner publish egress, ack-measured tree latency
+            stats.update(self._transport.window_stats())
         return stats
+
+    def fleet_size(self) -> Optional[int]:
+        """Live collective-fleet member count (``None`` off-fleet) — rides
+        the cluster telemetry beat as ``cluster/fleet_size``."""
+        if self._transport is None:
+            return None
+        return self._transport.fleet_size()
 
     def close(self) -> None:
         """Stop actors, wake anything blocked, join threads. Idempotent."""

@@ -386,7 +386,28 @@ class AsyncRLConfig:
     :param poll_interval_s: process-mode file polling interval.
     :param max_actor_restarts: thread mode — dead actors are respawned
         (their in-flight chunk requeued) up to this many times before the
-        underlying error propagates to the learner.
+        underlying error propagates to the learner. With the collective
+        transport, exhausting restarts while OTHER actors survive shrinks
+        the fleet instead (elastic membership): the dead actor's chunks
+        requeue onto survivors and the run continues.
+    :param transport: ``"file"`` (the PR-9 spool/weights-file transport —
+        the degraded/fallback mode; thread mode uses the equivalent
+        in-memory channel) or ``"collective"`` (the fleet fabric:
+        param-dissemination tree with unchanged-leaf delta skipping,
+        in-fabric chunk commits, elastic join/leave —
+        ``async_rl/transport.py``, docs/ASYNC_RL.md "Transports").
+        Rank-uniform: on a multihost learner every rank must agree (the
+        fleet gauges ride the telemetry beat; graftlint GL704 registry).
+    :param fanout: dissemination-tree fanout (collective transport). The
+        learner sends each param delta to at most ``fanout`` direct
+        children; actors relay to theirs. Rank-uniform (see above).
+    :param bind_host: host/interface the collective transport's listeners
+        bind (learner root and actor relay nodes). Default loopback; set
+        to the pod-routable interface for a real fleet.
+    :param fetch_timeout_s: file transport — how long an actor's
+        ``fetch`` retries reading a mid-replace weights file before
+        declaring the writer dead. The learner's npz write grows with the
+        model, so this is a deadline (default 60s), not an attempt count.
     """
 
     enabled: bool = False
@@ -400,6 +421,10 @@ class AsyncRLConfig:
     actor_timeout_s: float = 300.0
     poll_interval_s: float = 0.02
     max_actor_restarts: int = 3
+    transport: str = "file"
+    fanout: int = 2
+    bind_host: str = "127.0.0.1"
+    fetch_timeout_s: float = 60.0
 
     from_dict = classmethod(_strict_from_dict)
 

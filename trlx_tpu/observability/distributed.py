@@ -75,6 +75,7 @@ PACK_FIELDS = (
     "device_bytes",  # device bytes in use (host RSS on CPU)
     "clock_s",  # clock fine part: (perf_counter − epoch) mod _CLOCK_COARSE_S
     "clock_hi_s",  # clock coarse part: the subtracted _CLOCK_COARSE_S multiple
+    "fleet_size",  # live async actor-fleet members (−1: no fleet on this rank)
 )
 
 # The clock stamp is split coarse+fine so float32 packing stays sub-ms for
@@ -140,6 +141,7 @@ class ClusterTelemetry:
             "tokens_per_sec": 0.0,
             "device_bytes": 0.0,
         }
+        self._fleet_size = -1.0
         self._last_beat_t: Optional[float] = None
 
     # -- feeding ---------------------------------------------------------
@@ -157,6 +159,13 @@ class ClusterTelemetry:
             "tokens_per_sec": float(tokens_per_sec),
             "device_bytes": float(device_bytes),
         }
+
+    def note_fleet(self, size: Optional[int]) -> None:
+        """Record this rank's live async actor-fleet size (``None`` = no
+        collective fleet here). The membership gauge rides the NEXT beat's
+        packed vector — the same allgather as everything else, so elastic
+        fleet visibility adds zero new sync points."""
+        self._fleet_size = -1.0 if size is None else float(size)
 
     # -- the beat --------------------------------------------------------
 
@@ -203,6 +212,7 @@ class ClusterTelemetry:
                 self._last_step["device_bytes"],
                 clock - clock_hi,
                 clock_hi,
+                self._fleet_size,
             ],
             np.float32,
         )
@@ -318,6 +328,13 @@ class ClusterTelemetry:
         metrics.set_gauge("cluster/tokens_per_sec_sum", float(tps.sum()))
         metrics.set_gauge("cluster/device_bytes_in_use_max", float(mem.max()))
         metrics.set_gauge("cluster/straggler_rank", float(straggler))
+        # elastic actor-fleet membership (docs/ASYNC_RL.md "Transports"):
+        # the learner rank carries the live member count, peers carry −1 —
+        # publish only when some rank actually hosts a fleet (a −1 gauge
+        # on every fleet-less run would just pollute dashboards)
+        fleet = matrix[:, 8]
+        if fleet.max() >= 0:
+            metrics.set_gauge("cluster/fleet_size", float(fleet.max()))
 
     def clock_offsets(self) -> Dict[int, float]:
         """rank → seconds to ADD to that rank's tracer-relative timestamps

@@ -70,6 +70,28 @@ def mesh_descriptor(mesh: Mesh) -> dict:
     }
 
 
+def local_mesh(parallel: Optional[ParallelConfig] = None) -> Mesh:
+    """A mesh over THIS process's local devices only — the actor-slice
+    mesh of a disaggregated fleet (Podracer's learner/actor mesh pairs,
+    arXiv 2104.06272; RLAX's actor slices, arXiv 2512.06392).
+
+    A fleet member's compiled programs (generation, scoring) must never
+    span another member's devices: learner and actors run *different*
+    programs concurrently, so a global mesh would deadlock the first time
+    one side launched a collective the other never posts. Each member
+    therefore builds its mesh from ``jax.local_devices()``; the host-side
+    fleet fabric (``async_rl/transport.py``) carries params and experience
+    *between* the per-member meshes. In single-runtime deployments (every
+    process its own JAX world — today's CPU harness) local and global
+    devices coincide and this is simply :func:`make_mesh`; in a shared
+    ``jax.distributed`` world it is the actor's slice carved out of the
+    pod. The member advertises ``mesh_descriptor(local_mesh())`` in its
+    fleet HELLO, so the coordinator can log the fleet's topology."""
+    import jax
+
+    return make_mesh(parallel, devices=jax.local_devices())
+
+
 def mesh_shape_from_config(
     parallel: ParallelConfig, device_count: Optional[int] = None
 ) -> Tuple[int, int, int, int, int, int]:

@@ -1542,6 +1542,13 @@ class TPUBaseTrainer(BaseRLTrainer):
                             stats.get("memory/host_rss_bytes", 0.0),
                         ),
                     )
+                    # elastic fleet membership rides the same beat vector
+                    # (async_rl.transport: collective; None off-fleet)
+                    collector = getattr(self, "_async", None)
+                    if collector is not None and hasattr(
+                        collector, "fleet_size"
+                    ):
+                        self.obs.cluster.note_fleet(collector.fleet_size())
                     self.obs.note_dropped_spans()
                     stats.update(self.obs.metrics.snapshot())
                     # the flight recorder keeps the last N steps' stats for

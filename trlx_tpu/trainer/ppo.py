@@ -914,7 +914,64 @@ class PPOTrainer(TPUBaseTrainer):
 
         acfg = self.config.async_rl
         capacity = self._async_queue_capacity()
-        if acfg.mode == "process":
+        coordinator = None
+        member_factory = None
+        if acfg.transport not in ("file", "collective"):
+            raise ValueError(
+                f"unknown async_rl.transport '{acfg.transport}' "
+                "(file | collective)"
+            )
+        if acfg.transport == "collective":
+            # the fleet fabric (async_rl/transport.py): param-dissemination
+            # tree + in-fabric chunk commits + elastic membership. The file
+            # transports below remain the degraded/fallback mode.
+            if acfg.queue_policy == "drop_oldest":
+                raise ValueError(
+                    "async_rl.transport: collective back-pressures through "
+                    "the fleet production window; queue_policy: drop_oldest "
+                    "is a file-transport knob"
+                )
+            from trlx_tpu.async_rl.transport import (
+                CollectiveExperienceQueue,
+                CollectiveWeightChannel,
+                FleetCoordinator,
+                make_member_factory,
+                write_endpoint,
+            )
+
+            coordinator = FleetCoordinator(
+                fanout=acfg.fanout,
+                bind_host=acfg.bind_host,
+                capacity=capacity,
+                plan=self.resilience.plan,
+                metrics=self.obs.metrics,
+                sync_every=acfg.sync_every,
+                actor_timeout_s=acfg.actor_timeout_s,
+            )
+            queue = CollectiveExperienceQueue(coordinator)
+            channel = CollectiveWeightChannel(coordinator)
+            if acfg.mode == "process":
+                if not acfg.root_dir:
+                    raise ValueError(
+                        "async_rl.mode: process requires async_rl.root_dir "
+                        "(endpoint discovery for the run_actor processes)"
+                    )
+                write_endpoint(
+                    acfg.root_dir, coordinator.address, coordinator.authkey
+                )
+                spawn = False  # actors are external run_actor processes
+            elif acfg.mode == "thread":
+                # each actor thread joins the fleet as its own member over
+                # loopback — the same wire protocol as a pod's processes
+                member_factory = make_member_factory(
+                    coordinator, lambda: self.state.params
+                )
+                spawn = True
+            else:
+                raise ValueError(
+                    f"unknown async_rl.mode '{acfg.mode}' (thread | process)"
+                )
+        elif acfg.mode == "process":
             if not acfg.root_dir:
                 raise ValueError(
                     "async_rl.mode: process requires async_rl.root_dir (a "
@@ -932,6 +989,7 @@ class PPOTrainer(TPUBaseTrainer):
                 metrics=self.obs.metrics,
                 sync_every=acfg.sync_every,
                 poll_interval_s=acfg.poll_interval_s,
+                fetch_timeout_s=acfg.fetch_timeout_s,
             )
             spawn = False  # actors are external run_actor processes
         elif acfg.mode == "thread":
@@ -969,6 +1027,8 @@ class PPOTrainer(TPUBaseTrainer):
             metrics=self.obs.metrics,
             tracer=self.obs.tracer,
             span=self.obs.span,
+            member_factory=member_factory,
+            transport=coordinator,
         )
         self._async.version = self._async_version
         return self._async
