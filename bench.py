@@ -855,6 +855,13 @@ def main():
     # when the untimed dump+reload probe found span AND metric records in
     # the ring the warmup populated; null when BENCH_FAULTS=0
     line["flight_recorder"] = flight_recorder
+    # RL health verdict (docs/OBSERVABILITY.md "Training dynamics"): "ok"
+    # or the first tripped detector at the end of the timed cycles — a
+    # degenerate-run artifact is labeled as such, not read as a perf number
+    try:
+        line["health"] = str(trainer.obs.health.verdict)
+    except Exception:
+        line["health"] = None
     # cross-rank step skew (docs/OBSERVABILITY.md "Distributed telemetry"):
     # max−min per-rank step time at the last cluster beat — 0.0 on a
     # single process, the straggler signal on a pod

@@ -20,8 +20,10 @@ if REPO_ROOT not in sys.path:
 
 from trlx_tpu.analysis.conventions import (  # noqa: E402,F401
     CLUSTER_KEYS,
+    DIST_KEYS,
     ENGINE_KEYS,
     FLIGHTREC_KEYS,
+    HEALTH_KEYS,
     LEGACY_KEYS,
     OBS_KEYS,
     RESILIENCE_KEYS,

@@ -95,6 +95,17 @@ def test_scanner_sees_the_codebase():
     assert "async/fleet_joins" in keys
     assert "async/fleet_shrinks" in keys
     assert "cluster/fleet_size" in keys
+    # training-dynamics / health keys (docs/OBSERVABILITY.md "Training
+    # dynamics"): the literal sites — the engine canary gauges, the NaN-guard
+    # counters, and the triage-dump counter (the dist/* sketch keys and the
+    # per-detector gauges are parameterized f-string emissions, registered in
+    # DIST_KEYS / HEALTH_KEYS instead)
+    assert "rollout/gen_len_p50" in keys
+    assert "rollout/repetition_frac" in keys
+    assert "health/kl_ctl_skips" in keys
+    assert "health/triage_dumps" in keys
+    assert "health/nonfinite_scores" in keys
+    assert "health/nonfinite_kl_chunks" in keys
 
 
 def test_engine_keys_registered_and_namespaced():
@@ -139,6 +150,29 @@ def test_cluster_flightrec_obs_keys_registered_and_namespaced():
         assert missing == set(), (
             f"{registry_name} entries not seen by the scanner: {missing}"
         )
+
+
+def test_dist_and_health_keys_registered_and_namespaced():
+    """Every canonical dist/* sketch key and health/* detector key
+    (docs/OBSERVABILITY.md "Training dynamics") is registered in the checker
+    and follows the namespace/name convention — including the histogram and
+    per-detector keys the static scan can't see (parameterized f-string
+    emissions in observability/dynamics.py and health.py)."""
+    checker = _load_checker()
+    keys = checker.scanned_keys()
+    for registry_name in ("DIST_KEYS", "HEALTH_KEYS"):
+        registry = getattr(checker, registry_name)
+        assert registry, f"{registry_name} is empty"
+        for key in registry:
+            assert checker._CONVENTION_RE.match(key), key
+    # the statically-visible health sites must reach the scanner
+    visible = {k for k in checker.HEALTH_KEYS if k in keys}
+    assert {
+        "health/kl_ctl_skips",
+        "health/triage_dumps",
+        "rollout/gen_len_p50",
+        "rollout/repetition_frac",
+    } <= visible
 
 
 def test_lint_catches_a_bad_key(tmp_path):

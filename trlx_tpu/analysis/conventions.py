@@ -189,6 +189,57 @@ OBS_KEYS = frozenset({
     "obs/spans_dropped",
 })
 
+# Canonical training-dynamics sketch keys (observability/dynamics.py,
+# docs/OBSERVABILITY.md "Training dynamics"). The ``*_hist`` keys carry the
+# on-device fixed-bin histogram counts through the stats fetch; the host
+# summarizer collapses each into ``_p05/_p50/_p95`` percentile gauges (the
+# summary keys are emitted through parameterized f-strings, so the registry
+# is their single canonical list).
+DIST_KEYS = frozenset({
+    "dist/log_ratio_hist",
+    "dist/kl_hist",
+    "dist/ref_kl_hist",
+    "dist/advantages_hist",
+    "dist/value_error_hist",
+    "dist/entropy_hist",
+    "dist/reward_margin_hist",
+    # host-side summaries (DynamicsSummarizer): one triple per histogram
+    "dist/log_ratio_p05", "dist/log_ratio_p50", "dist/log_ratio_p95",
+    "dist/kl_p05", "dist/kl_p50", "dist/kl_p95",
+    "dist/ref_kl_p05", "dist/ref_kl_p50", "dist/ref_kl_p95",
+    "dist/advantages_p05", "dist/advantages_p50", "dist/advantages_p95",
+    "dist/value_error_p05", "dist/value_error_p50", "dist/value_error_p95",
+    "dist/entropy_p05", "dist/entropy_p50", "dist/entropy_p95",
+    "dist/reward_margin_p05", "dist/reward_margin_p50",
+    "dist/reward_margin_p95",
+    # mass of per-token ratio beyond the PPO clip window [1−ε, 1+ε]
+    "dist/ratio_outside_clip_frac",
+})
+
+# Canonical RL health keys (observability/health.py, docs/OBSERVABILITY.md
+# "Training dynamics"): one 0/1 gauge per windowed detector plus the overall
+# verdict (detector gauges are published through a parameterized f-string —
+# registered here), the rollout canary gauges, and the counters the NaN
+# guards bump (kl-controller skips, sanitized scores/KL chunks, triage
+# artifact dumps).
+HEALTH_KEYS = frozenset({
+    "health/kl_runaway",
+    "health/entropy_collapse",
+    "health/clipfrac_saturation",
+    "health/value_ev_collapse",
+    "health/reward_flatline",
+    "health/gen_canary",
+    "health/verdict",
+    "health/kl_ctl_skips",
+    "health/triage_dumps",
+    "health/nonfinite_scores",
+    "health/nonfinite_kl_chunks",
+    # rollout-side generation canary (engine harvest + finalize host twin)
+    "rollout/gen_len_p50",
+    "rollout/gen_len_p95",
+    "rollout/repetition_frac",
+})
+
 
 def _iter_line_keys(lines) -> "List[Tuple[int, str]]":
     """(lineno, key) for every literal metric-key site in ``lines`` — the

@@ -51,9 +51,14 @@ class MethodConfig:
     """Base config for an RL method.
 
     :param name: registry name of the method (e.g. ``"PPOConfig"``).
+    :param dist_sketches: emit on-device distribution sketches of training
+        dynamics from the loss (``dist/*_hist`` — observability/dynamics.py).
+        Sketches are stop-gradient'd and ride the existing stats fetch, so
+        disabling buys nothing but a few histogram scatters per step.
     """
 
     name: str = "MethodConfig"
+    dist_sketches: bool = True
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

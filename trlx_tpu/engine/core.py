@@ -144,6 +144,12 @@ class EngineStats:
     prefix_tokens_saved: int = 0  # prompt columns NOT re-prefilled
     prefix_evicted_blocks: int = 0
     prefill_tokens: int = 0  # prompt columns actually prefilled
+    # harvest-side generation canary (observability/health.py gen_canary):
+    # per-sequence generated lengths, and adjacent repeated-token pairs —
+    # the cheap on-harvest signal for degenerate looping generations
+    gen_len_samples: List[float] = field(default_factory=list)
+    repeat_pairs: int = 0  # adjacent equal-token pairs in responses
+    repeat_pairs_total: int = 0  # adjacent in-response pairs observed
 
     @property
     def slot_utilization(self) -> float:
@@ -182,6 +188,32 @@ class EngineStats:
             return 0.0
         return float(max(self.decode_stall_samples))
 
+    def note_harvest(self, tokens: np.ndarray, mask: np.ndarray) -> None:
+        """Fold one harvested [B, N] (or [N]) response block into the
+        generation canary: per-row generated lengths and the repeated
+        adjacent-token fraction. Host numpy on already-fetched arrays."""
+        tokens = np.atleast_2d(np.asarray(tokens))
+        mask = np.atleast_2d(np.asarray(mask, np.float32))
+        lens = mask.sum(axis=1)
+        self.gen_len_samples.extend(float(n) for n in lens)
+        if tokens.shape[1] > 1:
+            pair_mask = mask[:, 1:] * mask[:, :-1]
+            self.repeat_pairs += int(
+                ((tokens[:, 1:] == tokens[:, :-1]) * pair_mask).sum()
+            )
+            self.repeat_pairs_total += int(pair_mask.sum())
+
+    @property
+    def repetition_frac(self) -> float:
+        if self.repeat_pairs_total == 0:
+            return 0.0
+        return self.repeat_pairs / self.repeat_pairs_total
+
+    def _gen_len_pct(self, q: float) -> float:
+        if not self.gen_len_samples:
+            return 0.0
+        return float(np.percentile(np.asarray(self.gen_len_samples), q))
+
     def metrics(self) -> Dict[str, float]:
         """The observability-layer gauges (registered in
         ``tests/test_metric_names.py``; see docs/OBSERVABILITY.md)."""
@@ -200,6 +232,12 @@ class EngineStats:
         stats["rollout/decode_stall_p95"] = self.decode_stall_p95
         stats["rollout/decode_stall_max"] = self.decode_stall_max
         stats["rollout/prefill_chunks"] = float(self.prefill_chunk_calls)
+        # generation canary (observability/health.py): length percentiles
+        # and repeated-token fraction over everything harvested so far
+        if self.gen_len_samples:
+            stats["rollout/gen_len_p50"] = self._gen_len_pct(50.0)
+            stats["rollout/gen_len_p95"] = self._gen_len_pct(95.0)
+            stats["rollout/repetition_frac"] = self.repetition_frac
         if self.kv_blocks_total:
             stats["engine/kv_blocks_in_use"] = float(self.kv_blocks_in_use)
             stats["engine/block_pool_occupancy"] = self.kv_blocks_in_use / max(
@@ -329,6 +367,7 @@ class SerialEngine(Engine):
         self.stats.slot_steps += steps * n
         self.stats.live_slot_steps += int(host["mask"].sum())
         self.stats.harvested += n
+        self.stats.note_harvest(host["tokens"], host["mask"])
         return [
             CompletedSequence(
                 index=idx[i],
@@ -940,6 +979,7 @@ class ContinuousEngine(Engine):
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()
         host = {k: np.asarray(v) for k, v in rows.items()}
+        self.stats.note_harvest(host["tokens"], host["mask"])
         t_harvest = time.perf_counter()
         completed = []
         for j, slot in enumerate(finished):  # slot order: deterministic

@@ -65,7 +65,20 @@ class DPOConfig(MethodConfig):
 
         chosen_rewards = self.beta * (policy_chosen_logps - ref_chosen_logps)
         rejected_rewards = self.beta * (policy_rejected_logps - ref_rejected_logps)
+        dist = {}
+        if self.dist_sketches:
+            from trlx_tpu.observability.dynamics import loss_sketches
+
+            # per-pair margins, [B] with no mask — the margin *distribution*
+            # separates "uniformly confident" from "a few saturated pairs"
+            dist = loss_sketches(
+                {
+                    "log_ratio": (logits, None),
+                    "reward_margin": (chosen_rewards - rejected_rewards, None),
+                }
+            )
         stats = dict(
+            **dist,
             losses=dict(total_loss=loss),
             rewards=dict(
                 chosen=chosen_rewards.mean(),

@@ -135,8 +135,22 @@ class GRPOConfig(PPOConfig):
 
         approx_kl_old = 0.5 * jnp.sum(log_ratio**2) / n  # vs behavior policy
         clipfrac = jnp.sum((pg_loss2 > pg_loss1).astype(jnp.float32) * mask) / n
+        dist = {}
+        if self.dist_sketches:
+            from trlx_tpu.observability.dynamics import loss_sketches
+
+            # per-token ref-KL is the k3 integrand GRPO already penalizes;
+            # advantages are per-sequence [B] (mask=None — every row counts)
+            dist = loss_sketches(
+                {
+                    "log_ratio": (log_ratio, mask),
+                    "ref_kl": (jnp.exp(delta) - delta - 1.0, mask),
+                    "advantages": (advantages, None),
+                }
+            )
         stats = dict(
             **iw_stats,
+            **dist,
             losses=dict(
                 total_loss=loss,
                 policy_loss=pg_loss,

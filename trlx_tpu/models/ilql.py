@@ -127,7 +127,22 @@ class ILQLConfig(MethodConfig):
 
         loss = loss_q + loss_v + self.cql_scale * loss_cql + self.awac_scale * loss_awac
 
+        dist = {}
+        if self.dist_sketches:
+            from trlx_tpu.observability.dynamics import entropy_of_logits, loss_sketches
+
+            # TD error of the first Q head as the value-error sketch, the
+            # expectile target gap (minQ' − V) as the advantage analogue
+            dist = loss_sketches(
+                {
+                    "value_error": (Q[0] - Q_target, terminal_mask),
+                    "advantages": (diff, terminal_mask),
+                    "entropy": (entropy_of_logits(logits), terminal_mask),
+                }
+            )
+
         stats = dict(
+            **dist,
             losses=dict(
                 loss=loss,
                 loss_q=loss_q,

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from trlx_tpu.data.method_configs import MethodConfig, register_method
+from trlx_tpu.observability.dynamics import loss_sketches
 from trlx_tpu.utils.stats import get_tensor_stats, whiten
 from trlx_tpu.utils import flatten_dict
 
@@ -28,14 +29,24 @@ class AdaptiveKLController:
     β is multiplied by ``1 + clip(KL/target - 1, ±0.2) · n/horizon`` after
     each round of rollouts. Host-side scalar state, folded into the compiled
     step as an argument (so updating it never triggers a recompile).
+
+    A non-finite ``current_kl`` (one bad batch) is *skipped* rather than
+    folded in — multiplying by NaN would poison ``self.value`` forever, and
+    β reaches every subsequent reward via ``kl_penalty_rewards``. Skips are
+    counted in :attr:`skipped` and surfaced as the ``health/kl_ctl_skips``
+    gauge (trainer/ppo.py ``post_backward_callback``).
     """
 
     def __init__(self, init_kl_coef: float, target: float, horizon: int):
         self.value = float(init_kl_coef)
         self.target = target
         self.horizon = horizon
+        self.skipped = 0
 
     def update(self, current_kl: float, n_steps: int) -> None:
+        if not np.isfinite(current_kl):
+            self.skipped += 1
+            return
         proportional_error = float(np.clip(current_kl / self.target - 1, -0.2, 0.2))
         self.value *= 1 + proportional_error * n_steps / self.horizon
 
@@ -200,8 +211,23 @@ class PPOConfig(MethodConfig):
 
         loss = pg_loss + self.vf_coef * vf_loss
 
+        dist = {}
+        if self.dist_sketches:
+            # stop-gradient'd histograms of the loss's own intermediates
+            # (observability/dynamics.py) — ride the stats fetch, feed
+            # nothing back, so the objective is bit-identical either way
+            dist = loss_sketches(
+                {
+                    "log_ratio": (log_ratio, mask),
+                    "kl": ((ratio - 1) - log_ratio, mask),
+                    "advantages": (advantages, mask),
+                    "value_error": (values - returns, mask),
+                }
+            )
+
         stats = dict(
             **iw_stats,
+            **dist,
             losses=dict(total_loss=loss, policy_loss=pg_loss, value_loss=vf_loss),
             values=dict(
                 get_tensor_stats(values, mask, n),

@@ -20,7 +20,14 @@ that gap:
   merged multi-rank Perfetto traces on one aligned clock;
 - :mod:`flightrec` — a crash flight recorder: bounded ring of recent
   spans, metric updates, and resilience events, dumped as
-  ``flightrec.json`` on any exception/NaN-halt/preemption.
+  ``flightrec.json`` on any exception/NaN-halt/preemption;
+- :mod:`dynamics` — on-device fixed-bin distribution sketches of training
+  dynamics (log-ratio, KL, advantages, value error, entropy) riding the
+  existing stats fetch, summarized into ``dist/*`` percentile gauges;
+- :mod:`health` — windowed RL health detectors (KL runaway, entropy
+  collapse, clipfrac saturation, value EV collapse, reward flatline,
+  generation canary) publishing ``health/*`` gauges and triggering
+  bad-batch triage dumps.
 
 :class:`Observability` bundles one instance of each per trainer. See
 ``docs/OBSERVABILITY.md`` for the span API and metric naming convention.
@@ -33,7 +40,9 @@ from trlx_tpu.observability.distributed import (
     ClusterDesyncError,
     ClusterTelemetry,
 )
+from trlx_tpu.observability.dynamics import DynamicsSummarizer
 from trlx_tpu.observability.flightrec import FlightRecorder
+from trlx_tpu.observability.health import HealthMonitor
 from trlx_tpu.observability.metrics import (
     DEFAULT_PEAK_FLOPS,
     MetricsRegistry,
@@ -54,7 +63,9 @@ __all__ = [
     "ClusterTelemetry",
     "DEFAULT_PEAK_FLOPS",
     "DeviceMemoryGauge",
+    "DynamicsSummarizer",
     "FlightRecorder",
+    "HealthMonitor",
     "MetricsRegistry",
     "Observability",
     "ProfileWindow",
@@ -101,6 +112,18 @@ class Observability:
         # seam drives beat(); single-process it degenerates to local gauges
         self.cluster = ClusterTelemetry(
             self.tracer, self.metrics, flightrec=self.flightrec
+        )
+        # training-dynamics sketches + windowed health detectors
+        # (dynamics.py / health.py); method knobs read duck-typed so a bare
+        # Observability() in tests still builds
+        method = getattr(config, "method", None)
+        self.dynamics = DynamicsSummarizer(
+            cliprange=getattr(method, "cliprange", None)
+        )
+        self.health = HealthMonitor(
+            metrics=self.metrics,
+            flightrec=self.flightrec,
+            kl_target=getattr(method, "target", None),
         )
         self._warned_dropped = False
         # wall-clock construction time: the merge's staleness floor — peer

@@ -1,0 +1,193 @@
+"""On-device distribution sketches of training dynamics.
+
+The loss functions report masked *means* only (``policy/approx_kl``,
+``policy/clipfrac``, mean ``ratio`` — ``trlx_tpu/models/ppo.py``), which is
+exactly the wrong granularity for the failure modes that actually kill RLHF
+runs: KL runaway lives in the ratio distribution's tails, entropy collapse in
+its left edge, value-function divergence in the error distribution's spread —
+all invisible in a mean until the run is already wrecked (the silent-failure
+mode RLAX reports dominating large-scale TPU RL; PAPERS.md).
+
+The sketch is a **fixed-bin masked histogram** computed *inside* the jitted
+train step from stop-gradient'd intermediates the loss already materializes:
+
+- fixed bins (``SKETCH_BINS`` over a per-quantity ``SKETCH_RANGES`` window,
+  out-of-range values clamped into the edge bins — the edges double as
+  "mass beyond the window" tail counters), so the array shape is static and
+  the program never recompiles as the distribution moves;
+- the counts pytree rides the existing stats fetch back to host — **zero
+  new host syncs** — where :class:`DynamicsSummarizer` turns each histogram
+  into ``dist/<name>_{p05,p50,p95}`` gauges (plus
+  ``dist/ratio_outside_clip_frac``) for the tracker stream, and
+  ``filter_non_scalars`` drops the raw arrays as before;
+- every sketched quantity passes through ``stop_gradient`` and feeds nothing
+  back into the objective, so the sketch-enabled step is **bit-identical**
+  in loss and params to the sketch-free step (pinned by
+  ``tests/test_health.py``).
+
+Under gradient accumulation the train step *averages* stats over
+microbatches, so the fetched counts are ``sum/accum`` — a uniform rescale
+that leaves every percentile and mass fraction unchanged.
+
+Emission is gated by ``method.dist_sketches`` (on by default); the host-side
+summaries feed the windowed health detectors (``observability/health.py``).
+Bins/ranges and the artifact formats: docs/OBSERVABILITY.md "Training
+dynamics".
+"""
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+SKETCH_BINS = 32
+
+# Per-quantity histogram windows. Deliberately generous: healthy runs live
+# well inside them, and a distribution escaping its window piles mass into
+# the edge bins — which is itself the signal (p95 pegged at the window edge).
+SKETCH_RANGES: Dict[str, Tuple[float, float]] = {
+    "log_ratio": (-1.0, 1.0),  # new − old per-token logprob delta
+    "kl": (0.0, 1.0),  # per-token k3 estimator vs the behavior policy
+    "ref_kl": (0.0, 1.0),  # per-token k3 vs the frozen reference (rollout)
+    "advantages": (-5.0, 5.0),  # whitened GAE / group-relative advantages
+    "value_error": (-5.0, 5.0),  # value prediction − return
+    "entropy": (0.0, 12.0),  # per-token policy entropy, nats (ln V ≈ 10.8)
+    "reward_margin": (-10.0, 10.0),  # DPO chosen − rejected implicit reward
+}
+
+_HIST_KEY_RE = re.compile(r"^dist/(\w+)_hist$")
+
+
+def sketch(x, mask=None, *, lo: float, hi: float, bins: int = SKETCH_BINS):
+    """Masked fixed-bin histogram of ``x`` — pure JAX, trace-safe.
+
+    Values are stop-gradient'd and clamped into ``[lo, hi)`` (the edge bins
+    absorb the tails), masked-out positions contribute zero weight. Returns
+    float32 counts of shape ``[bins]``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.lax.stop_gradient(jnp.asarray(x).astype(jnp.float32))
+    if mask is None:
+        weights = jnp.ones(x.shape, jnp.float32)
+    else:
+        weights = jax.lax.stop_gradient(jnp.asarray(mask).astype(jnp.float32))
+    scale = bins / (hi - lo)
+    idx = jnp.clip(((x - lo) * scale).astype(jnp.int32), 0, bins - 1)
+    counts = jnp.zeros((bins,), jnp.float32)
+    return counts.at[idx.reshape(-1)].add(weights.reshape(-1))
+
+
+def sketch_np(x, mask=None, *, lo: float, hi: float, bins: int = SKETCH_BINS):
+    """Host (numpy) twin of :func:`sketch` — same bin math on already-fetched
+    arrays. The rollout finalize stage uses it for the reference-KL sketch
+    (the per-token ref logprobs only exist on host there)."""
+    x = np.asarray(x, np.float32)
+    weights = (
+        np.ones(x.shape, np.float32)
+        if mask is None
+        else np.asarray(mask, np.float32)
+    )
+    scale = bins / (hi - lo)
+    idx = np.clip(((x - lo) * scale).astype(np.int32), 0, bins - 1)
+    counts = np.zeros((bins,), np.float32)
+    np.add.at(counts, idx.reshape(-1), weights.reshape(-1))
+    return counts
+
+
+def loss_sketches(named: Dict[str, Tuple[Any, Any]]) -> Dict[str, Any]:
+    """Sketch each ``name -> (values, mask)`` pair into the canonical
+    ``dist/<name>_hist`` stats keys (ranges from :data:`SKETCH_RANGES`).
+    The loss functions merge the result into their stats dict, so the counts
+    ride the existing device→host stats fetch."""
+    out = {}
+    for name, (values, mask) in named.items():
+        lo, hi = SKETCH_RANGES[name]
+        out[f"dist/{name}_hist"] = sketch(values, mask, lo=lo, hi=hi)
+    return out
+
+
+def entropy_of_logits(logits):
+    """Per-token policy entropy (nats) from ``[..., V]`` logits, computed in
+    f32 under ``stop_gradient`` so sketching it perturbs nothing."""
+    import jax
+
+    logits = jax.lax.stop_gradient(logits.astype("float32"))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -(jax.numpy.exp(logp) * logp).sum(axis=-1)
+
+
+def hist_percentile(counts: np.ndarray, lo: float, hi: float, q: float) -> float:
+    """Percentile ``q`` (0-100) from fixed-bin counts, linearly interpolated
+    inside the containing bin. Caller guarantees ``counts.sum() > 0``."""
+    counts = np.asarray(counts, np.float64)
+    bins = counts.shape[0]
+    width = (hi - lo) / bins
+    cum = np.cumsum(counts)
+    target = cum[-1] * (q / 100.0)
+    i = int(np.searchsorted(cum, target))
+    i = min(i, bins - 1)
+    prev = cum[i - 1] if i > 0 else 0.0
+    frac = (target - prev) / max(counts[i], 1e-12)
+    return float(lo + (i + min(max(frac, 0.0), 1.0)) * width)
+
+
+def hist_mass_outside(
+    counts: np.ndarray, lo: float, hi: float, lower: float, upper: float
+) -> float:
+    """Fraction of histogram mass outside ``[lower, upper]``, with linear
+    within-bin interpolation at the boundaries."""
+    counts = np.asarray(counts, np.float64)
+    total = counts.sum()
+    if total <= 0:
+        return 0.0
+    bins = counts.shape[0]
+    width = (hi - lo) / bins
+    edges = lo + width * np.arange(bins + 1)
+    # per-bin overlap fraction with [lower, upper]
+    inside_lo = np.clip(edges[:-1], lower, upper)
+    inside_hi = np.clip(edges[1:], lower, upper)
+    inside_frac = np.clip((inside_hi - inside_lo) / width, 0.0, 1.0)
+    inside_mass = float((counts * inside_frac).sum())
+    return float(1.0 - inside_mass / total)
+
+
+class DynamicsSummarizer:
+    """Host-side collapse of the fetched ``dist/*_hist`` counts into scalar
+    tracker gauges.
+
+    One instance per trainer (``trainer.obs.dynamics``); the learn loop calls
+    :meth:`summarize` on the host stats dict *before* ``filter_non_scalars``
+    strips the raw arrays. Emits ``dist/<name>_p05|_p50|_p95`` per sketch,
+    plus ``dist/ratio_outside_clip_frac`` — the fraction of per-token ratio
+    mass beyond the PPO clip window ``[1−ε, 1+ε]``, the direct precursor of
+    clipfrac saturation (a mean clipfrac of 0.3 can be one-third of tokens
+    barely clipped or a bimodal ratio blowup; the tail mass tells them
+    apart).
+    """
+
+    def __init__(self, cliprange: Optional[float] = None):
+        self.cliprange = float(cliprange) if cliprange else None
+
+    def summarize(self, host_stats: Dict[str, Any]) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for key, value in host_stats.items():
+            m = _HIST_KEY_RE.match(key) if isinstance(key, str) else None
+            if m is None:
+                continue
+            counts = np.asarray(value, np.float64).reshape(-1)
+            if counts.sum() <= 0:  # empty mask — nothing to summarize
+                continue
+            name = m.group(1)
+            lo, hi = SKETCH_RANGES.get(name, (0.0, 1.0))
+            out[f"dist/{name}_p05"] = hist_percentile(counts, lo, hi, 5.0)
+            out[f"dist/{name}_p50"] = hist_percentile(counts, lo, hi, 50.0)
+            out[f"dist/{name}_p95"] = hist_percentile(counts, lo, hi, 95.0)
+            if name == "log_ratio" and self.cliprange:
+                lo_r = float(np.log(max(1.0 - self.cliprange, 1e-6)))
+                hi_r = float(np.log(1.0 + self.cliprange))
+                out["dist/ratio_outside_clip_frac"] = hist_mass_outside(
+                    counts, lo, hi, lo_r, hi_r
+                )
+        return out

@@ -15,7 +15,7 @@ Plan syntax (``;``-separated entries, whitespace ignored)::
     kind     one of: reward_raise | publish_raise | sigterm | sigint |
              sigterm_one_proc | nan_loss | crash_save | topology_shrink |
              sleep_one_proc | flightrec_dump | actor_crash |
-             weight_sync_drop
+             weight_sync_drop | health_trip
     trigger  call  — the Nth invocation of the consulting site (1-based;
                      for reward_raise/publish_raise every *attempt* counts,
                      so retries advance the counter)
@@ -55,6 +55,11 @@ Examples::
                                  # the previous params until the next
                                  # publish (deterministic staleness/IW
                                  # exercise)
+    health_trip@step:1           # force the RL health monitor to trip at
+                                 # the boundary before update 2 — exercises
+                                 # the detector → flightrec-dump → bad-batch
+                                 # triage path (observability/health.py)
+                                 # without needing an organically sick run
 
 Plans come from ``config.resilience.fault_plan`` or the
 ``TRLX_TPU_FAULT_PLAN`` env var (env wins — a relaunched run can drop the
@@ -72,7 +77,7 @@ from typing import Dict, List, Optional
 _KINDS = frozenset({
     "reward_raise", "publish_raise", "sigterm", "sigint", "sigterm_one_proc",
     "nan_loss", "crash_save", "topology_shrink", "sleep_one_proc",
-    "flightrec_dump", "actor_crash", "weight_sync_drop",
+    "flightrec_dump", "actor_crash", "weight_sync_drop", "health_trip",
 })
 
 # how long a ``sleep_one_proc`` fault stalls the afflicted rank's train step

@@ -61,10 +61,16 @@ from trlx_tpu.utils.checkpoint import (
 )
 from trlx_tpu.observability import Observability, train_step_flops
 from trlx_tpu.observability import mfu as obs_mfu
-from trlx_tpu.resilience import Resilience, TrainingPreempted
+from trlx_tpu.resilience import UPDATE_OK_KEY, Resilience, TrainingPreempted
 from trlx_tpu.utils.trackers import make_tracker
 
 logger = logging.get_logger(__name__)
+
+# Bad-batch triage bounds (docs/OBSERVABILITY.md "Training dynamics"): cap
+# rows per dump and dumps per run so a persistently-tripping detector can't
+# fill the disk with repro artifacts.
+TRIAGE_MAX_ROWS = 64
+TRIAGE_MAX_DUMPS = 8
 
 
 @flax.struct.dataclass
@@ -336,6 +342,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         self.best_reward = -float("inf")
         self._emergency_resume = False
         self._prompt_chunks_drawn = 0
+        self._triage_dumps = 0
 
     # ------------------------------------------------------------------
     # subclass contract
@@ -1356,6 +1363,80 @@ class TPUBaseTrainer(BaseRLTrainer):
         except Exception:  # pragma: no cover - defensive
             pass
 
+    def _triage_extra(self, arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Subclass hook: derived per-token quantities worth keeping with a
+        triaged batch (e.g. the PPO trainer adds advantages/returns and
+        per-token logprob deltas). Must not raise past its own best effort."""
+        return {}
+
+    def _dump_triage(self, reason: str, stats: Dict[str, Any]) -> Optional[str]:
+        """Write the current (memoized) batch as ``triage/step<N>.npz`` so a
+        bad update is reproducible offline — tokens, masks, and whatever the
+        trainer derives (docs/OBSERVABILITY.md "Training dynamics").
+
+        Bounded (first ``TRIAGE_MAX_ROWS`` rows, at most ``TRIAGE_MAX_DUMPS``
+        files per run), atomic (tmp + ``os.replace``), process 0 only, and
+        never raises — it runs on failure paths. Returns the path or None."""
+        if jax.process_index() != 0:
+            return None
+        directory = self.obs._trace_dir
+        batch = self._last_batch_host
+        if hasattr(batch, "_asdict"):
+            batch = batch._asdict()
+        if not directory or not isinstance(batch, dict):
+            return None
+        if self._triage_dumps >= TRIAGE_MAX_DUMPS:
+            return None
+        try:
+            arrays: Dict[str, np.ndarray] = {}
+            for key, value in batch.items():
+                if hasattr(value, "shape") and getattr(value, "ndim", 0) > 0:
+                    arrays[key] = np.asarray(value[:TRIAGE_MAX_ROWS])
+            if not arrays:
+                return None
+            try:
+                extra = self._triage_extra(arrays)
+            except Exception:  # pragma: no cover - defensive
+                extra = {}
+            for key, value in extra.items():
+                arrays.setdefault(key, np.asarray(value)[:TRIAGE_MAX_ROWS])
+            meta = {
+                "step": self.iter_count,
+                "reason": reason,
+                "rows": int(next(iter(arrays.values())).shape[0]),
+                "stats": {
+                    k: float(v)
+                    for k, v in stats.items()
+                    if isinstance(v, (int, float)) and np.isfinite(v)
+                },
+            }
+            arrays["__meta__"] = np.frombuffer(
+                json.dumps(meta).encode("utf-8"), dtype=np.uint8
+            )
+            triage_dir = os.path.join(directory, "triage")
+            os.makedirs(triage_dir, exist_ok=True)
+            path = os.path.join(triage_dir, f"step{self.iter_count}.npz")
+            tmp = path + ".tmp.npz"
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, path)
+            self._triage_dumps += 1
+            self.obs.metrics.inc("health/triage_dumps")
+            self.obs.flightrec.record(
+                "triage",
+                {
+                    "step": self.iter_count,
+                    "reason": reason,
+                    "path": path,
+                    "keys": sorted(k for k in arrays if k != "__meta__"),
+                },
+            )
+            logger.warning(f"triage batch dumped to {path} ({reason})")
+            return path
+        except Exception:  # pragma: no cover - defensive, crash-path code
+            logger.warning("triage dump failed", exc_info=True)
+            return None
+
     def _check_faults_and_preemption(self) -> None:
         """Step-boundary seam, called before every update: deliver any
         fault-plan signals for this step, coordinate the preemption flag
@@ -1385,6 +1466,10 @@ class TPUBaseTrainer(BaseRLTrainer):
                 self.obs.dump_flight_record(
                     reason=f"fault plan: flightrec_dump@step:{self.iter_count}"
                 )
+            if plan.poll("health_trip", step=self.iter_count):
+                # arm an injected detector trip; this step's health update
+                # consumes it and runs the organic flightrec+triage path
+                self.obs.health.force_trip("fault_plan", step=self.iter_count)
         preemption = self.resilience.preemption
         requested = preemption.requested
         coordinate = self.resilience.config.coordinate_preemption
@@ -1506,7 +1591,21 @@ class TPUBaseTrainer(BaseRLTrainer):
                             # flight after the stats land, and without any
                             # fence the timer reads async dispatch latency
                             sp.fence((self.state, device_stats))
-                    stats = filter_non_scalars(to_host(device_stats))
+                    host_stats = to_host(device_stats)
+                    stats = filter_non_scalars(host_stats)
+                    # collapse the on-device distribution sketches into
+                    # dist/* percentile gauges BEFORE the filter's output is
+                    # used — the raw histogram arrays live only in host_stats
+                    stats.update(self.obs.dynamics.summarize(host_stats))
+                    # a guard-rejected update is the one moment the offending
+                    # batch is still in hand — triage it before any rollback
+                    # (docs/RESILIENCE.md "Update guard", OBSERVABILITY.md
+                    # "Training dynamics")
+                    if stats.get(UPDATE_OK_KEY) == 0.0:
+                        if self._dump_triage("update_guard", stats):
+                            self.obs.dump_flight_record(
+                                reason=f"update guard rejected step {self.iter_count}"
+                            )
                     # update guard: the on-device finiteness flag landed
                     # with the stats; skip was already applied on device,
                     # rollback/halt are host decisions (docs/RESILIENCE.md)
@@ -1551,6 +1650,23 @@ class TPUBaseTrainer(BaseRLTrainer):
                         self.obs.cluster.note_fleet(collector.fleet_size())
                     self.obs.note_dropped_spans()
                     stats.update(self.obs.metrics.snapshot())
+                    # windowed health detectors over this step's metric
+                    # stream; a trip transition dumps the flight record and
+                    # triages the batch that produced it
+                    stats.update(
+                        self.obs.health.update(stats, step=self.iter_count)
+                    )
+                    tripped = self.obs.health.just_tripped
+                    if tripped is not None:
+                        if self._dump_triage(f"health:{tripped}", stats):
+                            # this step's registry snapshot is already taken;
+                            # surface the counter on the step that dumped
+                            stats["health/triage_dumps"] = float(
+                                self._triage_dumps
+                            )
+                        self.obs.dump_flight_record(
+                            reason=f"health_trip: {tripped} @ step {self.iter_count}"
+                        )
                     # the flight recorder keeps the last N steps' stats for
                     # the crash dump (docs/OBSERVABILITY.md)
                     self.obs.flightrec.record(

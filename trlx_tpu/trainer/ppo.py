@@ -33,6 +33,12 @@ from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.ppo_types import PPORLElement
 from trlx_tpu.models.builder import hydra_ref_params
 from trlx_tpu.models.ppo import PPOConfig, kl_penalty_rewards_np
+from trlx_tpu.observability.dynamics import (
+    SKETCH_RANGES,
+    entropy_of_logits,
+    loss_sketches,
+    sketch_np,
+)
 from trlx_tpu.models.transformer import CausalTransformer
 from trlx_tpu.ops.sampling import GenerationOutput
 from trlx_tpu.parallel import shard_batch
@@ -527,7 +533,17 @@ class PPOTrainer(TPUBaseTrainer):
         response_tokens = chunk["response_tokens"]
         host = chunk["host"]
 
-        # reward scaling/clipping (reference :350-366)
+        # reward scaling/clipping (reference :350-366). Non-finite scores
+        # (a flaky reward endpoint, an overflowed RM) are zeroed BEFORE the
+        # running moments fold them in — RunningMoments state is cumulative,
+        # so one NaN would poison every subsequently scaled reward.
+        scores = np.asarray(scores, np.float32)
+        nonfinite = ~np.isfinite(scores)
+        if nonfinite.any():
+            stats["health/nonfinite_scores"] = stats.get(
+                "health/nonfinite_scores", 0.0
+            ) + float(nonfinite.sum())
+            scores = np.where(nonfinite, 0.0, scores)
         scores_mean, scores_std = self.running_moments.update(scores)
         stats["exp_scores/mean"] = float(scores_mean)
         stats["exp_scores/std"] = float(scores_std)
@@ -547,11 +563,47 @@ class PPOTrainer(TPUBaseTrainer):
             host["logprobs"], host["ref_logprobs"], response_mask,
             scores, self.kl_ctl.value,
         )
-        acc["kl_sum"] += mean_kl
-        acc["kl_batches"] += 1
+        # a non-finite chunk KL (one overflowed logprob) must reach neither
+        # the adaptive controller's accumulator nor the tracker stream —
+        # max(nan, 0.0) is nan, so the old sqrt guard passed NaN through
+        if np.isfinite(mean_kl):
+            acc["kl_sum"] += mean_kl
+            acc["kl_batches"] += 1
+            stats["policy/sqrt_kl"] = float(np.sqrt(max(mean_kl, 0.0)))
+        else:
+            stats["health/nonfinite_kl_chunks"] = stats.get(
+                "health/nonfinite_kl_chunks", 0.0
+            ) + 1.0
+            stats["policy/sqrt_kl"] = 0.0
         acc["gen_tokens"] += int(response_mask.sum())
         acc["chunks"] += 1
-        stats["policy/sqrt_kl"] = float(np.sqrt(max(mean_kl, 0.0)))
+
+        # rollout-side dynamics sketches (observability/dynamics.py): the
+        # per-token KL vs the frozen reference only exists host-side here
+        # (the train step sees new-vs-old only), and all four collection
+        # paths (serial / pipelined / continuous / async) funnel through
+        # this finalize — one uniform feed point for the health canary
+        fmask = np.asarray(response_mask, np.float32)
+        ref_lr = (
+            np.asarray(host["logprobs"]) - np.asarray(host["ref_logprobs"])
+        ) * fmask
+        ref_k3 = (np.exp(ref_lr) - 1.0) - ref_lr
+        lo, hi = SKETCH_RANGES["ref_kl"]
+        acc["ref_kl_hist"] = acc.get("ref_kl_hist", 0.0) + sketch_np(
+            ref_k3, fmask, lo=lo, hi=hi
+        )
+        # generation-length + repeated-adjacent-token canary (host twin of
+        # the engine-harvest counters; engine's exact numbers win via
+        # setdefault in make_experience on the continuous path)
+        toks = np.asarray(response_tokens)
+        pair_mask = fmask[:, 1:] * fmask[:, :-1]
+        acc["rep_pairs"] = acc.get("rep_pairs", 0.0) + float(
+            ((toks[:, 1:] == toks[:, :-1]) * pair_mask).sum()
+        )
+        acc["rep_total"] = acc.get("rep_total", 0.0) + float(pair_mask.sum())
+        acc.setdefault("gen_lens", []).extend(
+            fmask.sum(axis=1).astype(np.int64).tolist()
+        )
 
         # slot accounting (docs/PERFORMANCE.md): a chunk's decode ran
         # max(n_i) steps over B slots (per-sample eos early-exit ends the
@@ -1206,6 +1258,27 @@ class PPOTrainer(TPUBaseTrainer):
                 "rollout/padded_decode_frac",
                 1.0 - acc["live_slot_steps"] / acc["slot_steps"],
             )
+        # rollout-side dynamics summaries + health canary (accumulated per
+        # chunk in _rollout_chunk_finalize; setdefault keeps the engine's
+        # exact counters when continuous batching already merged them)
+        ref_hist = acc.get("ref_kl_hist")
+        if ref_hist is not None:
+            stats.update(
+                self.obs.dynamics.summarize({"dist/ref_kl_hist": ref_hist})
+            )
+        gen_lens = acc.get("gen_lens")
+        if gen_lens:
+            stats.setdefault(
+                "rollout/gen_len_p50", float(np.percentile(gen_lens, 50))
+            )
+            stats.setdefault(
+                "rollout/gen_len_p95", float(np.percentile(gen_lens, 95))
+            )
+        if acc.get("rep_total"):
+            stats.setdefault(
+                "rollout/repetition_frac", acc["rep_pairs"] / acc["rep_total"]
+            )
+        self.obs.health.observe_rollout(stats)
         self.make_experience_stats = stats
         self.tracker.log(stats, step=iter_count)
 
@@ -1256,19 +1329,25 @@ class PPOTrainer(TPUBaseTrainer):
             )
             logprobs = logprobs_of_labels(out["logits"], responses)
             values_pred = out["value"]
-            return self.with_router_aux(
-                method.loss(
-                    logprobs=logprobs,
-                    values=values_pred,
-                    old_logprobs=old_logprobs,
-                    old_values=old_values,
-                    advantages=advantages,
-                    returns=returns,
-                    mask=response_mask,
-                    behavior_logprobs=batch.get("behavior_logprobs"),
-                ),
-                out,
+            loss, stats = method.loss(
+                logprobs=logprobs,
+                values=values_pred,
+                old_logprobs=old_logprobs,
+                old_values=old_values,
+                advantages=advantages,
+                returns=returns,
+                mask=response_mask,
+                behavior_logprobs=batch.get("behavior_logprobs"),
             )
+            if method.dist_sketches:
+                # entropy needs the full logits the method's loss never
+                # sees — sketch it here while [B, R, V] is still live
+                stats.update(
+                    loss_sketches(
+                        {"entropy": (entropy_of_logits(out["logits"]), response_mask)}
+                    )
+                )
+            return self.with_router_aux((loss, stats), out)
 
         input_ids = jnp.concatenate([queries, responses], axis=1)
         attention_mask = jnp.concatenate(
@@ -1281,19 +1360,25 @@ class PPOTrainer(TPUBaseTrainer):
         logprobs = logprobs_of_labels(out["logits"], responses)
         values_pred = out["value"][:, Q - 1 : Q + R - 1]
 
-        return self.with_router_aux(
-            method.loss(
-                logprobs=logprobs,
-                values=values_pred,
-                old_logprobs=old_logprobs,
-                old_values=old_values,
-                advantages=advantages,
-                returns=returns,
-                mask=response_mask,
-                behavior_logprobs=batch.get("behavior_logprobs"),
-            ),
-            out,
+        loss, stats = method.loss(
+            logprobs=logprobs,
+            values=values_pred,
+            old_logprobs=old_logprobs,
+            old_values=old_values,
+            advantages=advantages,
+            returns=returns,
+            mask=response_mask,
+            behavior_logprobs=batch.get("behavior_logprobs"),
         )
+        if method.dist_sketches:
+            # entropy needs the full logits the method's loss never sees —
+            # sketch it here while the [B, R, V] span is still live
+            stats.update(
+                loss_sketches(
+                    {"entropy": (entropy_of_logits(out["logits"]), response_mask)}
+                )
+            )
+        return self.with_router_aux((loss, stats), out)
 
     def prepare_learning(self) -> None:
         self.train_dataloader = self.store.create_loader(
@@ -1307,10 +1392,65 @@ class PPOTrainer(TPUBaseTrainer):
             * len(self.train_dataloader),
         )
 
+    def _triage_extra(self, arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Derived per-token quantities for a triaged batch: GAE advantages/
+        returns, plus the new-policy per-token logprob deltas from one
+        un-jitted forward under the current params (best-effort — a sick
+        enough state can fail the forward, and the tokens/masks already
+        dumped are the irreplaceable part)."""
+        extra: Dict[str, np.ndarray] = {}
+        values = arrays.get("values")
+        rewards = arrays.get("rewards")
+        mask = arrays.get("response_mask")
+        try:
+            if values is not None and rewards is not None and mask is not None:
+                adv, ret = self.config.method.get_advantages_and_returns(
+                    jnp.asarray(values),
+                    jnp.asarray(rewards),
+                    jnp.asarray(mask, jnp.float32),
+                )
+                extra["advantages"] = np.asarray(adv)
+                extra["returns"] = np.asarray(ret)
+        except Exception:  # pragma: no cover - defensive, crash-path code
+            pass
+        needed = (
+            "query_tensors", "response_tensors", "query_mask",
+            "response_mask", "logprobs",
+        )
+        try:
+            if not self.is_seq2seq and all(k in arrays for k in needed):
+                queries = jnp.asarray(arrays["query_tensors"])
+                responses = jnp.asarray(arrays["response_tensors"])
+                Q, R = queries.shape[1], responses.shape[1]
+                out = self.module.apply(
+                    {"params": self.state.params},
+                    jnp.concatenate([queries, responses], axis=1),
+                    attention_mask=jnp.concatenate(
+                        [
+                            jnp.asarray(arrays["query_mask"]),
+                            jnp.asarray(arrays["response_mask"]),
+                        ],
+                        axis=1,
+                    ),
+                    logits_span=(Q - 1, Q + R - 1),
+                )
+                new_logprobs = logprobs_of_labels(out["logits"], responses)
+                extra["logprob_deltas"] = np.asarray(new_logprobs) - np.asarray(
+                    arrays["logprobs"]
+                )
+        except Exception:  # pragma: no cover - defensive, crash-path code
+            pass
+        return extra
+
     def post_backward_callback(self) -> None:
         # adaptive KL coefficient folds into the next compiled rollout as a
         # scalar argument (reference ``accelerate_ppo_trainer.py:233-234``)
         self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)
+        skips = getattr(self.kl_ctl, "skipped", 0)
+        if skips:
+            # non-finite chunk KLs the controller refused to fold in
+            # (models/ppo.py AdaptiveKLController.update)
+            self.obs.metrics.set_gauge("health/kl_ctl_skips", float(skips))
 
     def post_epoch_callback(self) -> None:
         # fresh rollouts with the updated policy (reference ``:222-231``)
