@@ -586,6 +586,27 @@ def main():
             ),
         )
 
+    # BENCH_SPEC=1: speculative continuous batching over the paged engine
+    # (engine.speculative, docs/PERFORMANCE.md "Speculative continuous
+    # batching") — a tiny draft proposes gamma tokens per round, the policy
+    # verifies them in ONE paged forward, per-row RNG keeps every stream
+    # bit-identical to a solo speculative run. The headline then carries
+    # spec_acceptance_rate; the dedicated A/B lives in
+    # `python -m trlx_tpu.benchmark engine-spec`.
+    bench_spec = os.environ.get("BENCH_SPEC", "0") == "1"
+    if bench_spec:
+        config = config.evolve(
+            train=dict(continuous_batching=True),
+            model=dict(draft_model_path="builtin:gpt2-test", draft_gamma=4),
+            engine=dict(backend="paged", prefix_cache=True, speculative=4),
+            method=dict(
+                gen_kwargs=dict(
+                    max_new_tokens=_MAX_NEW, top_k=0, top_p=1.0,
+                    do_sample=True, per_row_rng=True,
+                )
+            ),
+        )
+
     # BENCH_ASYNC=1: route experience collection through the disaggregated
     # actor/learner split (docs/ASYNC_RL.md) — one actor thread generates
     # the NEXT cycle's rollouts while the timed cycle's ppo_epochs updates
@@ -824,6 +845,13 @@ def main():
     )
     blocks = trainer.make_experience_stats.get("engine/kv_blocks_in_use")
     line["kv_blocks_in_use"] = int(blocks) if blocks is not None else None
+    # speculative-decoding gauge (docs/PERFORMANCE.md "Speculative
+    # continuous batching"): fraction of draft proposals the target
+    # accepted over the last cycle's collection; null unless BENCH_SPEC=1
+    acc = trainer.make_experience_stats.get("engine/spec_acceptance_rate")
+    line["spec_acceptance_rate"] = (
+        round(float(acc), 4) if acc is not None else None
+    )
     # async actor/learner gauges (docs/ASYNC_RL.md): fraction of the actor
     # fleet's wall-time spent waiting (staleness gate + queue back-pressure)
     # and the mean consumption staleness in learner updates, from the last

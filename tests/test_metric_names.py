@@ -72,6 +72,12 @@ def test_scanner_sees_the_codebase():
     assert "rollout/decode_stall_p95" in keys
     assert "rollout/decode_stall_max" in keys
     assert "rollout/prefill_chunks" in keys
+    # speculative continuous batching (docs/PERFORMANCE.md "Speculative
+    # continuous batching"): acceptance and round gauges from
+    # EngineStats.metrics — literal stats[...] sites
+    assert "engine/spec_acceptance_rate" in keys
+    assert "engine/spec_tokens_per_round" in keys
+    assert "rollout/spec_rounds" in keys
     # distributed-telemetry keys (docs/OBSERVABILITY.md "Distributed
     # telemetry"): the cluster beat's literal set_gauge sites
     assert "cluster/step_skew_s" in keys

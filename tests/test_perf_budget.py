@@ -95,6 +95,21 @@ def test_budget_gpt2_test_paged_prefill():
 
 
 @pytest.mark.slow
+def test_budget_gpt2_test_spec():
+    """Speculative continuous batching (engine.speculative): the spec
+    refill (target prefill through the block table + dense draft-cache
+    prefill) and the speculative segment (draft-propose loop + ONE
+    multi-position verify forward per round). The budget pins that the
+    verify really is a single target forward over gamma+1 positions — a
+    change that re-serializes verification (gamma+1 forwards) shows up as
+    a flop jump, and speculation adds exactly these two programs per
+    bucket (zero-extra-programs claim, benchmarks/ENGINE_SPEC_cpu.json).
+    The serial `generate` budget here is the solo speculative sampler —
+    the bit-parity reference program (tests/test_spec_engine.py)."""
+    _assert_within_budget("gpt2_test_spec")
+
+
+@pytest.mark.slow
 def test_budget_ilql_gpt2_test():
     """ILQL's programs: twin-Q/CQL train step + the advantage-reshaping
     sampler (a different generate program than PPO's)."""

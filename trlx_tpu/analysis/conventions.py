@@ -122,6 +122,14 @@ ENGINE_KEYS = frozenset({
     "rollout/decode_stall_p50",
     "rollout/decode_stall_p95",
     "rollout/decode_stall_max",
+    # speculative continuous batching (engine.speculative,
+    # docs/PERFORMANCE.md "Speculative continuous batching"): fraction of
+    # draft proposals the target accepted, committed tokens per live
+    # row-round (the throughput multiplier, ∈ [1, gamma+1]), and
+    # draft-propose/verify rounds run this collection
+    "engine/spec_acceptance_rate",
+    "engine/spec_tokens_per_round",
+    "rollout/spec_rounds",
 })
 
 # Canonical cross-rank telemetry gauges (observability/distributed.py,

@@ -332,6 +332,17 @@ class EngineConfig:
         measured ``rollout/decode_stall_p50/p95/max`` gauges bound it.
         Harvests stay bit-identical across chunk sizes. Requires
         ``backend: paged``.
+    :param speculative: speculative continuous batching (0 = off): each
+        decode segment runs draft-propose → verify ROUNDS in which the
+        draft model (``model.draft_model_path``) proposes this many
+        tokens per live slot and the target verifies all of them in one
+        paged forward — committing 1..k+1 tokens per row per round while
+        every harvested sequence stays bit-identical to a solo
+        ``ops/speculative.py`` run of that row (``tests/test_spec_engine
+        .py``). Requires ``backend: paged`` with the xla decode/prefill
+        compute, ``model.draft_model_path``, and per-row RNG (always on
+        under continuous batching). Acceptance lands in the
+        ``engine/spec_*`` gauges.
     """
 
     backend: str = "dense"
@@ -342,6 +353,7 @@ class EngineConfig:
     decode_kernel: str = "xla"
     prefill_kernel: str = "xla"
     prefill_chunk: int = 0
+    speculative: int = 0
 
     from_dict = classmethod(_strict_from_dict)
 

@@ -144,6 +144,14 @@ class EngineStats:
     prefix_tokens_saved: int = 0  # prompt columns NOT re-prefilled
     prefix_evicted_blocks: int = 0
     prefill_tokens: int = 0  # prompt columns actually prefilled
+    # speculative decode segments (engine.speculative = k > 0): deltas of
+    # the device-cumulative spec counters over this collection — verify
+    # rounds run, live row-rounds, draft tokens accepted, tokens committed
+    spec_gamma: int = 0
+    spec_rounds: int = 0
+    spec_live_rounds: int = 0
+    spec_accepted: int = 0
+    spec_committed: int = 0
     # harvest-side generation canary (observability/health.py gen_canary):
     # per-sequence generated lengths, and adjacent repeated-token pairs —
     # the cheap on-harvest signal for degenerate looping generations
@@ -168,6 +176,24 @@ class EngineStats:
         if self.prefix_lookup_blocks == 0:
             return 0.0
         return self.prefix_hit_blocks / self.prefix_lookup_blocks
+
+    @property
+    def spec_acceptance_rate(self) -> float:
+        """Fraction of proposed draft tokens accepted, over live
+        row-rounds (each proposes ``spec_gamma``)."""
+        if self.spec_live_rounds == 0:
+            return 0.0
+        return self.spec_accepted / (
+            self.spec_live_rounds * max(self.spec_gamma, 1)
+        )
+
+    @property
+    def spec_tokens_per_round(self) -> float:
+        """Committed tokens per live row-round ∈ [1, gamma+1] — the
+        decode-throughput multiplier speculation buys."""
+        if self.spec_live_rounds == 0:
+            return 0.0
+        return self.spec_committed / self.spec_live_rounds
 
     def _stall_pct(self, q: float) -> float:
         if not self.decode_stall_samples:
@@ -263,6 +289,12 @@ class EngineStats:
         if self.prefix_enabled:
             stats["engine/prefix_hit_rate"] = self.prefix_hit_rate
             stats["engine/prefix_tokens_saved"] = float(self.prefix_tokens_saved)
+        if self.spec_gamma:
+            # speculative decode segments: how much of the draft's work the
+            # target kept, and the per-round throughput multiplier
+            stats["engine/spec_acceptance_rate"] = self.spec_acceptance_rate
+            stats["engine/spec_tokens_per_round"] = self.spec_tokens_per_round
+            stats["rollout/spec_rounds"] = float(self.spec_rounds)
         return stats
 
 
@@ -410,6 +442,17 @@ class ContinuousEngine(Engine):
     path across chunk sizes (``tests/test_paged_attention.py``,
     ``tests/test_engine.py``). Each per-request chunk additionally lands
     as an ``engine/prefill_chunk`` span on the slot's trace track.
+
+    Speculative decode segments (``fns.speculative = k > 0``, paged
+    backend): each segment runs draft-propose → paged-verify → accept
+    ROUNDS instead of single-token steps, committing 1..k+1 tokens per
+    live row per round — ``params`` is then a ``(target, draft)`` tuple
+    (swapped atomically by :meth:`swap_params`), harvested rows stay
+    bit-identical to solo ``ops/speculative.py`` runs per row
+    (``tests/test_spec_engine.py``), and the ``engine/spec_*`` gauges
+    report acceptance. Admission, chunked prefill, prefix-cache hits and
+    insertion are UNCHANGED — speculation only replaces the decode
+    segment's inner loop.
     """
 
     def __init__(
@@ -450,10 +493,23 @@ class ContinuousEngine(Engine):
             raise ValueError(f"prefill_chunk {self._chunk} must be >= 0")
 
         self.spec = getattr(fns, "paged", None)
+        # speculative decode segments (ops/slot_refill.py speculative=k):
+        # params become a (target, draft) tuple, buffers widen to
+        # N + gamma + 1, caches to S = P + N + gamma, and rows advance
+        # VARIABLE amounts per round — the per-slot step counters below
+        # track the true committed lengths instead of a uniform bound
+        self._gamma = int(getattr(fns, "speculative", 0) or 0)
+        self._S = self.P + self.N + self._gamma
+        self.stats.spec_gamma = self._gamma
+        # device spec counters are cumulative over the fns-state lifetime;
+        # per-collection stats are deltas against this snapshot
+        self._spec_base = {
+            "rounds": 0, "accepted": 0, "live_rounds": 0, "committed": 0
+        }
         self.allocator: Optional[BlockAllocator] = None
         self.prefix: Optional[PrefixCache] = None
         if self.spec is not None:
-            S = self.P + self.N
+            S = self._S
             self._bs = self.spec.block_size
             self._TB = num_table_blocks(S, self._bs)
             self.allocator = BlockAllocator(self.spec.max_blocks)
@@ -502,6 +558,9 @@ class ContinuousEngine(Engine):
                 "prompt spans through the block table"
             )
         self.stats.kv_cache_bytes = kv_bytes(self.state.cache)
+        if self._gamma:
+            # the draft's dense [B, S] cache is persistent engine state too
+            self.stats.kv_cache_bytes += kv_bytes(self.state.d_cache)
         # identity of the params the pool's committed KV (and hence every
         # prefix-cache entry) was computed under — a different params tree
         # invalidates all cached KV (begin_collection flushes)
@@ -555,17 +614,32 @@ class ContinuousEngine(Engine):
             kv_blocks_total=self.stats.kv_blocks_total,
             decode_kernel_pallas=self.stats.decode_kernel_pallas,
             prefill_kernel_pallas=self.stats.prefill_kernel_pallas,
+            spec_gamma=self._gamma,
         )
+        if self._gamma:
+            self._spec_base = self._read_spec_counters()
         if self.allocator is not None:
             # per-collection high-water, not lifetime
             self.allocator.high_water = self.allocator.blocks_in_use
 
+    @staticmethod
+    def _same_params(a: Any, b: Any) -> bool:
+        """Identity, element-wise over (target, draft) params tuples — the
+        speculative engine's params often arrive as a freshly-built 2-tuple
+        around the SAME trees every call, and the naked identity test would
+        false-negative and flush a still-valid prefix cache."""
+        if type(a) is tuple and type(b) is tuple and len(a) == len(b):
+            return all(x is y for x, y in zip(a, b))
+        return a is b
+
     def _params_changed(self, params: Any, version: Optional[int]) -> bool:
         """One int compare on the versioned weight-sync path, identity on
-        the unversioned path — never a tree walk."""
+        the unversioned path — never a tree walk. The spec engine's
+        (target, draft) tuple swaps ATOMICALLY: both trees arrive in one
+        params object adopted at one segment boundary."""
         if version is not None and self._params_version is not None:
             return version != self._params_version
-        return params is not self._kv_params
+        return not self._same_params(params, self._kv_params)
 
     def _adopt_params(self, params: Any, version: Optional[int]) -> None:
         if self._params_changed(params, version):
@@ -730,8 +804,11 @@ class ContinuousEngine(Engine):
                 # prompt blocks were assigned at admission, decode blocks
                 # wait until the final span seeds them
                 continue
+            # a spec segment commits up to (gamma+1) tokens per round per
+            # live row, bounded by the row hitting N
+            per_seg = segment_len * (self._gamma + 1) if self._gamma else segment_len
             need_cols = self.P + min(
-                self.N, self._steps_bound[slot] + segment_len
+                self.N, self._steps_bound[slot] + per_seg
             )
             need_blocks = (need_cols - 1) // self._bs + 1
             have = self._alloc_upto[slot]
@@ -751,6 +828,14 @@ class ContinuousEngine(Engine):
         )
 
     # -- the slot-refill state machine -----------------------------------
+
+    def _read_spec_counters(self) -> Dict[str, int]:
+        """Fetch the device-cumulative spec counters (tiny scalars; the
+        caller already blocked on the segment they were produced by)."""
+        return {
+            k: int(np.asarray(getattr(self.state, k)))
+            for k in ("rounds", "accepted", "live_rounds", "committed")
+        }
 
     def _decoding(self) -> int:
         """Slots holding a seeded (decoding or awaiting-harvest) sequence —
@@ -900,7 +985,7 @@ class ContinuousEngine(Engine):
                 # bit-parity (ops/slot_refill.py chunk-program docstring)
                 self._note_refill_io(
                     len(rows),
-                    (self.P + self.N) if start > 0 else 0,
+                    self._S if start > 0 else 0,
                     end - start,
                 )
             else:
@@ -916,7 +1001,7 @@ class ContinuousEngine(Engine):
                 )
                 self._note_refill_io(
                     len(rows),
-                    (self.P + self.N) if start > 0 else 0,
+                    self._S if start > 0 else 0,
                     self.P - start,
                 )
                 finished.extend(slots)
@@ -970,7 +1055,9 @@ class ContinuousEngine(Engine):
             return []
         idx = self._jnp.asarray(np.asarray(finished, np.int32))
         rows = {
-            name: getattr(self.state, name)[idx]
+            # spec buffers are [B, N + gamma + 1] (block writes never
+            # clip); the caller-visible response is always [N]
+            name: getattr(self.state, name)[idx, : self.N]
             for name in ("tokens", "logprobs", "values", "mask")
         }
         # ship immediately: start the device→host copies without blocking —
@@ -986,7 +1073,9 @@ class ContinuousEngine(Engine):
             req = self._slots[slot]
             self._slots[slot] = None
             self._seeded[slot] = False
-            self._trace_request(req, slot, t_harvest)
+            self._trace_request(
+                req, slot, t_harvest, gen_len=float(host["mask"][j].sum())
+            )
             if self.spec is not None:
                 # free the row's block refs; blocks the prefix cache (or a
                 # sharing sibling) still holds stay allocated. The device
@@ -1013,7 +1102,9 @@ class ContinuousEngine(Engine):
         self.stats.harvested += len(completed)
         return completed
 
-    def _trace_request(self, req: "_Request", slot: int, t_harvest: float) -> None:
+    def _trace_request(
+        self, req: "_Request", slot: int, t_harvest: float, gen_len: float = 0.0
+    ) -> None:
         """Emit the request's lifecycle spans (queue wait → prefill →
         decode, closed by harvest) on this slot's track — a slot holds one
         request at a time, so per-slot tracks never overlap and a stalled
@@ -1033,6 +1124,15 @@ class ContinuousEngine(Engine):
             "engine/decode", req.t_refill1, t_harvest,
             track=track, index=req.index,
         )
+        if self._gamma:
+            # the request's decode window IS draft-propose/verify rounds:
+            # one span per request, so a low-acceptance straggler is
+            # attributable to its exact row in the merged trace
+            self._tracer.add_complete_event(
+                "engine/spec_verify", req.t_refill1, t_harvest,
+                track=track, index=req.index,
+                gamma=self._gamma, tokens=gen_len,
+            )
 
     def step(self) -> List[CompletedSequence]:
         """One admit → prefill-span → segment → harvest turn; returns newly
@@ -1075,10 +1175,30 @@ class ContinuousEngine(Engine):
         self.stats.decode_steps += steps
         self.stats.slot_steps += steps * self.B
         self.stats.live_slot_steps += live_steps
+        if self._gamma:
+            cur = self._read_spec_counters()
+            self.stats.spec_rounds = cur["rounds"] - self._spec_base["rounds"]
+            self.stats.spec_accepted = (
+                cur["accepted"] - self._spec_base["accepted"]
+            )
+            self.stats.spec_live_rounds = (
+                cur["live_rounds"] - self._spec_base["live_rounds"]
+            )
+            self.stats.spec_committed = (
+                cur["committed"] - self._spec_base["committed"]
+            )
         if self.spec is not None:
+            step_np = np.asarray(self.state.step) if self._gamma else None
             for slot in range(self.B):
                 if self._slots[slot] is not None and self._seeded[slot]:
-                    self._steps_bound[slot] = min(
-                        self.N, self._steps_bound[slot] + steps
-                    )
+                    if self._gamma:
+                        # per-row accepted-length divergence: under
+                        # speculation rows advance different amounts per
+                        # round, and the device step counter IS each row's
+                        # true committed length
+                        self._steps_bound[slot] = int(step_np[slot])
+                    else:
+                        self._steps_bound[slot] = min(
+                            self.N, self._steps_bound[slot] + steps
+                        )
         return self._harvest()

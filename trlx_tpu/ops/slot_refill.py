@@ -79,8 +79,9 @@ from trlx_tpu.ops.sampling import (
     sample_token_from_logits,
     split_row_keys,
 )
+from trlx_tpu.ops.speculative import spec_round_step
 
-__all__ = ["SlotState", "SlotRefillFns", "make_slot_refill_fns"]
+__all__ = ["SlotState", "SpecState", "SlotRefillFns", "make_slot_refill_fns"]
 
 
 class SlotState(NamedTuple):
@@ -101,6 +102,43 @@ class SlotState(NamedTuple):
     done: jax.Array  # [B] finished (or empty) slots — frozen in decode
     step: jax.Array  # [B] per-slot decode step
     rng: jax.Array  # [B, 2] per-slot key chains
+
+
+class SpecState(NamedTuple):
+    """Per-slot state of the *speculative* decode segments
+    (``speculative=k`` in :func:`make_slot_refill_fns`).
+
+    The shape geometry is solo ``generate_speculative``'s, per slot: token
+    buffers are ``[B, NB = N + G + 1]`` (a round's commit block never
+    clips), the target cache spans ``S = P + N + G`` slots *per row*
+    through the paged block table, and the draft keeps its own small dense
+    ``[B, S]`` cache right in the state (the draft has no prefix sharing —
+    paging it would buy nothing and cost a gather per proposal). Field
+    names shared with :class:`SlotState` (``tokens``/``logprobs``/
+    ``values``/``mask``/``done``/``step``/``cache``/``rng``/``prompt_len``)
+    keep the host engine's harvest/refill bookkeeping backend-agnostic;
+    ``step`` counts COMMITTED tokens (rows advance unevenly — the engine
+    reads it per row instead of assuming uniform segment advancement)."""
+
+    tokens: jax.Array  # [B, NB] response tokens (pad after eos)
+    logprobs: jax.Array  # [B, NB] behavior logprobs (target's)
+    values: jax.Array  # [B, NB] value-head outputs (0 if no head)
+    mask: jax.Array  # [B, NB] 1 on real response tokens (incl. eos)
+    prompt_mask: jax.Array  # [B, P] the rows' prompt masks (round masks
+    # are rebuilt from this + step every round, like solo)
+    cache: Any  # target PagedKV: block pool + per-slot tables, S columns
+    d_cache: Any  # draft dense KV cache pytree ([B, S, ...] / scanned)
+    t_last: jax.Array  # [B] last committed token (re-fed every round)
+    prompt_len: jax.Array  # [B] real (unpadded) prompt lengths
+    done: jax.Array  # [B] finished (or empty) slots — frozen
+    step: jax.Array  # [B] committed generated tokens (solo's n_out)
+    rng: jax.Array  # [B, 2] per-slot key chains
+    # cumulative acceptance accounting (absolute counters — the engine
+    # differences them across segments for its gauges)
+    rounds: jax.Array  # [] spec rounds run
+    accepted: jax.Array  # [] accepted draft tokens (pre-truncation)
+    live_rounds: jax.Array  # [] live row-rounds
+    committed: jax.Array  # [] committed tokens (post budget/eos clip)
 
 
 class SlotRefillFns(NamedTuple):
@@ -127,6 +165,11 @@ class SlotRefillFns(NamedTuple):
     # (the final span [start, P) is the ordinary refill program)
     prefill_chunk_rows: Optional[Callable[..., SlotState]] = None
     prefill_chunk_program: Optional[Callable[..., Callable]] = None
+    # speculative decode segments (0 = plain): each segment runs up to
+    # ``segment_len`` draft-propose/verify/accept ROUNDS, committing up to
+    # ``speculative + 1`` tokens per live row per round. The programs then
+    # take ``params = (target_params, draft_params)``.
+    speculative: int = 0
 
 
 def _row_where(flag: jax.Array, new: Any, old: Any) -> Any:
@@ -165,6 +208,10 @@ def make_slot_refill_fns(
     paged: Optional[PagedSpec] = None,
     decode_kernel: str = "xla",
     prefill_kernel: str = "xla",
+    speculative: int = 0,
+    draft_apply: Optional[Callable[..., Dict[str, Any]]] = None,
+    init_draft_cache_fn: Optional[Callable[[int, int], Any]] = None,
+    transition_mask: Optional[jax.Array] = None,
 ) -> SlotRefillFns:
     """Build the (jitted) slot-refill programs for one shape bucket.
 
@@ -199,6 +246,24 @@ def make_slot_refill_fns(
     ``[start, end)``, ``end < P``, committing K/V only: the host engine
     interleaves these with decode segments (``engine.prefill_chunk``) so a
     long prompt never stalls live decode slots longer than one chunk.
+
+    ``speculative = k > 0`` (``engine.speculative``) swaps the decode
+    segment for the *speculative* segment: each segment runs up to
+    ``segment_len`` draft-propose → verify → accept ROUNDS of
+    :func:`trlx_tpu.ops.speculative.spec_round_step` — literally the solo
+    sampler's round body, so every slot's token stream is bit-identical to
+    a solo ``generate_speculative`` run with that row's key chain,
+    regardless of batch composition or refills. Requires the paged backend
+    (the verify writes flow through the block table with drop-mode
+    commits), the xla kernels (the segment is the gather-reference shape;
+    an in-place verify kernel would need the paged T>1 branch to take
+    per-row cache_index vectors), per-row RNG, plus ``draft_apply`` /
+    ``init_draft_cache_fn`` for the proposal model. ``transition_mask``
+    (the trainer's logit mask) must be passed HERE rather than composed
+    into ``adjust_logits``: the rounds apply it to draft proposals and
+    target verify distributions separately, exactly like solo.
+    ``params`` for every program becomes ``(target_params, draft_params)``
+    — one tuple, so mid-stream ``swap_params`` swaps both atomically.
     """
     if decode_kernel not in ("xla", "pallas"):
         raise ValueError(
@@ -219,10 +284,47 @@ def make_slot_refill_fns(
             "(ops/paged_prefill.py) — it requires the paged KV backend "
             "(engine.backend: paged)"
         )
+    G = int(speculative or 0)
+    if G < 0:
+        raise ValueError(f"speculative must be >= 0, got {G}")
+    if G:
+        if paged is None:
+            raise ValueError(
+                "speculative decode segments require the paged KV backend "
+                "(engine.backend: paged) — the verify pass commits accepted "
+                "K/V through the block table with drop-mode writes"
+            )
+        if decode_kernel != "xla" or prefill_kernel != "xla":
+            raise ValueError(
+                "speculative decode segments run the gather-reference (xla) "
+                "programs; the in-place Pallas kernels take scalar or "
+                "uniform cache indices and cannot yet host the per-row "
+                "variable-advance verify — set engine.decode_kernel and "
+                "engine.prefill_kernel to 'xla' with engine.speculative"
+            )
+        if draft_apply is None or init_draft_cache_fn is None:
+            raise ValueError(
+                "speculative decode segments need the draft model: pass "
+                "draft_apply and init_draft_cache_fn "
+                "(model.draft_model_path resolves them in the trainer)"
+            )
+        if not config.per_row_rng:
+            raise ValueError(
+                "engine.speculative requires per-row RNG chains "
+                "(GenerationConfig.per_row_rng=True): speculative slot "
+                "streams are only batch-composition-invariant when draft "
+                "proposals, acceptance uniforms, and residual/bonus draws "
+                "advance [B, 2] per-row key chains"
+            )
     if not config.per_row_rng:
         config = dataclasses.replace(config, per_row_rng=True)
     B, P, N = batch_size, prompt_len, config.max_new_tokens
-    S = P + N
+    # speculative geometry is solo's: commits cap at P+N but each round
+    # probes G slots past the last commit, and the key width must match
+    # solo's exactly (see spec_round_step / _make_prefill_chunk's
+    # key-width lowering note) — G = 0 reduces to the plain S = P + N
+    S = P + N + G
+    NB = N + G + 1  # spec token buffers: block writes never clip
 
     def empty_state() -> SlotState:
         # step_out structure comes from an abstract prefill — shapes only
@@ -267,6 +369,29 @@ def make_slot_refill_fns(
             done=jnp.ones((B,), bool),  # empty slots never decode
             step=jnp.zeros((B,), jnp.int32),
             rng=jnp.zeros((B, 2), jnp.uint32),
+        )
+
+    def empty_spec_state() -> SpecState:
+        # no eval_shape needed: spec segments carry no logits/step_out —
+        # every round re-derives both models' distributions by re-feeding
+        # the last committed token, exactly like solo
+        return SpecState(
+            tokens=jnp.full((B, NB), config.pad_token_id, jnp.int32),
+            logprobs=jnp.zeros((B, NB), jnp.float32),
+            values=jnp.zeros((B, NB), jnp.float32),
+            mask=jnp.zeros((B, NB), jnp.int32),
+            prompt_mask=jnp.zeros((B, P), jnp.int32),
+            cache=init_paged_kv(init_cache_fn, paged, B, S),
+            d_cache=init_draft_cache_fn(B, S),
+            t_last=jnp.zeros((B,), jnp.int32),
+            prompt_len=jnp.zeros((B,), jnp.int32),
+            done=jnp.ones((B,), bool),  # empty slots never decode
+            step=jnp.zeros((B,), jnp.int32),
+            rng=jnp.zeros((B, 2), jnp.uint32),
+            rounds=jnp.asarray(0, jnp.int32),
+            accepted=jnp.asarray(0, jnp.int32),
+            live_rounds=jnp.asarray(0, jnp.int32),
+            committed=jnp.asarray(0, jnp.int32),
         )
 
     def last_step_info_abstract(out_sds: Dict[str, Any]) -> Dict[str, Any]:
@@ -396,6 +521,102 @@ def make_slot_refill_fns(
 
         return refill
 
+    def _make_spec_refill(R: int, hit: int = 0):
+        def refill(
+            params: Any,  # (target_params, draft_params)
+            state: SpecState,
+            input_ids: jax.Array,  # [R, P] left-padded fresh prompts
+            prompt_mask: jax.Array,  # [R, P]
+            slot_idx: jax.Array,  # [R] target slots; >= B = padding (dropped)
+            new_keys: jax.Array,  # [R, 2] per-row key chains
+            table_rows: Optional[jax.Array] = None,  # [R, TB]
+        ) -> SpecState:
+            """The speculative twin of ``_make_refill``: prefill the TARGET
+            suffix ``[hit, P)`` through the block table exactly like the
+            plain paged refill (same forward, same ``scatter_span`` commit
+            — K/V only, ``logits_span=(0, 0)``: the first round re-feeds
+            the last prompt token, so prefill logits are never consumed),
+            plus a full ``[0, P)`` DRAFT prefill on a fresh zero cache
+            scattered whole-row into ``state.d_cache`` (the draft shares
+            nothing across rows — prefix hits only skip target compute;
+            the full-row scatter also zeroes any stale recycled-slot
+            columns past ``P``). Both prefills use solo's ``S``-wide slot
+            mask, so the refilled row's caches are bit-identical to a solo
+            run's post-prefill caches."""
+            t_params, d_params = params
+            input_ids = input_ids.astype(jnp.int32)
+            prompt_mask = prompt_mask.astype(jnp.int32)
+            slot_mask_r = jnp.concatenate(
+                [prompt_mask, jnp.zeros((R, S - P), jnp.int32)], axis=1
+            )
+            if hit > 0:
+                row_cache = gather_view(state.cache.pool, table_rows, S)
+            else:
+                row_cache = init_cache_fn(R, S)
+            t_out = apply_fn(
+                t_params,
+                input_ids[:, hit:],
+                attention_mask=slot_mask_r,
+                positions=None,
+                cache=row_cache,
+                cache_index=jnp.asarray(hit, jnp.int32),
+                logits_span=(0, 0),
+            )
+            new_pool = scatter_span(
+                state.cache.pool, table_rows, t_out["cache"], hit, P - hit
+            )
+            new_cache = PagedKV(
+                pool=new_pool,
+                block_table=state.cache.block_table.at[slot_idx].set(
+                    table_rows, mode="drop"
+                ),
+            )
+            d_out = draft_apply(
+                d_params,
+                input_ids,
+                attention_mask=slot_mask_r,
+                positions=None,
+                cache=init_draft_cache_fn(R, S),
+                cache_index=jnp.asarray(0, jnp.int32),
+                logits_span=(0, 0),
+            )
+
+            def scat(big, rows):
+                if big is None or not hasattr(big, "ndim") or big.ndim == 0:
+                    return big
+                return big.at[slot_idx].set(rows.astype(big.dtype), mode="drop")
+
+            def scat_cache(big, rows):
+                if big.ndim - 4 == 0:
+                    return big.at[slot_idx].set(rows.astype(big.dtype), mode="drop")
+                # scanned layout [L, B, S, KV, D]: batch axis 1
+                return big.at[:, slot_idx].set(rows.astype(big.dtype), mode="drop")
+
+            return SpecState(
+                tokens=scat(
+                    state.tokens, jnp.full((R, NB), config.pad_token_id, jnp.int32)
+                ),
+                logprobs=scat(state.logprobs, jnp.zeros((R, NB), jnp.float32)),
+                values=scat(state.values, jnp.zeros((R, NB), jnp.float32)),
+                mask=scat(state.mask, jnp.zeros((R, NB), jnp.int32)),
+                prompt_mask=scat(state.prompt_mask, prompt_mask),
+                cache=new_cache,
+                d_cache=jax.tree_util.tree_map(
+                    scat_cache, state.d_cache, d_out["cache"]
+                ),
+                t_last=scat(state.t_last, input_ids[:, -1]),
+                prompt_len=scat(state.prompt_len, jnp.sum(prompt_mask, axis=1)),
+                done=scat(state.done, jnp.zeros((R,), bool)),
+                step=scat(state.step, jnp.zeros((R,), jnp.int32)),
+                rng=scat(state.rng, new_keys),
+                rounds=state.rounds,
+                accepted=state.accepted,
+                live_rounds=state.live_rounds,
+                committed=state.committed,
+            )
+
+        return refill
+
     _refill_cache: Dict[Tuple[int, int], Callable] = {}
     _warmed = {"done": False}
 
@@ -405,7 +626,7 @@ def make_slot_refill_fns(
         paged prefix-cache hits compile one extra variant per distinct
         block-aligned hit length, on first use."""
         if (bucket, hit) not in _refill_cache:
-            fn = _make_refill(bucket, hit)
+            fn = (_make_spec_refill if G else _make_refill)(bucket, hit)
             _refill_cache[(bucket, hit)] = jax.jit(fn) if jit else fn
         return _refill_cache[(bucket, hit)]
 
@@ -432,12 +653,16 @@ def make_slot_refill_fns(
             Tables are taken as an argument (host mirror) — the device
             block-table rows of still-prefilling slots are stale by
             design."""
+            # speculative builds chunk only the TARGET's prompt (the draft
+            # prefills whole at refill time — it is the small model; only
+            # the target's prefill can stall live decode slots)
+            t_params = params[0] if G else params
             input_ids = input_ids.astype(jnp.int32)
             prompt_mask = prompt_mask.astype(jnp.int32)
             # visibility: committed prompt columns [0, end) only
             span_mask = prompt_mask * (jnp.arange(P)[None, :] < end)
             key_mask = jnp.concatenate(
-                [span_mask, jnp.zeros((R, N), jnp.int32)], axis=1
+                [span_mask, jnp.zeros((R, S - P), jnp.int32)], axis=1
             )
             if prefill_kernel == "pallas":
                 row_cache = attach_block_table(state.cache.pool, table_rows)
@@ -449,7 +674,7 @@ def make_slot_refill_fns(
                 # shortcut)
                 row_cache = init_cache_fn(R, S)
             out = apply_fn(
-                params,
+                t_params,
                 input_ids[:, start:end],
                 attention_mask=key_mask,
                 positions=None,
@@ -653,6 +878,8 @@ def make_slot_refill_fns(
         exactly the columns ``scatter_steps`` would not have committed.
         Bit-identical to the gather path (tests/test_paged_attention.py,
         tests/test_engine.py)."""
+        if G:
+            return _spec_decode_segment(params, state)
         if paged is not None and decode_kernel == "pallas":
             return _decode_segment_paged_kernel(params, state)
         if paged is not None:
@@ -676,6 +903,110 @@ def make_slot_refill_fns(
                 steps,
             )
         return _decode_segment_dense(params, state)
+
+    def _spec_decode_segment(params: Any, state: SpecState):
+        """Up to ``segment_len`` speculative ROUNDS over live slots — the
+        round body is :func:`trlx_tpu.ops.speculative.spec_round_step`,
+        shared verbatim with the solo sampler, so per-slot bit-parity is
+        structural. One segment = one compiled program: fixed trip count
+        (early exit when all slots finish), per-row live masks absorb the
+        variable advancement — a row commits between 1 and ``G + 1``
+        tokens per live round, bounded by ``segment_len · (G + 1)`` per
+        segment — so the bucket never recompiles.
+
+        Paged plumbing mirrors the plain xla segment: ONE pool gather into
+        solo's dense ``[B, S]`` view on entry (each round's draft
+        proposals, the single width-``G + 1`` target verify forward, and
+        acceptance run on the view), ONE ``scatter_steps`` commit on exit.
+        The committed span per row is ``[P + step_in − 1, P + step_out]``:
+        the re-feed column ``c − 1`` is re-committed (its pool value was
+        the residual-sampled token's never-forwarded placeholder — solo's
+        dense cache holds the same re-feed result), accepted/bonus columns
+        carry the verify's K/V, and REJECTED probe columns simply fall
+        outside ``counts`` — ``scatter_steps`` poisons their lanes
+        out-of-range exactly like frozen rows', so they drop instead of
+        dirtying pool blocks another row may later receive."""
+        t_params, d_params = params
+        table = state.cache.block_table
+        entry_live = ~state.done
+        step_in = state.step
+        view = gather_view(state.cache.pool, table, S)
+        carry = {
+            "rng": state.rng,
+            "n_out": state.step,
+            "done": state.done,
+            "t_last": state.t_last,
+            "t_cache": view,
+            "d_cache": state.d_cache,
+            "tokens": state.tokens,
+            "logprobs": state.logprobs,
+            "values": state.values,
+            "mask": state.mask,
+            "rounds": state.rounds,
+            "accepted": state.accepted,
+            "live_rounds": state.live_rounds,
+            "committed": state.committed,
+        }
+
+        def body(c):
+            cr, k = c
+            return (
+                spec_round_step(
+                    cr,
+                    prompt_mask=state.prompt_mask,
+                    target_apply=apply_fn,
+                    target_params=t_params,
+                    draft_apply=draft_apply,
+                    draft_params=d_params,
+                    config=config,
+                    G=G,
+                    transition_mask=transition_mask,
+                    adjust_logits=adjust_logits,
+                ),
+                k + 1,
+            )
+
+        def cond(c):
+            cr, k = c
+            return (k < segment_len) & ~jnp.all(cr["done"])
+
+        final, _ = jax.lax.while_loop(
+            cond, body, (carry, jnp.asarray(0, jnp.int32))
+        )
+        pool = scatter_steps(
+            state.cache.pool,
+            table,
+            final["t_cache"],
+            P + step_in - 1,
+            jnp.where(entry_live, final["n_out"] - step_in + 1, 0),
+            segment_len * (G + 1) + 1,
+        )
+        new_state = SpecState(
+            tokens=final["tokens"],
+            logprobs=final["logprobs"],
+            values=final["values"],
+            mask=final["mask"],
+            prompt_mask=state.prompt_mask,
+            cache=PagedKV(pool, table),
+            d_cache=final["d_cache"],
+            t_last=final["t_last"],
+            prompt_len=state.prompt_len,
+            done=final["done"],
+            step=final["n_out"],
+            rng=final["rng"],
+            rounds=final["rounds"],
+            accepted=final["accepted"],
+            live_rounds=final["live_rounds"],
+            committed=final["committed"],
+        )
+        # same (state, live_steps, steps) contract as the plain segment,
+        # in ROUND units (slot_utilization keeps its live/total meaning;
+        # token-level throughput is the spec_* gauges' job)
+        return (
+            new_state,
+            final["live_rounds"] - state.live_rounds,
+            final["rounds"] - state.rounds,
+        )
 
     def _decode_segment_paged_kernel(params: Any, state: SlotState):
         """The in-place twin of ``_decode_segment_dense``: same sampling
@@ -779,7 +1110,7 @@ def make_slot_refill_fns(
     if jit:
         decode_segment = jax.jit(decode_segment)
     return SlotRefillFns(
-        init_state=empty_state,
+        init_state=empty_spec_state if G else empty_state,
         refill_rows=refill_rows,
         refill_program=refill_program,
         prewarm=prewarm,
@@ -795,4 +1126,5 @@ def make_slot_refill_fns(
         prefill_chunk_program=(
             prefill_chunk_program if paged is not None else None
         ),
+        speculative=G,
     )

@@ -154,6 +154,256 @@ def accept_and_extra(
     return k, extra_tok, rng
 
 
+def spec_round_step(
+    carry: dict,
+    *,
+    prompt_mask: jax.Array,  # [B, P] int32
+    target_apply: Callable[..., Any],
+    target_params: Any,
+    draft_apply: Callable[..., Any],
+    draft_params: Any,
+    config: GenerationConfig,
+    G: int,
+    transition_mask: Optional[jax.Array] = None,
+    adjust_logits: Optional[Callable[[Any, jax.Array], jax.Array]] = None,
+) -> dict:
+    """One draft-propose → verify → accept round over the shared carry.
+
+    THE speculative round: both ``generate_speculative``'s while_loop body
+    and the continuous-batching spec segment's round body
+    (``ops/slot_refill.py``) are this one function, so a slot's token
+    stream is bit-identical to a solo run by construction rather than by
+    mirrored code. The contract that makes that hold across refills and
+    batch composition:
+
+    - caches must span ``S = P + N + G`` slots (the solo width — masked
+      columns contribute exact-0.0 softmax, but a narrower key axis
+      changes the dots' lowering, see ``_make_prefill_chunk``);
+    - every forward masks exactly committed slots + the round's ``G``
+      probe slots ``[c, c+G)`` — slot-causality inside the model keeps
+      everything else (stale pool values included) invisible;
+    - the rng chain advances a FIXED number of ``split_row_keys`` draws
+      per round (G proposal draws + 2 acceptance draws when sampling),
+      so a row's stream depends only on (its chain, its round index).
+
+    Carry keys: ``rng`` ([B,2] per-row chains or [2] batch-wide), ``n_out``
+    [B] committed generated tokens, ``done`` [B], ``t_last`` [B] (last
+    committed token — its K/V is re-derived by re-feeding, never carried),
+    ``t_cache``/``d_cache``, output buffers ``tokens``/``logprobs``/
+    ``values``/``mask`` [B, N+G+1], and the scalar counters ``rounds``/
+    ``accepted``/``live_rounds``/``committed``.
+    """
+    B, P = prompt_mask.shape
+    N = config.max_new_tokens
+    NB = N + G + 1
+    V_pad = config.pad_token_id
+    per_row = jnp.asarray(carry["rng"]).ndim == 2
+
+    rng = carry["rng"]
+    n_out = carry["n_out"]  # [B] committed generated tokens
+    done = carry["done"]
+    t_last = carry["t_last"]  # [B] last committed token (slot c-1)
+    c = P + n_out  # [B] next free slot per row
+
+    # slot mask for this round's forwards: committed slots + the G
+    # proposal slots [c, c+G) — slot-causality inside the models keeps
+    # stale/future slots invisible to each query
+    gen_slots = jnp.arange(NB - 1)[None, :]
+    committed = jnp.concatenate(
+        [prompt_mask, (gen_slots < n_out[:, None]).astype(jnp.int32)], axis=1
+    )
+    probe = (gen_slots >= n_out[:, None]) & (gen_slots < (n_out + G)[:, None])
+    mask_round = committed + jnp.concatenate(
+        [jnp.zeros((B, P), jnp.int32), probe.astype(jnp.int32)], axis=1
+    )
+
+    # ---- draft proposes G tokens (G single-token forwards, unrolled:
+    # G is small and static) ----
+    d_cache_r, tok_r = carry["d_cache"], t_last
+    d_toks = jnp.zeros((B, G), jnp.int32)
+    # [B, G, V] full draft dists for the residual resample — f32: the
+    # rejection-sampling identity needs the SAME q as the accept test
+    # (a rounded copy would sample the extra token from rounding noise
+    # when p ≈ q, precisely the good-draft case)
+    q_probs = None
+    for j in range(G):
+        prev = tok_r  # the token being fed — q_{j+1} conditions on it
+        out_j = draft_apply(
+            draft_params, tok_r[:, None], attention_mask=mask_round,
+            positions=None, cache=d_cache_r, cache_index=c - 1 + j,
+        )
+        logits_j = out_j["logits"][:, -1, :].astype(jnp.float32)
+        if transition_mask is not None:
+            logits_j = apply_transition_mask(transition_mask, prev, logits_j)
+        if config.eos_token_id is not None and config.min_new_tokens > 0:
+            # proposal j lands at response position n_out + j: block eos
+            # there exactly like the plain sampler (q then matches the
+            # distribution the proposal is actually drawn from)
+            block_j = (n_out + j) < config.min_new_tokens  # [B]
+            logits_j = jnp.where(
+                block_j[:, None]
+                & (jnp.arange(logits_j.shape[-1])[None, :] == config.eos_token_id),
+                -jnp.inf,
+                logits_j,
+            )
+        probs_j = _filtered_probs(logits_j, config)
+        if per_row:
+            rng, rj = split_row_keys(rng)
+        else:
+            rng, rj = jax.random.split(rng)
+        if config.do_sample:
+            log_probs_j = jnp.log(jnp.maximum(probs_j, 1e-30))
+            if per_row:
+                tok_r = jax.vmap(
+                    lambda kk, row: jax.random.categorical(kk, row)
+                )(rj, log_probs_j).astype(jnp.int32)
+            else:
+                tok_r = jax.random.categorical(
+                    rj, log_probs_j, axis=-1
+                ).astype(jnp.int32)
+        else:
+            tok_r = jnp.argmax(probs_j, axis=-1).astype(jnp.int32)
+        if q_probs is None:
+            q_probs = jnp.zeros((B, G) + probs_j.shape[-1:], jnp.float32)
+        d_toks = d_toks.at[:, j].set(tok_r)
+        q_probs = q_probs.at[:, j].set(probs_j)
+        d_cache_r = out_j["cache"]
+    # one more draft forward to write d_G's K/V (logits discarded):
+    # after a fully-accepted round the NEXT round marks d_G's slot
+    # committed, and a zero-K/V hole there would quietly degrade every
+    # subsequent proposal — exactly in the high-acceptance regime
+    d_cache_new = draft_apply(
+        draft_params, tok_r[:, None], attention_mask=mask_round,
+        positions=None, cache=d_cache_r, cache_index=c - 1 + G,
+        logits_span=(0, 0),
+    )["cache"]
+
+    # ---- one target forward verifies everything ----
+    verify_in = jnp.concatenate([t_last[:, None], d_toks], axis=1)  # [B, G+1]
+    t_out = target_apply(
+        target_params, verify_in, attention_mask=mask_round,
+        positions=None, cache=carry["t_cache"], cache_index=c - 1,
+    )
+    t_cache_new = t_out["cache"]
+    t_logits = t_out["logits"].astype(jnp.float32)  # [B, G+1, V]
+    if adjust_logits is not None:
+        # same order as the plain sampler: algo reshaping first, then
+        # transition mask, then min_new_tokens eos blocking. step_info
+        # mirrors the plain sampler's step_out keys (incl. last_tokens),
+        # but fields keep the verify shape [B, G+1, ...] where plain
+        # passes last-position [B, ...] views — hence the hook contract:
+        # leading-dim polymorphic (see BaseRLTrainer.adjust_logits_fn)
+        step_info = {
+            k: v for k, v in t_out.items()
+            if k not in _NON_CARRY_KEYS and v is not None
+        }
+        step_info["last_tokens"] = verify_in  # token position j conditions on
+        t_logits = adjust_logits(step_info, t_logits)
+    if transition_mask is not None:
+        # p_j conditions on verify position j's input token — identical
+        # masking to the plain sampler's logit-mask hook, so behavior
+        # logprobs below come from the same (masked) distribution
+        t_logits = apply_transition_mask(transition_mask, verify_in, t_logits)
+    if config.eos_token_id is not None and config.min_new_tokens > 0:
+        # verify position j produces response position n_out + j; the
+        # plain sampler blocks eos there BEFORE both sampling and the
+        # behavior logprob, so the mask goes on t_logits (feeding
+        # p_probs and t_logprobs_all alike) for exactness
+        pos = n_out[:, None] + jnp.arange(G + 1)[None, :]  # [B, G+1]
+        t_logits = jnp.where(
+            (pos < config.min_new_tokens)[..., None]
+            & (
+                jnp.arange(t_logits.shape[-1])[None, None, :]
+                == config.eos_token_id
+            ),
+            -jnp.inf,
+            t_logits,
+        )
+    p_probs = _filtered_probs(t_logits, config)  # p_0 .. p_G
+    t_logprobs_all = jax.nn.log_softmax(t_logits, axis=-1)
+    t_values = t_out.get("value")
+    if t_values is None:
+        t_values = jnp.zeros(verify_in.shape, jnp.float32)
+    t_values = t_values.astype(jnp.float32)  # [B, G+1]
+
+    # ---- acceptance (the pure rejection-sampling rule) ----
+    k, extra_tok, rng = accept_and_extra(
+        p_probs, q_probs, d_toks, rng, config.do_sample
+    )
+
+    # ---- tentative committed block: d_1..d_k, extra ----
+    j_iota = jnp.arange(G + 1)[None, :]
+    block_toks = jnp.concatenate([d_toks, jnp.zeros((B, 1), jnp.int32)], axis=1)
+    block_toks = jnp.where(j_iota == k[:, None], extra_tok[:, None], block_toks)
+    block_lp = jnp.take_along_axis(
+        t_logprobs_all, block_toks[..., None], axis=-1
+    )[..., 0]  # log p_j(x_j) — target logprob of each committed token
+    block_val = t_values  # v before sampling x_j is at index j
+
+    valid = j_iota <= k[:, None]
+    # respect the N budget and prior completion
+    valid = valid & ((n_out[:, None] + j_iota) < N) & (~done[:, None])
+    if config.eos_token_id is not None:
+        is_eos = block_toks == config.eos_token_id
+        eos_before = jnp.cumsum(
+            jnp.pad(is_eos.astype(jnp.int32), ((0, 0), (1, 0)))[:, :-1], axis=1
+        )
+        valid = valid & (eos_before == 0)
+    commit_len = jnp.sum(valid.astype(jnp.int32), axis=1)  # [B]
+    block_toks_w = jnp.where(valid, block_toks, V_pad)
+    block_lp_w = jnp.where(valid, block_lp, 0.0)
+    block_val_w = jnp.where(valid, block_val, 0.0)
+    block_mask_w = valid.astype(jnp.int32)
+
+    # ---- per-row block write into the output buffers ----
+    def row_write(buf, blk, i):
+        return jax.vmap(
+            lambda b, x, o: jax.lax.dynamic_update_slice(b, x.astype(b.dtype), (o,))
+        )(buf, blk, i)
+
+    # never write past the buffer; done rows re-write pads over pads
+    off = jnp.minimum(n_out, NB - (G + 1))
+    tokens = row_write(carry["tokens"], block_toks_w, off)
+    logprobs = row_write(carry["logprobs"], block_lp_w, off)
+    values = row_write(carry["values"], block_val_w, off)
+    out_mask = row_write(carry["mask"], block_mask_w, off)
+
+    n_new = n_out + commit_len
+    done_new = done | (n_new >= N)
+    if config.eos_token_id is not None:
+        done_new = done_new | jnp.any(
+            (block_toks_w == config.eos_token_id) & (valid), axis=1
+        )
+    last_idx = jnp.maximum(commit_len - 1, 0)
+    t_last_new = jnp.where(
+        commit_len > 0,
+        jnp.take_along_axis(block_toks_w, last_idx[:, None], axis=1)[:, 0],
+        t_last,
+    )
+
+    return {
+        "rng": rng,
+        "n_out": n_new,
+        "done": done_new,
+        "t_last": t_last_new,
+        "t_cache": t_cache_new,
+        "d_cache": d_cache_new,
+        "tokens": tokens,
+        "logprobs": logprobs,
+        "values": values,
+        "mask": out_mask,
+        "rounds": carry["rounds"] + 1,
+        # accepted draft tokens this round, live rows only — k is
+        # PRE-truncation acceptance (budget/eos clipping is not
+        # rejection), so the rate reflects draft quality alone
+        "accepted": carry["accepted"] + jnp.sum(jnp.where(~done, k, 0)),
+        "live_rounds": carry["live_rounds"] + jnp.sum((~done).astype(jnp.int32)),
+        # tokens actually committed (post budget/eos truncation) — the
+        # tokens-per-round throughput numerator
+        "committed": carry["committed"] + jnp.sum(jnp.where(~done, commit_len, 0)),
+    }
+
+
 def generate_speculative(
     target_apply: Callable[..., Any],
     target_params: Any,
@@ -227,206 +477,20 @@ def generate_speculative(
     )
 
     def round_step(carry):
-        rng = carry["rng"]
-        n_out = carry["n_out"]  # [B] committed generated tokens
-        done = carry["done"]
-        t_last = carry["t_last"]  # [B] last committed token (slot c-1)
-        c = P + n_out  # [B] next free slot per row
-
-        # slot mask for this round's forwards: committed slots + the G
-        # proposal slots [c, c+G) — slot-causality inside the models keeps
-        # stale/future slots invisible to each query
-        gen_slots = jnp.arange(NB - 1)[None, :]
-        committed = jnp.concatenate(
-            [prompt_mask, (gen_slots < n_out[:, None]).astype(jnp.int32)], axis=1
+        # the shared round (also the CB spec segment's body) — one function,
+        # bit-identity by construction
+        return spec_round_step(
+            carry,
+            prompt_mask=prompt_mask,
+            target_apply=target_apply,
+            target_params=target_params,
+            draft_apply=draft_apply,
+            draft_params=draft_params,
+            config=config,
+            G=G,
+            transition_mask=transition_mask,
+            adjust_logits=adjust_logits,
         )
-        probe = (gen_slots >= n_out[:, None]) & (gen_slots < (n_out + G)[:, None])
-        mask_round = committed + jnp.concatenate(
-            [jnp.zeros((B, P), jnp.int32), probe.astype(jnp.int32)], axis=1
-        )
-
-        # ---- draft proposes G tokens (G single-token forwards, unrolled:
-        # G is small and static) ----
-        d_cache_r, tok_r = carry["d_cache"], t_last
-        d_toks = jnp.zeros((B, G), jnp.int32)
-        # [B, G, V] full draft dists for the residual resample — f32: the
-        # rejection-sampling identity needs the SAME q as the accept test
-        # (a rounded copy would sample the extra token from rounding noise
-        # when p ≈ q, precisely the good-draft case)
-        q_probs = None
-        for j in range(G):
-            prev = tok_r  # the token being fed — q_{j+1} conditions on it
-            out_j = draft_apply(
-                draft_params, tok_r[:, None], attention_mask=mask_round,
-                positions=None, cache=d_cache_r, cache_index=c - 1 + j,
-            )
-            logits_j = out_j["logits"][:, -1, :].astype(jnp.float32)
-            if transition_mask is not None:
-                logits_j = apply_transition_mask(transition_mask, prev, logits_j)
-            if config.eos_token_id is not None and config.min_new_tokens > 0:
-                # proposal j lands at response position n_out + j: block eos
-                # there exactly like the plain sampler (q then matches the
-                # distribution the proposal is actually drawn from)
-                block_j = (n_out + j) < config.min_new_tokens  # [B]
-                logits_j = jnp.where(
-                    block_j[:, None]
-                    & (jnp.arange(logits_j.shape[-1])[None, :] == config.eos_token_id),
-                    -jnp.inf,
-                    logits_j,
-                )
-            probs_j = _filtered_probs(logits_j, config)
-            if per_row:
-                rng, rj = split_row_keys(rng)
-            else:
-                rng, rj = jax.random.split(rng)
-            if config.do_sample:
-                log_probs_j = jnp.log(jnp.maximum(probs_j, 1e-30))
-                if per_row:
-                    tok_r = jax.vmap(
-                        lambda kk, row: jax.random.categorical(kk, row)
-                    )(rj, log_probs_j).astype(jnp.int32)
-                else:
-                    tok_r = jax.random.categorical(
-                        rj, log_probs_j, axis=-1
-                    ).astype(jnp.int32)
-            else:
-                tok_r = jnp.argmax(probs_j, axis=-1).astype(jnp.int32)
-            if q_probs is None:
-                q_probs = jnp.zeros((B, G) + probs_j.shape[-1:], jnp.float32)
-            d_toks = d_toks.at[:, j].set(tok_r)
-            q_probs = q_probs.at[:, j].set(probs_j)
-            d_cache_r = out_j["cache"]
-        # one more draft forward to write d_G's K/V (logits discarded):
-        # after a fully-accepted round the NEXT round marks d_G's slot
-        # committed, and a zero-K/V hole there would quietly degrade every
-        # subsequent proposal — exactly in the high-acceptance regime
-        d_cache_new = draft_apply(
-            draft_params, tok_r[:, None], attention_mask=mask_round,
-            positions=None, cache=d_cache_r, cache_index=c - 1 + G,
-            logits_span=(0, 0),
-        )["cache"]
-
-        # ---- one target forward verifies everything ----
-        verify_in = jnp.concatenate([t_last[:, None], d_toks], axis=1)  # [B, G+1]
-        t_out = target_apply(
-            target_params, verify_in, attention_mask=mask_round,
-            positions=None, cache=carry["t_cache"], cache_index=c - 1,
-        )
-        t_cache_new = t_out["cache"]
-        t_logits = t_out["logits"].astype(jnp.float32)  # [B, G+1, V]
-        if adjust_logits is not None:
-            # same order as the plain sampler: algo reshaping first, then
-            # transition mask, then min_new_tokens eos blocking. step_info
-            # mirrors the plain sampler's step_out keys (incl. last_tokens),
-            # but fields keep the verify shape [B, G+1, ...] where plain
-            # passes last-position [B, ...] views — hence the hook contract:
-            # leading-dim polymorphic (see BaseRLTrainer.adjust_logits_fn)
-            step_info = {
-                k: v for k, v in t_out.items()
-                if k not in _NON_CARRY_KEYS and v is not None
-            }
-            step_info["last_tokens"] = verify_in  # token position j conditions on
-            t_logits = adjust_logits(step_info, t_logits)
-        if transition_mask is not None:
-            # p_j conditions on verify position j's input token — identical
-            # masking to the plain sampler's logit-mask hook, so behavior
-            # logprobs below come from the same (masked) distribution
-            t_logits = apply_transition_mask(transition_mask, verify_in, t_logits)
-        if config.eos_token_id is not None and config.min_new_tokens > 0:
-            # verify position j produces response position n_out + j; the
-            # plain sampler blocks eos there BEFORE both sampling and the
-            # behavior logprob, so the mask goes on t_logits (feeding
-            # p_probs and t_logprobs_all alike) for exactness
-            pos = n_out[:, None] + jnp.arange(G + 1)[None, :]  # [B, G+1]
-            t_logits = jnp.where(
-                (pos < config.min_new_tokens)[..., None]
-                & (
-                    jnp.arange(t_logits.shape[-1])[None, None, :]
-                    == config.eos_token_id
-                ),
-                -jnp.inf,
-                t_logits,
-            )
-        p_probs = _filtered_probs(t_logits, config)  # p_0 .. p_G
-        t_logprobs_all = jax.nn.log_softmax(t_logits, axis=-1)
-        t_values = t_out.get("value")
-        if t_values is None:
-            t_values = jnp.zeros(verify_in.shape, jnp.float32)
-        t_values = t_values.astype(jnp.float32)  # [B, G+1]
-
-        # ---- acceptance (the pure rejection-sampling rule) ----
-        k, extra_tok, rng = accept_and_extra(
-            p_probs, q_probs, d_toks, rng, config.do_sample
-        )
-
-        # ---- tentative committed block: d_1..d_k, extra ----
-        j_iota = jnp.arange(G + 1)[None, :]
-        block_toks = jnp.concatenate([d_toks, jnp.zeros((B, 1), jnp.int32)], axis=1)
-        block_toks = jnp.where(j_iota == k[:, None], extra_tok[:, None], block_toks)
-        block_lp = jnp.take_along_axis(
-            t_logprobs_all, block_toks[..., None], axis=-1
-        )[..., 0]  # log p_j(x_j) — target logprob of each committed token
-        block_val = t_values  # v before sampling x_j is at index j
-
-        valid = j_iota <= k[:, None]
-        # respect the N budget and prior completion
-        valid = valid & ((n_out[:, None] + j_iota) < N) & (~done[:, None])
-        if config.eos_token_id is not None:
-            is_eos = block_toks == config.eos_token_id
-            eos_before = jnp.cumsum(
-                jnp.pad(is_eos.astype(jnp.int32), ((0, 0), (1, 0)))[:, :-1], axis=1
-            )
-            valid = valid & (eos_before == 0)
-        commit_len = jnp.sum(valid.astype(jnp.int32), axis=1)  # [B]
-        block_toks_w = jnp.where(valid, block_toks, V_pad)
-        block_lp_w = jnp.where(valid, block_lp, 0.0)
-        block_val_w = jnp.where(valid, block_val, 0.0)
-        block_mask_w = valid.astype(jnp.int32)
-
-        # ---- per-row block write into the output buffers ----
-        def row_write(buf, blk, i):
-            return jax.vmap(
-                lambda b, x, o: jax.lax.dynamic_update_slice(b, x.astype(b.dtype), (o,))
-            )(buf, blk, i)
-
-        # never write past the buffer; done rows re-write pads over pads
-        off = jnp.minimum(n_out, NB - (G + 1))
-        tokens = row_write(carry["tokens"], block_toks_w, off)
-        logprobs = row_write(carry["logprobs"], block_lp_w, off)
-        values = row_write(carry["values"], block_val_w, off)
-        out_mask = row_write(carry["mask"], block_mask_w, off)
-
-        n_new = n_out + commit_len
-        done_new = done | (n_new >= N)
-        if config.eos_token_id is not None:
-            done_new = done_new | jnp.any(
-                (block_toks_w == config.eos_token_id) & (valid), axis=1
-            )
-        last_idx = jnp.maximum(commit_len - 1, 0)
-        t_last_new = jnp.where(
-            commit_len > 0,
-            jnp.take_along_axis(block_toks_w, last_idx[:, None], axis=1)[:, 0],
-            t_last,
-        )
-
-        return {
-            "rng": rng,
-            "n_out": n_new,
-            "done": done_new,
-            "t_last": t_last_new,
-            "t_cache": t_cache_new,
-            "d_cache": d_cache_new,
-            "tokens": tokens,
-            "logprobs": logprobs,
-            "values": values,
-            "mask": out_mask,
-            "rounds": carry["rounds"] + 1,
-            # accepted draft tokens this round, live rows only — k is
-            # PRE-truncation acceptance (budget/eos clipping is not
-            # rejection), so the rate reflects draft quality alone
-            "accepted": carry["accepted"] + jnp.sum(jnp.where(~done, k, 0)),
-            "live_rounds": carry["live_rounds"] + jnp.sum((~done).astype(jnp.int32)),
-        }
 
     def cond(carry):
         return ~jnp.all(carry["done"])
@@ -445,6 +509,7 @@ def generate_speculative(
         "rounds": jnp.asarray(0, jnp.int32),
         "accepted": jnp.asarray(0, jnp.int32),
         "live_rounds": jnp.asarray(0, jnp.int32),
+        "committed": jnp.asarray(0, jnp.int32),
     }
     final = jax.lax.while_loop(cond, round_step, init)
 
@@ -465,6 +530,10 @@ def generate_speculative(
             # fraction of proposed draft tokens accepted (per live row-round)
             "acceptance_rate": final["accepted"]
             / jnp.maximum(final["live_rounds"] * G, 1),
+            # committed tokens per live row-round (throughput multiplier,
+            # ∈ [1, G+1] — every live round commits at least the residual)
+            "tokens_per_round": final["committed"]
+            / jnp.maximum(final["live_rounds"], 1),
         }
         return out, stats
     return out

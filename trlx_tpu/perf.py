@@ -61,6 +61,15 @@ PAGED_ENGINE_PROGRAMS = ("paged_refill", "paged_decode")
 # stays the gather-path program.
 PAGED_KERNEL_PROGRAMS = ("paged_refill", "paged_decode_kernel")
 
+# Paged backend with engine.speculative: the refill prefills BOTH caches
+# (target through the block table + the dense draft cache) and the decode
+# segment is the speculative round program — draft propose loop + the
+# single multi-position verify forward + accept/commit
+# (ops/speculative.py::spec_round_step inside ops/slot_refill.py). These
+# two ARE the complete spec hot path: budgeting them pins "zero extra
+# compiled programs per bucket beyond (spec refill, spec segment)".
+PAGED_SPEC_PROGRAMS = ("paged_spec_refill", "paged_spec_segment")
+
 
 def _engine_programs(config: TRLConfig) -> Tuple[str, ...]:
     """The rollout programs ``train.continuous_batching`` adds, resolved
@@ -75,6 +84,13 @@ def _engine_programs(config: TRLConfig) -> Tuple[str, ...]:
     ``paged_decode_kernel``."""
     if not bool(getattr(config.train, "continuous_batching", False)):
         return ()
+    if int(getattr(config.engine, "speculative", 0)):
+        # spec forces the xla kernels (the segment is the gather-reference
+        # shape), so the names never compose with the pallas variants
+        progs = ("paged_spec_refill",)
+        if int(getattr(config.engine, "prefill_chunk", 0)):
+            progs = progs + ("paged_prefill_chunk",)
+        return progs + ("paged_spec_segment",)
     if config.engine.backend == "paged":
         refill = (
             "paged_prefill_kernel"
@@ -286,7 +302,10 @@ def hot_program_costs(
             fn = trainer._get_generate_fn(gen_config, ())
             results["generate"] = _costs_of(
                 fn.lower(
-                    params,
+                    # under engine.speculative the serial sampler takes the
+                    # (target, draft) tuple so abstract draft params lower
+                    # as operands, not closures
+                    trainer._engine_params(params),
                     batch_sds((B, P), np.int32),
                     batch_sds((B, P), np.int32),
                     jax.random.PRNGKey(0),
@@ -297,6 +316,7 @@ def hot_program_costs(
             CONTINUOUS_BATCHING_PROGRAMS
             + PAGED_ENGINE_PROGRAMS
             + PAGED_KERNEL_PROGRAMS
+            + PAGED_SPEC_PROGRAMS
             + ("paged_prefill_kernel", "paged_prefill_chunk")
         )
         if any(p in programs for p in cb_all):
@@ -320,12 +340,19 @@ def hot_program_costs(
             )
             fns = trainer._get_slot_refill_fns(gen_config, (), B, P, seg)
             state_sds = jax.eval_shape(fns.init_state)
-            refill_names = ("cb_refill", "paged_refill", "paged_prefill_kernel")
+            # spec programs take the (target, draft) params tuple — the
+            # same value the engine holds (trainer._engine_params); plain
+            # configs get `params` back unchanged
+            eng_params = trainer._engine_params(params)
+            refill_names = (
+                "cb_refill", "paged_refill", "paged_prefill_kernel",
+                "paged_spec_refill",
+            )
             if any(p in programs for p in refill_names):
                 # the full-bucket (R = B) cold refill program: worst-case
                 # refill cost; smaller buckets / prefix hits are cheaper
                 refill_args = [
-                    params,
+                    eng_params,
                     state_sds,
                     batch_sds((B, P), np.int32),
                     batch_sds((B, P), np.int32),
@@ -334,11 +361,12 @@ def hot_program_costs(
                 ]
                 name = "cb_refill"
                 if fns.paged is not None:
-                    name = (
-                        "paged_prefill_kernel"
-                        if getattr(fns, "prefill_kernel", "xla") == "pallas"
-                        else "paged_refill"
-                    )
+                    if getattr(fns, "speculative", 0):
+                        name = "paged_spec_refill"
+                    elif getattr(fns, "prefill_kernel", "xla") == "pallas":
+                        name = "paged_prefill_kernel"
+                    else:
+                        name = "paged_refill"
                     TB = state_sds.cache.block_table.shape[1]
                     refill_args.append(SDS((B, TB), np.int32))
                 results[name] = _costs_of(
@@ -355,7 +383,7 @@ def hot_program_costs(
                 TB = state_sds.cache.block_table.shape[1]
                 results["paged_prefill_chunk"] = _costs_of(
                     fns.prefill_chunk_program(B, 0, chunk).lower(
-                        params,
+                        eng_params,
                         state_sds,
                         batch_sds((B, P), np.int32),
                         batch_sds((B, P), np.int32),
@@ -366,15 +394,18 @@ def hot_program_costs(
                 "cb_segment" in programs
                 or "paged_decode" in programs
                 or "paged_decode_kernel" in programs
+                or "paged_spec_segment" in programs
             ):
                 if fns.paged is None:
                     name = "cb_segment"
+                elif getattr(fns, "speculative", 0):
+                    name = "paged_spec_segment"
                 elif getattr(fns, "decode_kernel", "xla") == "pallas":
                     name = "paged_decode_kernel"
                 else:
                     name = "paged_decode"
                 results[name] = _costs_of(
-                    fns.decode_segment.lower(params, state_sds)
+                    fns.decode_segment.lower(eng_params, state_sds)
                 )
 
         if "score" in programs:
@@ -559,6 +590,28 @@ def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
                     backend="paged", kv_block_size=8, prefix_cache=True,
                     decode_kernel="pallas", prefill_kernel="pallas",
                     prefill_chunk=8,
+                ),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "gpt2_test_spec": (
+            # speculative continuous batching (engine.speculative): the
+            # spec refill (target prefill through the block table + the
+            # dense draft-cache prefill) and the speculative segment (the
+            # draft-propose loop + single multi-position verify forward
+            # per round, ops/speculative.py::spec_round_step). The pair of
+            # budgets is the standing record that speculation adds exactly
+            # these two programs per bucket — nothing else.
+            base.evolve(
+                train=dict(continuous_batching=True),
+                model=dict(
+                    model_path="builtin:gpt2-test", num_layers_unfrozen=1,
+                    draft_model_path="builtin:gpt2-test", draft_gamma=4,
+                ),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+                engine=dict(
+                    backend="paged", kv_block_size=8, prefix_cache=True,
+                    speculative=4,
                 ),
             ),
             dict(batch_size=8, prompt_len=32, gen_len=16),

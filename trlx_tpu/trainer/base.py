@@ -205,6 +205,38 @@ class TPUBaseTrainer(BaseRLTrainer):
                 "requires engine.backend: paged — the chunk programs "
                 "commit prompt spans through the block table"
             )
+        if int(config.engine.speculative) < 0:
+            raise ValueError(
+                f"engine.speculative {config.engine.speculative} must be "
+                ">= 0 (0 = off, k = draft tokens proposed per verify round)"
+            )
+        if int(config.engine.speculative):
+            # each requirement its own error: the composition has three
+            # independent preconditions and "speculative engine misconfigured"
+            # would send users grepping
+            if not config.model.draft_model_path:
+                raise ValueError(
+                    "engine.speculative (speculative continuous batching) "
+                    "requires model.draft_model_path — the engine needs a "
+                    "draft model to propose tokens for the target to verify"
+                )
+            if config.engine.backend != "paged":
+                raise ValueError(
+                    "engine.speculative requires engine.backend: paged — "
+                    "the verify pass commits accepted K/V through the "
+                    "block table with drop-mode writes"
+                )
+            if (
+                config.engine.decode_kernel != "xla"
+                or config.engine.prefill_kernel != "xla"
+            ):
+                raise ValueError(
+                    "engine.speculative requires engine.decode_kernel: xla "
+                    "and engine.prefill_kernel: xla — the spec segment is "
+                    "the gather → shared round (ops/speculative.py) → "
+                    "scatter program; the in-place Pallas kernels have no "
+                    "multi-token verify path yet"
+                )
         self.mesh = make_mesh(config.parallel)
         set_global_mesh(self.mesh)  # model code reads this for sequence-parallel ops
         # NOTE: the global mesh is process-wide; entry points re-assert it so
@@ -853,11 +885,19 @@ class TPUBaseTrainer(BaseRLTrainer):
                     return draft_module.apply({"params": p}, ids, **kw)
 
                 def fn(params, input_ids, attention_mask, rng):
+                    # first arg is the target params, or the engine's
+                    # (target, draft) tuple — the tuple form keeps draft
+                    # params a traced operand instead of a closure, which
+                    # abstract-weight lowering (trlx_tpu/perf.py) requires
+                    if type(params) is tuple:
+                        t_params, d_params = params
+                    else:
+                        t_params, d_params = params, draft_params
                     return generate_speculative(
                         apply_fn,
-                        params,
+                        t_params,
                         draft_apply,
-                        draft_params,
+                        d_params,
                         lambda B, S: make_kv_cache(tcfg, B, S),
                         lambda B, S: make_kv_cache(dcfg, B, S),
                         input_ids,
@@ -939,21 +979,29 @@ class TPUBaseTrainer(BaseRLTrainer):
                 "train.continuous_batching supports causal LMs only: the "
                 "seq2seq decoder has no slot-refill path"
             )
-        if self.draft_module is not None:
-            raise NotImplementedError(
-                "train.continuous_batching and speculative decoding "
-                "(model.draft_model_path) are not composed yet: the "
-                "sampler now supports per-row RNG chains "
-                "(ops/speculative.py, per_row_rng=True), but the slot "
-                "engine has no speculative decode-segment program — "
-                "rounds commit a variable number of tokens per row, which "
-                "the fixed-size segment decode does not express. Drop one "
-                "of the two (ROADMAP item 2 tracks the composition)."
+        gamma = int(self.config.engine.speculative)
+        if gamma and self.draft_module is None:
+            # __init__ validates the config path; this guards direct callers
+            raise ValueError(
+                "engine.speculative requires model.draft_model_path (no "
+                "draft model was built)"
             )
+        if self.draft_module is not None and not gamma:
+            if not getattr(self, "_warned_cb_draft", False):
+                self._warned_cb_draft = True
+                logger.warning(
+                    "model.draft_model_path is set but engine.speculative "
+                    "is 0: continuous batching runs PLAIN decode segments "
+                    "(the serial path's model.draft_gamma does not apply "
+                    "here — set engine.speculative to propose k tokens "
+                    "per verify round)"
+                )
         import dataclasses as _dc
 
         gen_config = _dc.replace(gen_config, per_row_rng=True)
-        paged = self._resolve_paged_spec(batch_size, prompt_len, gen_config)
+        paged = self._resolve_paged_spec(
+            batch_size, prompt_len, gen_config, gamma=gamma
+        )
         decode_kernel = (
             self.config.engine.decode_kernel if paged is not None else "xla"
         )
@@ -962,13 +1010,35 @@ class TPUBaseTrainer(BaseRLTrainer):
         )
         key = (
             "slot_refill", gen_config, extra_kwargs, batch_size, prompt_len,
-            segment_len, paged, decode_kernel, prefill_kernel,
+            segment_len, paged, decode_kernel, prefill_kernel, gamma,
         )
         if key not in self._generate_fns:
             from trlx_tpu.ops.slot_refill import make_slot_refill_fns
 
-            adjust = self._compose_logit_mask(self.adjust_logits_fn(dict(extra_kwargs)))
+            algo_adjust = self.adjust_logits_fn(dict(extra_kwargs))
             tcfg = self.tcfg
+            spec_kwargs = {}
+            if gamma:
+                # speculative segments take the transition mask SEPARATELY
+                # (applied to draft AND target inside the shared round, the
+                # serial generate_speculative convention) and the raw algo
+                # hook for the target's verify distributions — composing
+                # the mask into adjust would leave the draft unconstrained
+                # and the acceptance rule lossy under constrained sampling
+                adjust = algo_adjust
+                draft_module, dcfg = self.draft_module, self.draft_tcfg
+
+                def draft_apply(p, ids, **kw):
+                    return draft_module.apply({"params": p}, ids, **kw)
+
+                spec_kwargs = dict(
+                    speculative=gamma,
+                    draft_apply=draft_apply,
+                    init_draft_cache_fn=lambda B, S: make_kv_cache(dcfg, B, S),
+                    transition_mask=self._logit_mask_array(),
+                )
+            else:
+                adjust = self._compose_logit_mask(algo_adjust)
             self._generate_fns[key] = make_slot_refill_fns(
                 self._apply_fn(),
                 lambda B, S: make_kv_cache(tcfg, B, S),
@@ -981,10 +1051,24 @@ class TPUBaseTrainer(BaseRLTrainer):
                 paged=paged,
                 decode_kernel=decode_kernel,
                 prefill_kernel=prefill_kernel,
+                **spec_kwargs,
             )
         return self._generate_fns[key]
 
-    def _resolve_paged_spec(self, batch_size: int, prompt_len: int, gen_config):
+    def _engine_params(self, params: Any = None) -> Any:
+        """The params object the rollout engines consume: the policy
+        params, or — with ``engine.speculative`` on — the ``(target,
+        draft)`` tuple the spec programs unpack. One object means
+        ``swap_params`` adopts both trees atomically at a segment boundary
+        (a mid-stream sync can never verify old-target against new-draft)."""
+        target = self.state.params if params is None else params
+        if int(self.config.engine.speculative):
+            return (target, self.draft_params)
+        return target
+
+    def _resolve_paged_spec(
+        self, batch_size: int, prompt_len: int, gen_config, gamma: int = 0
+    ):
         """The paged-KV geometry for this trainer's ``engine:`` config
         section, or None for the dense backend. ``max_kv_blocks`` auto
         (0) sizes the pool so every slot can reach full length, plus an
@@ -1003,8 +1087,12 @@ class TPUBaseTrainer(BaseRLTrainer):
         bs = int(ecfg.kv_block_size)
         if bs < 1:
             raise ValueError(f"engine.kv_block_size {bs} must be >= 1")
+        # speculative segments gather/scatter an S = P + N + gamma view
+        # (solo's cache width — the G probe columns past the last commit),
+        # so tables carry entries for the probe region too; only the
+        # committable P + N columns ever consume allocated blocks
         table_blocks = num_table_blocks(
-            prompt_len + gen_config.max_new_tokens, bs
+            prompt_len + gen_config.max_new_tokens + int(gamma), bs
         )
         max_blocks = int(ecfg.max_kv_blocks)
         if max_blocks <= 0:

@@ -794,7 +794,7 @@ class PPOTrainer(TPUBaseTrainer):
             )
             engine = ContinuousEngine(
                 fns,
-                self.state.params if params is None else params,
+                self._engine_params(params),
                 self.tokenizer.pad_token_id,
                 span=self.obs.span,
                 # per-request lifecycle spans (engine/queue_wait → prefill →
@@ -807,9 +807,7 @@ class PPOTrainer(TPUBaseTrainer):
                 prefill_chunk=int(self.config.engine.prefill_chunk),
             )
             self._generate_fns[key] = engine
-        engine.begin_collection(
-            self.state.params if params is None else params, version=version
-        )
+        engine.begin_collection(self._engine_params(params), version=version)
         return engine
 
     def _cb_chunk_keys(self, rows: int) -> np.ndarray:
@@ -1143,11 +1141,15 @@ class PPOTrainer(TPUBaseTrainer):
             completed.extend(engine.step())
             if channel is not None and engine.busy:
                 fresh, fresh_version = channel.fetch(template=self.state.params)
-                engine.swap_params(fresh, fresh_version)
+                # spec engines swap the (target, draft) tuple atomically
+                engine.swap_params(self._engine_params(fresh), fresh_version)
         completed.sort(key=lambda c: c.index)
         stats["time/exp_generate"] = engine.stats.decode_s + engine.stats.refill_s
         stats["time/generate"] = engine.stats.decode_s
-        return self._cb_group_device(completed, params=engine.params)
+        gen_params = engine.params
+        if int(self.config.engine.speculative):
+            gen_params = gen_params[0]  # scoring runs under the target
+        return self._cb_group_device(completed, params=gen_params)
 
     def _collect_async(
         self, num_rollouts: int, elements: list, stats: Dict[str, float],
