@@ -1,0 +1,332 @@
+"""Fused Pallas learner-step kernel (``ops/fused_loss.py``, ISSUE 18):
+GAE + masked advantage whitening + clipped PPO losses/stats in one program,
+pinned BIT-IDENTICAL to the XLA reference path in interpret mode — loss,
+every stat, every ``dist/*`` sketch bin, and the gradients w.r.t. the two
+differentiable operands (logprobs, values).
+
+Harness rule (the fourth-landmine facet the kernel's docstring documents):
+BOTH paths are compared jit-to-jit with EVERY operand passed as a runtime
+argument — exactly how the trainer passes batch arrays. An eager reference
+drifts 1 ulp in the scalar epilogue (FMA contraction), and a jitted
+reference that CLOSES OVER a bf16 ``old_values`` lets XLA constant-fold the
+``old_values ± cliprange_value`` clip bounds at different precision.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.models.grpo import GRPOConfig
+from trlx_tpu.models.ppo import PPOConfig
+from trlx_tpu.ops.fused_loss import (
+    fused_ppo_loss,
+    fused_ppo_loss_reference,
+)
+
+B, R = 7, 13
+MASK_KINDS = ("random", "allmasked_row", "all_zero", "single_token")
+
+
+def _method(**kw):
+    return PPOConfig(name="PPOConfig", **kw)
+
+
+def _mask(kind, rs, b=B, r=R):
+    if kind == "all_zero":
+        return np.zeros((b, r), np.float32)
+    if kind == "single_token":
+        m = np.zeros((b, r), np.float32)
+        m[np.arange(b), rs.randint(0, r, b)] = 1.0
+        return m
+    m = (rs.rand(b, r) > 0.3).astype(np.float32)
+    if kind == "allmasked_row":
+        m[0] = 0.0
+    return m
+
+
+def _operands(mask_kind="random", b=B, r=R, ov_dtype=None, seed=0):
+    rs = np.random.RandomState(seed)
+    lp = jnp.asarray(rs.randn(b, r).astype(np.float32) * 0.1)
+    v = jnp.asarray(rs.randn(b, r).astype(np.float32))
+    olp = lp + jnp.asarray(rs.randn(b, r).astype(np.float32) * 0.05)
+    ov = jnp.asarray(rs.randn(b, r).astype(np.float32))
+    if ov_dtype is not None:
+        ov = ov.astype(ov_dtype)
+    rw = jnp.asarray(rs.randn(b, r).astype(np.float32) * 0.05)
+    mask = jnp.asarray(_mask(mask_kind, rs, b, r))
+    return lp, v, olp, ov, rw, mask
+
+
+def _behavior(ops, seed=1):
+    rs = np.random.RandomState(seed)
+    olp = ops[2]
+    return olp + jnp.asarray(rs.randn(*olp.shape).astype(np.float32) * 0.03)
+
+
+def _assert_bitwise(method, ops, block_rows=8):
+    """loss, every stat key, and d(loss)/d(logprobs, values) — all
+    jnp.array_equal between the jitted XLA reference and the jitted fused
+    interpret-mode program, operands as runtime arguments throughout."""
+
+    def ref(*a):
+        return fused_ppo_loss_reference(method, *a)
+
+    def fus(*a):
+        return fused_ppo_loss(
+            method, *a, interpret=True, block_rows=block_rows
+        )
+
+    rl, rstats = jax.jit(ref)(*ops)
+    fl, fstats = jax.jit(fus)(*ops)
+    assert jnp.array_equal(rl, fl), "loss differs"
+    assert set(rstats) == set(fstats)
+    for k in rstats:
+        assert jnp.array_equal(rstats[k], fstats[k]), f"stat {k} differs"
+    gref = jax.jit(jax.grad(lambda *a: ref(*a)[0], argnums=(0, 1)))(*ops)
+    gfus = jax.jit(jax.grad(lambda *a: fus(*a)[0], argnums=(0, 1)))(*ops)
+    assert jnp.array_equal(gref[0], gfus[0]), "d/d logprobs differs"
+    assert jnp.array_equal(gref[1], gfus[1]), "d/d values differs"
+
+
+# ---------------------------------------------------------------------------
+# bit-parity sweep
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mask_kind", MASK_KINDS)
+def test_bit_parity_across_mask_shapes(mask_kind):
+    """Every mask edge case the whitening/GAE epilogue can hit: random
+    holes, a fully-masked row, an all-masked batch, single-token rows."""
+    _assert_bitwise(_method(), _operands(mask_kind))
+
+
+@pytest.mark.parametrize("block_rows", [1, 2, 3, 8, 16])
+def test_bit_parity_across_block_rows(block_rows):
+    """Padding granularity is a layout knob, not a semantics knob — B=7
+    is not a multiple of any of these, R=13 is not a multiple of the lane
+    width."""
+    _assert_bitwise(_method(), _operands(), block_rows=block_rows)
+
+
+def test_bit_parity_with_importance_weighting():
+    """behavior_logprobs (async collection) routes through the 7-operand
+    custom_vjp pair; iw stats ride bit-identically."""
+    ops = _operands()
+    _assert_bitwise(
+        _method(iw_correction="clip"), ops + (_behavior(ops),)
+    )
+
+
+def test_bit_parity_bf16_old_values():
+    """Mixed-dtype operands stay in their ORIGINAL dtypes inside the
+    kernel — a host-side pre-cast would shift the clip bounds by 2^-11."""
+    _assert_bitwise(_method(), _operands(ov_dtype=jnp.bfloat16))
+
+
+def test_bit_parity_degenerate_shapes():
+    _assert_bitwise(_method(), _operands(b=1, r=1, mask_kind="random"))
+    _assert_bitwise(_method(), _operands(b=1, r=1, mask_kind="all_zero"))
+
+
+# ---------------------------------------------------------------------------
+# seam + sketches
+# ---------------------------------------------------------------------------
+
+
+def test_reference_is_the_method_composition():
+    """``fused_ppo_loss_reference`` must be the trainer's XLA path op for
+    op: genuine ``get_advantages_and_returns`` + genuine ``method.loss``
+    (parity-by-construction — the kernel body calls the same functions)."""
+    m = _method()
+    ops = _operands()
+
+    def manual(lp, v, olp, ov, rw, mask):
+        adv, ret = m.get_advantages_and_returns(ov, rw, mask)
+        return m.loss(
+            logprobs=lp, values=v, old_logprobs=olp, old_values=ov,
+            advantages=adv, returns=ret, mask=mask,
+        )
+
+    ml, mstats = jax.jit(manual)(*ops)
+    rl, rstats = jax.jit(
+        lambda *a: fused_ppo_loss_reference(m, *a)
+    )(*ops)
+    assert jnp.array_equal(ml, rl)
+    assert set(mstats) == set(rstats)
+    for k in mstats:
+        assert jnp.array_equal(mstats[k], rstats[k]), k
+
+
+def test_sketches_ride_without_perturbing_loss_or_grads():
+    """PR-15 acceptance carried forward: dist_sketches on vs off leaves
+    loss and grads byte-identical on the FUSED path (sketches are a pure
+    epilogue), and the sketch stats themselves are bit-equal to the XLA
+    reference's."""
+    ops = _operands()
+    on, off = _method(dist_sketches=True), _method(dist_sketches=False)
+
+    def fused_of(m):
+        return jax.jit(
+            lambda *a: fused_ppo_loss(m, *a, interpret=True)
+        )
+
+    l_on, s_on = fused_of(on)(*ops)
+    l_off, s_off = fused_of(off)(*ops)
+    assert jnp.array_equal(l_on, l_off)
+    g_on = jax.jit(jax.grad(
+        lambda *a: fused_ppo_loss(on, *a, interpret=True)[0], argnums=(0, 1)
+    ))(*ops)
+    g_off = jax.jit(jax.grad(
+        lambda *a: fused_ppo_loss(off, *a, interpret=True)[0], argnums=(0, 1)
+    ))(*ops)
+    assert jnp.array_equal(g_on[0], g_off[0])
+    assert jnp.array_equal(g_on[1], g_off[1])
+    sketch_keys = {k for k in s_on if k.startswith("dist/")}
+    assert sketch_keys and not any(k.startswith("dist/") for k in s_off)
+    _, ref_stats = jax.jit(
+        lambda *a: fused_ppo_loss_reference(on, *a)
+    )(*ops)
+    for k in sketch_keys:
+        assert jnp.array_equal(s_on[k], ref_stats[k]), k
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: value targets are batch constants
+# ---------------------------------------------------------------------------
+
+
+def test_returns_and_advantages_are_stop_gradiented():
+    """GAE targets are regression targets, not predictions: no gradient
+    may flow from the loss back through ``returns``/``advantages`` into
+    ``old_values`` — the leak audit this PR closes, and the property that
+    makes the fused kernel's targets-are-constants treatment exact by
+    definition rather than by the trainer's call pattern."""
+    m = _method()
+    _, _, _, ov, rw, mask = _operands()
+
+    for pick in (0, 1):  # advantages, returns
+        g = jax.grad(
+            lambda o: m.get_advantages_and_returns(o, rw, mask)[pick].sum()
+        )(ov)
+        assert (np.asarray(g) == 0.0).all()
+
+    # grad-equality regression at the loss level: d(loss)/d(values) is
+    # identical whether or not old_values is treated as differentiable
+    lp, v, olp, ov, rw, mask = _operands()
+
+    def loss_of(values, old_values):
+        adv, ret = m.get_advantages_and_returns(old_values, rw, mask)
+        return m.loss(
+            logprobs=lp, values=values, old_logprobs=olp,
+            old_values=old_values, advantages=adv, returns=ret, mask=mask,
+        )[0]
+
+    g_live = jax.jit(jax.grad(loss_of, argnums=0))(v, ov)
+    g_const = jax.jit(jax.grad(
+        lambda values: loss_of(values, jax.lax.stop_gradient(ov))
+    ))(v)
+    assert jnp.array_equal(g_live, g_const)
+
+
+# ---------------------------------------------------------------------------
+# method capability + trainer seam
+# ---------------------------------------------------------------------------
+
+
+def test_loss_kernel_capability_narrowing():
+    assert PPOConfig.LOSS_KERNELS == ("xla", "pallas")
+    assert GRPOConfig.LOSS_KERNELS == ("xla",)
+    assert _method().loss_kernel == "xla"  # default stays the reference
+
+
+def test_loss_fused_method_seam():
+    """``PPOConfig.loss_fused`` (the trainer-facing entry) matches the
+    reference composition bit for bit — it takes raw rewards and computes
+    advantages/returns inside."""
+    m = _method()
+    ops = _operands()
+    fl, fstats = jax.jit(
+        lambda *a: m.loss_fused(
+            logprobs=a[0], values=a[1], old_logprobs=a[2],
+            old_values=a[3], rewards=a[4], mask=a[5],
+        )
+    )(*ops)
+    rl, rstats = jax.jit(
+        lambda *a: fused_ppo_loss_reference(m, *a)
+    )(*ops)
+    assert jnp.array_equal(fl, rl)
+    for k in rstats:
+        assert jnp.array_equal(fstats[k], rstats[k]), k
+
+
+def test_trainer_loss_fn_parity(tmp_path):
+    """End to end through the trainer: ``method.loss_kernel: pallas``
+    produces bit-identical loss AND parameter gradients to the XLA path on
+    the same batch through the same model — and emits the
+    ``train/loss_kernel_pallas`` gauge."""
+    import trlx_tpu.trainer.ppo  # noqa: F401 (registration)
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.trainer import get_trainer
+
+    def trainer_for(kernel):
+        cfg = default_ppo_config().evolve(
+            train=dict(
+                seq_length=16, batch_size=4, total_steps=2,
+                checkpoint_dir=str(tmp_path / f"ck_{kernel}"),
+                tracker=None,
+            ),
+            model=dict(
+                model_path="builtin:gpt2-test",
+                model_extra_kwargs={"dtype": "float32"},
+                num_layers_unfrozen=1,
+            ),
+            method=dict(loss_kernel=kernel),
+        )
+        return get_trainer(cfg.train.trainer)(
+            config=cfg, reward_fn=lambda *a, **k: [0.0],
+            metric_fn=None, stop_sequences=[],
+        )
+
+    t_xla = trainer_for("xla")
+    t_pal = trainer_for("pallas")
+
+    rs = np.random.RandomState(0)
+    Bt, Q, Rt = 4, 6, 5
+    batch = {
+        "query_tensors": jnp.asarray(rs.randint(5, 200, (Bt, Q)), jnp.int32),
+        "response_tensors": jnp.asarray(
+            rs.randint(5, 200, (Bt, Rt)), jnp.int32
+        ),
+        "query_mask": jnp.ones((Bt, Q), jnp.int32),
+        "response_mask": jnp.asarray(
+            (rs.rand(Bt, Rt) > 0.2).astype(np.int32)
+        ),
+        "logprobs": jnp.asarray(rs.randn(Bt, Rt).astype(np.float32) * 0.1),
+        "values": jnp.asarray(rs.randn(Bt, Rt).astype(np.float32)),
+        "rewards": jnp.asarray(rs.randn(Bt, Rt).astype(np.float32) * 0.05),
+    }
+    rng = jax.random.PRNGKey(0)
+    params = t_xla.state.params
+
+    (l_x, s_x), g_x = jax.jit(
+        jax.value_and_grad(t_xla.loss_fn, has_aux=True)
+    )(params, batch, rng)
+    (l_p, s_p), g_p = jax.jit(
+        jax.value_and_grad(t_pal.loss_fn, has_aux=True)
+    )(params, batch, rng)
+
+    assert jnp.array_equal(l_x, l_p), "trainer loss differs between kernels"
+    mismatched = [
+        str(path)
+        for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(g_x),
+            jax.tree_util.tree_leaves_with_path(g_p),
+        )
+        if not np.array_equal(np.asarray(a), np.asarray(b))
+    ]
+    assert not mismatched, f"grad divergence at {mismatched}"
+    assert "train/loss_kernel_pallas" in s_p
+    assert "train/loss_kernel_pallas" not in s_x
+    for k in s_x:
+        assert jnp.array_equal(s_x[k], s_p[k]), f"stat {k} differs"

@@ -78,6 +78,11 @@ def test_scanner_sees_the_codebase():
     assert "engine/spec_acceptance_rate" in keys
     assert "engine/spec_tokens_per_round" in keys
     assert "rollout/spec_rounds" in keys
+    # fused learner kernel + multi-position verify kernel (docs/PERFORMANCE.md
+    # "Fused learner kernels"): which compute actually ran — literal sites in
+    # trainer/ppo.py and engine/core.py
+    assert "train/loss_kernel_pallas" in keys
+    assert "engine/spec_verify_kernel_pallas" in keys
     # distributed-telemetry keys (docs/OBSERVABILITY.md "Distributed
     # telemetry"): the cluster beat's literal set_gauge sites
     assert "cluster/step_skew_s" in keys

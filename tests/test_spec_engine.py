@@ -161,9 +161,35 @@ class TestBitParity:
         assert m["rollout/spec_rounds"] > 0
         assert 0.0 < m["engine/spec_acceptance_rate"] <= 1.0
         assert 1.0 <= m["engine/spec_tokens_per_round"] <= G + 1
+        assert m["engine/spec_verify_kernel_pallas"] == 0.0  # xla verify
         # spec segments commit multiple tokens per round: total committed
         # tokens exceed the rounds run (the whole point of the program)
         assert eng.stats.spec_committed > eng.stats.spec_rounds
+
+    def test_pallas_kernels_compose(self, models, solo_refs):
+        """ISSUE 18 acceptance: engine.speculative no longer forces the
+        gather-reference kernels. With decode_kernel AND prefill_kernel
+        pallas the spec segment runs in place — the width-``G + 1`` verify
+        forwards read K/V through the multi-position verify kernel
+        (``ops/paged_attention.py::paged_verify_attention``) and commit
+        probe columns through per-row done-poisoned block tables — and
+        every harvested row stays bit-identical to its solo run (and hence
+        to the xla-kernel spec path, which pins against the same refs)."""
+        ids, mask, keys = _prompts()
+        fns = _spec_fns(
+            models, block_size=4, segment_len=2,
+            decode_kernel="pallas", prefill_kernel="pallas",
+        )
+        got, eng = _harvest_all(models, fns, ids, mask, keys)
+        _assert_parity(got, solo_refs, "pallas kernels")
+        assert eng.stats.spec_rounds > 0
+        # the verify-compute stamp must survive the per-collection stats
+        # reset (begin_collection rebuilds EngineStats; regression — the
+        # stamp used to be dropped there and always read 0)
+        from trlx_tpu.ops.pallas_utils import has_pallas_tpu
+
+        m = eng.stats.metrics()
+        assert m["engine/spec_verify_kernel_pallas"] == float(has_pallas_tpu())
 
     def test_odd_blocks_and_chunked_prefill(self, models, solo_refs):
         """Block size 3 (nothing aligns: P=8, S=21) with chunked prefill —
@@ -269,16 +295,6 @@ class TestValidation:
                 init_draft_cache_fn=models["d_init"],
             )
 
-    def test_requires_xla_kernels(self, models):
-        paged = PagedSpec(block_size=4, max_blocks=64)
-        with pytest.raises(ValueError, match="Pallas kernels"):
-            make_slot_refill_fns(
-                models["t_apply"], models["t_init"], B, P, _gen_config(),
-                paged=paged, decode_kernel="pallas",
-                speculative=G, draft_apply=models["d_apply"],
-                init_draft_cache_fn=models["d_init"],
-            )
-
     def test_requires_draft(self, models):
         paged = PagedSpec(block_size=4, max_blocks=64)
         with pytest.raises(ValueError, match="draft model"):
@@ -327,18 +343,24 @@ class TestValidation:
                     draft_model_path="builtin:gpt2-test",
                 ),
             )
-        with pytest.raises(ValueError, match="xla"):
-            build(
-                engine=dict(
-                    backend="paged", speculative=2, decode_kernel="pallas"
-                ),
-                model=dict(
-                    model_path="builtin:gpt2-test",
-                    draft_model_path="builtin:gpt2-test",
-                ),
-            )
         with pytest.raises(ValueError, match="must be >= 0"):
             build(engine=dict(backend="paged", speculative=-1))
+        # spec + pallas kernels now COMPOSE (the verify kernel): the old
+        # decode_kernel blocker is gone — construction succeeds
+        t = build(
+            engine=dict(
+                backend="paged", speculative=2, decode_kernel="pallas",
+                kv_block_size=4,
+            ),
+            model=dict(
+                model_path="builtin:gpt2-test",
+                draft_model_path="builtin:gpt2-test",
+            ),
+        )
+        assert t is not None
+        # method.loss_kernel is validated at construction the same way
+        with pytest.raises(ValueError, match="loss_kernel"):
+            build(method=dict(loss_kernel="mosaic"))
 
 
 @pytest.mark.slow

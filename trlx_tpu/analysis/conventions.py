@@ -130,6 +130,17 @@ ENGINE_KEYS = frozenset({
     "engine/spec_acceptance_rate",
     "engine/spec_tokens_per_round",
     "rollout/spec_rounds",
+    # spec verify compute path gauge (0/1): the in-place multi-position
+    # verify kernel (ops/paged_attention.py::paged_verify_attention, runs
+    # when engine.decode_kernel: pallas composes with engine.speculative)
+    # vs the gather → shared round → scatter reference
+    "engine/spec_verify_kernel_pallas",
+    # fused learner-step kernel gauge (0/1): method.loss_kernel: pallas
+    # ran with the Mosaic (pallas TPU) backend importable
+    # (ops/fused_loss.py) — a Mosaic-less build's staged fallback reports
+    # 0, so an artifact can't claim kernel=1 it never ran
+    # (docs/PERFORMANCE.md "Fused learner kernels")
+    "train/loss_kernel_pallas",
 })
 
 # Canonical cross-rank telemetry gauges (observability/distributed.py,

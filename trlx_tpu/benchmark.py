@@ -1107,13 +1107,19 @@ def measure_engine_spec(
     — ``num_rollouts`` prompts drained through ``batch_size`` slots with
     an absorbing transition mask (geometric lengths → refill churn).
 
-    The two arms run DIFFERENT per-row streams by construction (the spec
-    sampler advances the per-row key chains gamma+2 draws per round, the
-    plain sampler one per token), so the in-benchmark equality assert is
-    the spec contract itself: the spec arm's harvest is bit-identical,
-    per row, to one solo batched ``generate_speculative`` call over all
-    ``num_rollouts`` rows — refills, block tables, and batch composition
-    invisible (the standing tier-1 pin: ``tests/test_spec_engine.py``).
+    The plain and spec arms run DIFFERENT per-row streams by construction
+    (the spec sampler advances the per-row key chains gamma+2 draws per
+    round, the plain sampler one per token), so the in-benchmark equality
+    assert is the spec contract itself: each spec arm's harvest is
+    bit-identical, per row, to one solo batched ``generate_speculative``
+    call over all ``num_rollouts`` rows — refills, block tables, and
+    batch composition invisible (the standing tier-1 pin:
+    ``tests/test_spec_engine.py``). The third arm (``spec_pallas``) runs
+    the same speculative rounds over the Pallas kernels — the in-place
+    paged prefill plus the multi-position verify kernel
+    (``ops/paged_attention.py::paged_verify_attention``) — and is held to
+    the same solo reference, pinning that the kernel composition changes
+    no bit of the harvest.
 
     The committed claims (benchmarks/ENGINE_SPEC_cpu.json):
 
@@ -1234,8 +1240,16 @@ def measure_engine_spec(
     }
 
     harvests: Dict[str, Dict[int, Any]] = {}
-    for mode in ("plain", "spec"):
-        g = G if mode == "spec" else 0
+    # three arms: the plain paged segments, the speculative segments over
+    # the gather-reference kernels, and the speculative segments over the
+    # Pallas kernels (decode_kernel + prefill_kernel: pallas — the spec
+    # refill commits prompt K/V through the block table in place and the
+    # verify forward runs the multi-position paged kernel,
+    # ops/paged_attention.py::paged_verify_attention). Both spec arms
+    # decode the SAME per-row streams, so both are parity-asserted against
+    # the one solo run below.
+    for mode in ("plain", "spec", "spec_pallas"):
+        g = 0 if mode == "plain" else G
         S = P + N + g
         TB = -(-S // kv_block_size)
         paged = PagedSpec(block_size=kv_block_size, max_blocks=1 + 2 * B * TB)
@@ -1245,18 +1259,20 @@ def measure_engine_spec(
                 init_draft_cache_fn=lambda b, s: make_kv_cache(dcfg, b, s),
                 transition_mask=tmask,
             )
-            if mode == "spec"
+            if mode != "plain"
             # the plain arm composes the mask into adjust (the non-spec
-            # convention); the spec arm passes it separately so draft AND
+            # convention); the spec arms pass it separately so draft AND
             # target are constrained inside the shared round
             else dict(adjust_logits=adjust)
         )
+        if mode == "spec_pallas":
+            spec_kwargs.update(decode_kernel="pallas", prefill_kernel="pallas")
         fns = make_slot_refill_fns(
             t_apply, lambda b, s: make_kv_cache(tcfg, b, s), B, P, gen_config,
             segment_len=segment_len, params_example=t_params, paged=paged,
             **spec_kwargs,
         )
-        eng_params = (t_params, d_params) if mode == "spec" else t_params
+        eng_params = t_params if mode == "plain" else (t_params, d_params)
         engine = ContinuousEngine(fns, eng_params, pad, prefix_cache=True)
 
         def wave(keys, got):
@@ -1296,7 +1312,7 @@ def measure_engine_spec(
                 if k in ("flops", "bytes_accessed", "temp_bytes")
             },
         }
-        if mode == "spec":
+        if mode != "plain":
             results[mode].update(
                 acceptance_rate=round(m["engine/spec_acceptance_rate"], 4),
                 tokens_per_round=round(m["engine/spec_tokens_per_round"], 4),
@@ -1305,6 +1321,11 @@ def measure_engine_spec(
                 # win in backend-independent units (plain = 1.0)
                 target_forwards_per_token=round(
                     st.spec_live_rounds / max(st.spec_committed, 1), 4
+                ),
+                # which verify compute ran: the multi-position Pallas
+                # paged kernel (in place) or the gather-reference shape
+                verify_kernel=(
+                    "pallas" if mode == "spec_pallas" else "xla"
                 ),
             )
 
@@ -1319,29 +1340,30 @@ def measure_engine_spec(
         gen_config, gamma=G, transition_mask=tmask,
     )
     float_drift = 0.0
-    for i in range(num_rollouts):
-        for field, solo_arr in (
-            ("tokens", solo.response_tokens),
-            ("mask", solo.response_mask),
-        ):
-            assert (
-                harvests["spec"][i][field] == np.asarray(solo_arr)[i]
-            ).all(), (
-                f"spec engine harvest diverged from solo speculative run "
-                f"(row {i}, {field}) — bit-parity contract broken"
-            )
-        for field, solo_arr in (
-            ("logprobs", solo.response_logprobs),
-            ("values", solo.response_values),
-        ):
-            d = float(
-                np.abs(harvests["spec"][i][field] - np.asarray(solo_arr)[i]).max()
-            )
-            float_drift = max(float_drift, d)
-            assert d <= 4e-6, (
-                f"spec engine {field} diverged from solo beyond ulp scale "
-                f"(row {i}, max {d:.3e}) — parity contract broken"
-            )
+    for arm in ("spec", "spec_pallas"):
+        for i in range(num_rollouts):
+            for field, solo_arr in (
+                ("tokens", solo.response_tokens),
+                ("mask", solo.response_mask),
+            ):
+                assert (
+                    harvests[arm][i][field] == np.asarray(solo_arr)[i]
+                ).all(), (
+                    f"{arm} engine harvest diverged from solo speculative "
+                    f"run (row {i}, {field}) — bit-parity contract broken"
+                )
+            for field, solo_arr in (
+                ("logprobs", solo.response_logprobs),
+                ("values", solo.response_values),
+            ):
+                d = float(
+                    np.abs(harvests[arm][i][field] - np.asarray(solo_arr)[i]).max()
+                )
+                float_drift = max(float_drift, d)
+                assert d <= 4e-6, (
+                    f"{arm} engine {field} diverged from solo beyond ulp "
+                    f"scale (row {i}, max {d:.3e}) — parity contract broken"
+                )
     results["bit_identical_tokens"] = True
     # logprobs/values agree to ≤1 f32 ulp at these widths: the refill
     # program compiles separately from the solo sampler (its logits head
@@ -1354,14 +1376,20 @@ def measure_engine_spec(
     assert results["spec"]["acceptance_rate"] > 0.0, (
         "zero acceptance on a real draft/target pair"
     )
+    # the pallas arm replays the same streams, so its acceptance matches
+    assert (
+        results["spec_pallas"]["acceptance_rate"]
+        == results["spec"]["acceptance_rate"]
+    ), "pallas verify kernel changed the acceptance trace"
     results["speedup"] = round(
         results["plain"]["seconds"] / max(results["spec"]["seconds"], 1e-9), 3
     )
     results["programs_note"] = (
         "speculation SWAPS the per-bucket program pair (refill, segment) "
         "for (spec refill, spec segment) — it adds zero programs per "
-        "bucket; perf budget gpt2_test_spec (benchmarks/perf_budgets.json) "
-        "pins both programs' compiled costs"
+        "bucket; perf budgets gpt2_test_spec and gpt2_test_spec_kernel "
+        "(benchmarks/perf_budgets.json) pin both programs' compiled costs "
+        "for the gather-reference and Pallas-kernel compositions"
     )
     import jax as _jax
 
@@ -1379,11 +1407,241 @@ def measure_engine_spec(
             "> 0 on a real draft/target pair, and (c) "
             "target_forwards_per_token < 1.0 with the segment-program "
             "cost analysis: the verify forward's cost is amortized over "
-            "tokens_per_round committed tokens. On chip, run: "
+            "tokens_per_round committed tokens. The spec_pallas arm runs "
+            "the same rounds with the multi-position Pallas verify kernel "
+            "+ in-place prefill — off-TPU under the Pallas interpreter, "
+            "so its wall-clock measures the interpreter, not the kernel; "
+            "its committed claim is bit-parity (same solo reference, same "
+            "acceptance trace) through the real kernel code path. On "
+            "chip, run: "
             "TRLX_TPU_PLATFORM=tpu python -m trlx_tpu.benchmark "
             "engine-spec --policy-layers 24 --policy-hidden 1024 "
             "--draft-layers 4 --draft-hidden 256 --batch-size 64 "
             "--max-new-tokens 256 --num-rollouts 512"
+        )
+    return results
+
+
+def measure_loss_kernel(
+    batch_size: int = 64,
+    response_len: int = 128,
+    block_rows: int = 8,
+    rounds: int = 20,
+    seed: int = _SEED,
+) -> Dict[str, Any]:
+    """Learner-step A/B: the staged XLA loss chain vs the fused Pallas
+    kernel (``method.loss_kernel: pallas``, ops/fused_loss.py;
+    docs/PERFORMANCE.md "Fused learner kernels") on a synthetic PPO batch
+    of ``[batch_size, response_len]`` response windows with geometric
+    per-row lengths.
+
+    Three program measurements, all from XLA's compiled cost model
+    (``trlx_tpu/perf.py::lowered_costs``) over identical runtime operands:
+
+    - ``staged``: the three learner stages compiled as SEPARATE programs
+      — GAE (``get_advantages_and_returns`` without whitening), masked
+      whitening (``utils/stats.py::whiten``), and the clipped losses +
+      stats (``PPOConfig.loss``) — so every ``[B, R]`` intermediate
+      (advantages, returns, whitened advantages) crosses a program
+      boundary through HBM. This is the per-stage round-trip accounting
+      the fusion deletes;
+    - ``xla``: the trainer's actual reference path
+      (``fused_ppo_loss_reference``) in ONE jit — XLA already fuses what
+      it can across the stages, but the GAE scan and the whitening
+      reductions still materialize their ``[B, R]`` outputs;
+    - ``fused``: the fused Pallas program (``fused_ppo_loss``) — each
+      operand enters VMEM once, advantages/returns/whitening live and die
+      on-chip.
+
+    Both loss-and-stats and gradient (``d loss / d (logprobs, values)``)
+    programs are measured, and the fused path is asserted BIT-IDENTICAL
+    to the XLA reference in-function — loss, every stat, both grads —
+    before any number is reported (jit-to-jit, every operand a runtime
+    argument; see tests/test_fused_loss.py for why that harness rule
+    matters). The committed acceptance number is the bytes-accessed
+    reduction of ``fused`` against ``staged`` (and against ``xla``),
+    plus the analytic inter-stage ``[B, R]`` round-trip bytes the fusion
+    removes. Off-TPU the fused program runs under the Pallas interpreter,
+    so its wall-clock measures the interpreter, not the kernel — see
+    ``pallas_note`` in the artifact.
+    """
+    import numpy as np
+
+    from trlx_tpu.trlx import initialize_runtime
+
+    initialize_runtime()
+
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.ppo import PPOConfig
+    from trlx_tpu.ops.fused_loss import fused_ppo_loss, fused_ppo_loss_reference
+    from trlx_tpu.ops.pallas_utils import has_pallas_tpu
+    from trlx_tpu.perf import lowered_costs
+    from trlx_tpu.utils.stats import whiten
+
+    B, R = batch_size, response_len
+    rs = np.random.RandomState(seed)
+    # geometric per-row response lengths in [1, R]: the heterogeneous mask
+    # shape the whitening/GAE epilogue sees in real collection
+    lengths = np.clip(rs.geometric(p=4.0 / R, size=B), 1, R)
+    mask = np.zeros((B, R), np.float32)
+    for i, n in enumerate(lengths):
+        mask[i, :n] = 1.0
+    ops = (
+        jnp.asarray(rs.randn(B, R).astype(np.float32) * 0.1),  # logprobs
+        jnp.asarray(rs.randn(B, R).astype(np.float32)),  # values
+        jnp.asarray(rs.randn(B, R).astype(np.float32) * 0.1),  # old_logprobs
+        jnp.asarray(rs.randn(B, R).astype(np.float32)),  # old_values
+        jnp.asarray(rs.randn(B, R).astype(np.float32) * 0.05),  # rewards
+        jnp.asarray(mask),
+    )
+    method = PPOConfig(name="PPOConfig")
+
+    def ref(*a):
+        return fused_ppo_loss_reference(method, *a)
+
+    def fus(*a):
+        return fused_ppo_loss(method, *a, block_rows=block_rows)
+
+    # the staged chain as three separately-compiled programs: the [B, R]
+    # intermediates (advantages, returns, whitened advantages) cross HBM
+    # at every boundary — the accounting the fused program deletes
+    def stage_gae(old_values, rewards, m):
+        return method.get_advantages_and_returns(
+            old_values, rewards, m, use_whitening=False
+        )
+
+    def stage_whiten(advantages, m):
+        return whiten(advantages, m)
+
+    def stage_loss(logprobs, values, old_logprobs, old_values, adv, ret, m):
+        return method.loss(
+            logprobs=logprobs, values=values, old_logprobs=old_logprobs,
+            old_values=old_values, advantages=adv, returns=ret, mask=m,
+        )
+
+    lp, v, olp, ov, rw, m = ops
+    adv_raw, ret = jax.jit(stage_gae)(ov, rw, m)
+    adv = jax.jit(stage_whiten)(adv_raw, m)
+
+    def costs(lowered):
+        c = lowered_costs(lowered)
+        return {
+            k: c[k]
+            for k in ("flops", "bytes_accessed", "temp_bytes")
+            if k in c
+        }
+
+    staged_stages = {
+        "gae": costs(jax.jit(stage_gae).lower(ov, rw, m)),
+        "whiten": costs(jax.jit(stage_whiten).lower(adv_raw, m)),
+        "loss": costs(jax.jit(stage_loss).lower(lp, v, olp, ov, adv, ret, m)),
+    }
+    staged_total = {
+        k: sum(s[k] for s in staged_stages.values() if k in s)
+        for k in ("flops", "bytes_accessed", "temp_bytes")
+    }
+
+    def grad_fn(fn):
+        return jax.jit(jax.grad(lambda *a: fn(*a)[0], argnums=(0, 1)))
+
+    programs = {
+        "staged": {"stages": staged_stages, "total": staged_total},
+        "xla": {
+            "loss": costs(jax.jit(ref).lower(*ops)),
+            "loss_grad": costs(grad_fn(ref).lower(*ops)),
+        },
+        "fused": {
+            "loss": costs(jax.jit(fus).lower(*ops)),
+            "loss_grad": costs(grad_fn(fus).lower(*ops)),
+        },
+    }
+
+    # bit-parity gate: no cost number is reported unless the fused program
+    # is bit-identical to the reference on these exact operands
+    rl, rstats = jax.jit(ref)(*ops)
+    fl, fstats = jax.jit(fus)(*ops)
+    assert jnp.array_equal(rl, fl), "fused loss != xla loss — parity broken"
+    assert set(rstats) == set(fstats)
+    for k in rstats:
+        assert jnp.array_equal(rstats[k], fstats[k]), (
+            f"fused stat {k} != xla — parity broken"
+        )
+    gr = grad_fn(ref)(*ops)
+    gf = grad_fn(fus)(*ops)
+    assert jnp.array_equal(gr[0], gf[0]) and jnp.array_equal(gr[1], gf[1]), (
+        "fused grads != xla grads — parity broken"
+    )
+
+    # interpret-mode-caveated wall clock (meaningful on chip only)
+    timings = {}
+    for name, fn in (("xla", grad_fn(ref)), ("fused", grad_fn(fus))):
+        jax.block_until_ready(fn(*ops))  # warmup/compile
+        t0 = time.time()
+        for _ in range(rounds):
+            out = fn(*ops)
+        jax.block_until_ready(out)
+        timings[name] = round((time.time() - t0) / rounds, 6)
+
+    f32 = 4
+    results: Dict[str, Any] = {
+        "config": dict(
+            batch_size=B, response_len=R, block_rows=block_rows,
+            rounds=rounds, seed=seed,
+            response_len_mean=round(float(lengths.mean()), 2),
+        ),
+        "bit_identical": True,
+        "programs": programs,
+        # the acceptance numbers: one fused program instead of per-stage
+        # [B, R] HBM round-trips
+        "bytes_accessed_reduction_vs_staged": round(
+            1.0
+            - programs["fused"]["loss"]["bytes_accessed"]
+            / max(staged_total["bytes_accessed"], 1.0),
+            4,
+        ),
+        "bytes_accessed_reduction_vs_xla": round(
+            1.0
+            - programs["fused"]["loss"]["bytes_accessed"]
+            / max(programs["xla"]["loss"]["bytes_accessed"], 1.0),
+            4,
+        ),
+        # the [B, R] intermediates that cross program boundaries in the
+        # staged chain (advantages, returns, whitened advantages — each
+        # written by one stage and read by the next): exact arithmetic,
+        # backend-independent
+        "analytic_interstage_bytes": int(3 * 2 * B * R * f32),
+        "accounting_note": (
+            "the staged entry is the per-stage dispatch accounting "
+            "(three programs, intermediates through HBM) — the round-trips "
+            "the fusion deletes; the xla entry is the same chain in one "
+            "jit, where the CPU cost model already credits XLA's own "
+            "fusion, so fused-vs-xla measures interpret-lowering overhead "
+            "(0 here: the fused program compiles to the identical cost) "
+            "and the VMEM-residency win is an on-chip property the CPU "
+            "cost model cannot see"
+        ),
+        "loss_grad_seconds_per_call": timings,
+        "loss_kernel_pallas": float(has_pallas_tpu()),
+    }
+    import jax as _jax
+
+    results["backend"] = _jax.default_backend()
+    results["provenance"] = provenance()
+    if _jax.default_backend() != "tpu":
+        results["pallas_note"] = (
+            "off-TPU the fused program runs under the Pallas interpreter "
+            "(kernel body as sequential XLA ops): its wall-clock and its "
+            "own cost-analysis numbers measure the interpreter lowering, "
+            "not the Mosaic kernel — the committed CPU-scale claims are "
+            "bit-parity (loss/stats/grads, asserted in-function) through "
+            "the real kernel code path and the staged-chain bytes-accessed "
+            "accounting (three separately-compiled stages round-trip the "
+            "[B, R] intermediates through HBM; the fused path is one "
+            "program). On chip, run: TRLX_TPU_PLATFORM=tpu python -m "
+            "trlx_tpu.benchmark loss-kernel --batch-size 128 "
+            "--response-len 512"
         )
     return results
 
@@ -1459,6 +1717,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     es_p.add_argument("--absorb-frac", type=float, default=0.08)
     es_p.add_argument("--kv-block-size", type=int, default=8)
     es_p.add_argument("--segment-len", type=int, default=4)
+    lk_p = sub.add_parser(
+        "loss-kernel",
+        help="A/B learner step: staged XLA GAE/whitening/loss chain vs "
+        "the fused Pallas kernel (method.loss_kernel: pallas) — "
+        "bit-parity asserted, compiled bytes-accessed recorded",
+    )
+    lk_p.add_argument("--output", default=None, help="write JSON here (default stdout)")
+    lk_p.add_argument("--batch-size", type=int, default=64)
+    lk_p.add_argument("--response-len", type=int, default=128)
+    lk_p.add_argument("--block-rows", type=int, default=8)
+    lk_p.add_argument("--rounds", type=int, default=20)
     pf_p = sub.add_parser(
         "engine-prefill",
         help="A/B paged prefill: gather-prefill-scatter vs the in-place "
@@ -1547,6 +1816,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             absorb_frac=args.absorb_frac,
             kv_block_size=args.kv_block_size,
             segment_len=args.segment_len,
+        )
+        text = json.dumps(result, indent=2)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text + "\n")
+        print(text)
+        return 0
+    if args.cmd == "loss-kernel":
+        result = measure_loss_kernel(
+            batch_size=args.batch_size,
+            response_len=args.response_len,
+            block_rows=args.block_rows,
+            rounds=args.rounds,
         )
         text = json.dumps(result, indent=2)
         if args.output:

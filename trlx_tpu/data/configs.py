@@ -339,10 +339,15 @@ class EngineConfig:
         paged forward — committing 1..k+1 tokens per row per round while
         every harvested sequence stays bit-identical to a solo
         ``ops/speculative.py`` run of that row (``tests/test_spec_engine
-        .py``). Requires ``backend: paged`` with the xla decode/prefill
-        compute, ``model.draft_model_path``, and per-row RNG (always on
-        under continuous batching). Acceptance lands in the
-        ``engine/spec_*`` gauges.
+        .py``). Requires ``backend: paged``, ``model.draft_model_path``,
+        and per-row RNG (always on under continuous batching). Composes
+        with the in-place kernels: under ``decode_kernel: pallas`` the
+        verify forward runs the multi-position Pallas verify kernel
+        (``ops/paged_attention.py::paged_verify_attention``), and under
+        ``prefill_kernel: pallas`` spec refills keep the zero-copy paged
+        prefill — ``engine/spec_verify_kernel_pallas`` stamps which
+        verify compute ran. Acceptance lands in the ``engine/spec_*``
+        gauges.
     """
 
     backend: str = "dense"

@@ -152,6 +152,10 @@ class EngineStats:
     spec_live_rounds: int = 0
     spec_accepted: int = 0
     spec_committed: int = 0
+    # spec verify compute path: True = in-place multi-position verify
+    # kernel (engine.decode_kernel: pallas with engine.speculative),
+    # False = the gather → shared round → scatter reference
+    spec_verify_kernel_pallas: bool = False
     # harvest-side generation canary (observability/health.py gen_canary):
     # per-sequence generated lengths, and adjacent repeated-token pairs —
     # the cheap on-harvest signal for degenerate looping generations
@@ -295,6 +299,11 @@ class EngineStats:
             stats["engine/spec_acceptance_rate"] = self.spec_acceptance_rate
             stats["engine/spec_tokens_per_round"] = self.spec_tokens_per_round
             stats["rollout/spec_rounds"] = float(self.spec_rounds)
+            # which verify compute the rounds ran — same contract as the
+            # decode/prefill kernel gauges above
+            stats["engine/spec_verify_kernel_pallas"] = float(
+                self.spec_verify_kernel_pallas
+            )
         return stats
 
 
@@ -541,6 +550,11 @@ class ContinuousEngine(Engine):
                 getattr(fns, "prefill_kernel", "xla") == "pallas"
                 and has_pallas_tpu()
             )
+            self.stats.spec_verify_kernel_pallas = bool(
+                self._gamma
+                and getattr(fns, "decode_kernel", "xla") == "pallas"
+                and has_pallas_tpu()
+            )
             self._block_bytes = block_bytes(self.state.cache)
             # per-cache-column bytes (all layers, k+v): the unit of the
             # analytic refill gather/scatter accounting
@@ -614,6 +628,7 @@ class ContinuousEngine(Engine):
             kv_blocks_total=self.stats.kv_blocks_total,
             decode_kernel_pallas=self.stats.decode_kernel_pallas,
             prefill_kernel_pallas=self.stats.prefill_kernel_pallas,
+            spec_verify_kernel_pallas=self.stats.spec_verify_kernel_pallas,
             spec_gamma=self._gamma,
         )
         if self._gamma:

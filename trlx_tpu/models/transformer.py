@@ -604,20 +604,27 @@ class Attention(nn.Module):
                     q[:, 0], k_pool, v_pool, table, attention_bias[:, :, 0, :]
                 ).reshape(B, 1, H * D)
             else:
-                # prefill chunk: all rows share one static span [ci, ci+T)
-                # (the refill/chunk programs group rows per span), so the
-                # commit columns are a [T] vector broadcast over rows —
-                # every row writes its own table's blocks, shared prefix
-                # blocks sit strictly below ci and are only ever read
-                if ci.ndim != 0:
-                    raise ValueError(
-                        "paged in-place prefill takes a scalar cache_index "
-                        "(rows in one chunk program share the static span; "
-                        "per-row depths are a decode-path concept)"
-                    )
-                cols = ci + jnp.arange(T)  # [T]
-                blk = table[:, cols // blk_size]  # [B, T]
-                off = jnp.broadcast_to((cols % blk_size)[None, :], blk.shape)
+                # multi-position span. Two callers land here:
+                #   * prefill chunk — all rows share one static span
+                #     [ci, ci+T) (the refill/chunk programs group rows per
+                #     span), so ci is a scalar and the commit columns are a
+                #     [T] vector broadcast over rows;
+                #   * speculative verify — the target scores gamma+1 probe
+                #     positions per row at per-row depths (rows rewind to
+                #     different accepted lengths), so ci is a [B] vector and
+                #     each row writes its own [T] column window.
+                # Either way every row writes through its own table's
+                # blocks; shared prefix blocks sit strictly below ci and
+                # are only ever read.
+                verify = ci.ndim != 0
+                if verify:
+                    cols = ci[:, None] + jnp.arange(T)[None, :]  # [B, T]
+                    blk = jnp.take_along_axis(table, cols // blk_size, axis=1)
+                    off = cols % blk_size
+                else:
+                    cols = ci + jnp.arange(T)  # [T]
+                    blk = table[:, cols // blk_size]  # [B, T]
+                    off = jnp.broadcast_to((cols % blk_size)[None, :], blk.shape)
                 k_pool = cache["k"].at[blk, off].set(
                     k.astype(cache["k"].dtype), mode="drop"
                 )
@@ -625,11 +632,22 @@ class Attention(nn.Module):
                     v.astype(cache["v"].dtype), mode="drop"
                 )
                 new_cache = {"k": k_pool, "v": v_pool, "block_table": table}
-                from trlx_tpu.ops.paged_prefill import paged_prefill_attention
+                if verify:
+                    from trlx_tpu.ops.paged_attention import (
+                        paged_verify_attention,
+                    )
 
-                out = paged_prefill_attention(
-                    q, k_pool, v_pool, table, attention_bias
-                ).reshape(B, T, H * D)
+                    out = paged_verify_attention(
+                        q, k_pool, v_pool, table, attention_bias
+                    ).reshape(B, T, H * D)
+                else:
+                    from trlx_tpu.ops.paged_prefill import (
+                        paged_prefill_attention,
+                    )
+
+                    out = paged_prefill_attention(
+                        q, k_pool, v_pool, table, attention_bias
+                    ).reshape(B, T, H * D)
             out = _dense(cfg, cfg.hidden_size, cfg.attn_bias, ("joined_kv", "embed"), "o_proj")(out)
             return out, new_cache
 

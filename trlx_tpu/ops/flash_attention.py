@@ -35,8 +35,8 @@ from jax.experimental import pallas as pl
 from trlx_tpu.ops.pallas_utils import (  # noqa: F401  (NEG_INF/LANES re-export)
     LANES,
     NEG_INF,
-    default_interpret as _default_interpret,
     pad_to as _pad_to,
+    resolve_interpret as _resolve_interpret,
     smem_spec as _smem_spec,
 )
 
@@ -456,8 +456,7 @@ def flash_attention_bwd_chunk(
     (``trlx_tpu/parallel/ring_attention.py``). One fused kernel call
     produces all three grads.
     """
-    if interpret is None:
-        interpret = _default_interpret()
+    interpret = _resolve_interpret(interpret)
     B, T, H, D = q.shape
     S = k.shape[1]
     if sm_scale is None:
@@ -537,8 +536,7 @@ def flash_attention(
     its own VJP over whole ring sweeps rather than differentiating per-chunk
     (out, lse) pairs.
     """
-    if interpret is None:
-        interpret = _default_interpret()
+    interpret = _resolve_interpret(interpret)
     B, T, H, D = q.shape
     S, KV = k.shape[1], k.shape[2]
     if H % KV:

@@ -58,7 +58,14 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from trlx_tpu.ops.pallas_utils import has_pallas_tpu, pltpu, resolve_interpret
+from trlx_tpu.ops.pallas_utils import (
+    align_rows,
+    clamp_block_table,
+    has_pallas_tpu,
+    pad_bias_to,
+    paged_pool_grid_spec,
+    resolve_interpret,
+)
 
 __all__ = [
     "paged_prefill_attention",
@@ -172,13 +179,9 @@ def paged_prefill_attention(
     interpret = resolve_interpret(interpret)
     S_pad = TB * bs
     # scratch rounded up for hardware tiling; the kernel reads [0:S] slices
-    S_align = S_pad if interpret else -(-S_pad // 128) * 128
-    bias_p = bias.astype(jnp.float32)
-    if bias_p.shape[3] < S_pad:
-        bias_p = jnp.pad(
-            bias_p, ((0, 0), (0, 0), (0, 0), (0, S_pad - bias_p.shape[3]))
-        )
-    tbl = jnp.minimum(block_table.astype(jnp.int32), NB - 1)
+    S_align = align_rows(S_pad, interpret)
+    bias_p = pad_bias_to(bias, S_pad)
+    tbl = clamp_block_table(block_table, NB)
 
     kernel = functools.partial(
         _paged_prefill_kernel,
@@ -188,24 +191,18 @@ def paged_prefill_attention(
         group=group,
         head_dim=D,
     )
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(B, TB),
-        in_specs=[
-            pl.BlockSpec((1, T, H, D), lambda b, j, tbl: (b, 0, 0, 0)),
-            pl.BlockSpec((1, HB, T, S_pad), lambda b, j, tbl: (b, 0, 0, 0)),
-            pl.BlockSpec(
-                (1, bs, KV, D), lambda b, j, tbl: (tbl[b, j], 0, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, bs, KV, D), lambda b, j, tbl: (tbl[b, j], 0, 0, 0)
-            ),
-        ],
-        out_specs=pl.BlockSpec((1, T, H, D), lambda b, j, tbl: (b, 0, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((S_align, KV, D), k_pool.dtype),
-            pltpu.VMEM((S_align, KV, D), v_pool.dtype),
-        ],
+    grid_spec = paged_pool_grid_spec(
+        batch=B,
+        table_blocks=TB,
+        block_size=bs,
+        kv_heads=KV,
+        head_dim=D,
+        q_block=(1, T, H, D),
+        bias_block=(1, HB, T, S_pad),
+        out_block=(1, T, H, D),
+        scratch_rows=S_align,
+        k_dtype=k_pool.dtype,
+        v_dtype=v_pool.dtype,
     )
     return pl.pallas_call(
         kernel,

@@ -43,6 +43,10 @@ __all__ = [
     "resolve_interpret",
     "smem_spec",
     "pad_to",
+    "align_rows",
+    "clamp_block_table",
+    "pad_bias_to",
+    "paged_pool_grid_spec",
 ]
 
 NEG_INF = -1e30
@@ -86,3 +90,92 @@ def pad_to(x: jax.Array, mult: int, axis: int) -> jax.Array:
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
     return jnp.pad(x, widths)
+
+
+def align_rows(n: int, interpret: bool, lanes: int = 128) -> int:
+    """Scratch/operand row count for a VMEM buffer: exact under the
+    interpreter, rounded up to the hardware lane multiple on chip. Kernels
+    read ``[0:n]`` slices either way, so alignment never changes bits."""
+    return n if interpret else -(-n // lanes) * lanes
+
+
+def clamp_block_table(block_table: jax.Array, num_blocks: int) -> jax.Array:
+    """Block-table ids as safe int32 fetch indices: out-of-range entries
+    (poisoned rows, frozen slots) clamp to the last pool block — their
+    lanes are bias-masked or their outputs dropped, so the clamped fetch
+    only has to be *legal*, never correct."""
+    import jax.numpy as jnp
+
+    return jnp.minimum(block_table.astype(jnp.int32), num_blocks - 1)
+
+
+def pad_bias_to(bias: jax.Array, width: int) -> jax.Array:
+    """Additive bias as the kernels consume it: f32, last (key) axis
+    zero-padded to exactly ``width`` (the block-table span). Padded columns
+    sit beyond ``seq_len`` and are never read by the compute slice."""
+    import jax.numpy as jnp
+
+    bias = bias.astype(jnp.float32)
+    short = width - bias.shape[-1]
+    if short <= 0:
+        return bias
+    widths = [(0, 0)] * (bias.ndim - 1) + [(0, short)]
+    return jnp.pad(bias, widths)
+
+
+def _row_block_spec(block) -> pl.BlockSpec:
+    """Per-row spec under the ``(b, j, tbl)`` paged grid: block ``b`` along
+    the leading (batch) axis, whole operand elsewhere."""
+    zeros = (0,) * (len(block) - 1)
+    return pl.BlockSpec(block, lambda b, j, tbl: (b,) + zeros)
+
+
+def paged_pool_grid_spec(
+    *,
+    batch: int,
+    table_blocks: int,
+    block_size: int,
+    kv_heads: int,
+    head_dim: int,
+    q_block,
+    bias_block,
+    out_block,
+    scratch_rows: int,
+    k_dtype,
+    v_dtype,
+):
+    """The shared scalar-prefetch grid for pool-reading kernels.
+
+    ``ops/paged_attention.py`` and ``ops/paged_prefill.py`` (and the verify
+    entry built on the latter) all walk the same ``(B, TB)`` grid in which
+    the scalar-prefetched block table *is* the K/V index map: grid cell
+    ``(b, j)`` fetches pool block ``tbl[b, j]`` into VMEM, and per-row
+    operands (q / bias / out) ride the batch axis. Factored here so the
+    fourth kernel doesn't carry the fourth copy of this boilerplate
+    (ISSUE 18) — the shape differences between decode (``q: (1, H, D)``)
+    and prefill (``q: (1, T, H, D)``) are entirely in the block tuples.
+    """
+    if not _HAS_PLTPU:  # pragma: no cover - callers gate on has_pallas_tpu
+        raise RuntimeError(
+            "paged_pool_grid_spec requires the Mosaic (pallas TPU) backend"
+        )
+    pool_block = (1, block_size, kv_heads, head_dim)
+
+    def pool_map(b, j, tbl):
+        return (tbl[b, j], 0, 0, 0)
+
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(batch, table_blocks),
+        in_specs=[
+            _row_block_spec(q_block),
+            _row_block_spec(bias_block),
+            pl.BlockSpec(pool_block, pool_map),
+            pl.BlockSpec(pool_block, pool_map),
+        ],
+        out_specs=_row_block_spec(out_block),
+        scratch_shapes=[
+            pltpu.VMEM((scratch_rows, kv_heads, head_dim), k_dtype),
+            pltpu.VMEM((scratch_rows, kv_heads, head_dim), v_dtype),
+        ],
+    )

@@ -255,10 +255,13 @@ def make_slot_refill_fns(
     a solo ``generate_speculative`` run with that row's key chain,
     regardless of batch composition or refills. Requires the paged backend
     (the verify writes flow through the block table with drop-mode
-    commits), the xla kernels (the segment is the gather-reference shape;
-    an in-place verify kernel would need the paged T>1 branch to take
-    per-row cache_index vectors), per-row RNG, plus ``draft_apply`` /
-    ``init_draft_cache_fn`` for the proposal model. ``transition_mask``
+    commits), per-row RNG, plus ``draft_apply`` / ``init_draft_cache_fn``
+    for the proposal model. Both kernel flavors compose: ``decode_kernel:
+    pallas`` runs the rounds in place — each verify forward commits its
+    ``G + 1`` probe columns through per-row (done-poisoned) block tables
+    and reads K/V via the multi-position verify kernel
+    (``ops/paged_attention.py::paged_verify_attention``) — while ``xla``
+    keeps the gather → rounds → scatter reference shape. ``transition_mask``
     (the trainer's logit mask) must be passed HERE rather than composed
     into ``adjust_logits``: the rounds apply it to draft proposals and
     target verify distributions separately, exactly like solo.
@@ -293,14 +296,6 @@ def make_slot_refill_fns(
                 "speculative decode segments require the paged KV backend "
                 "(engine.backend: paged) — the verify pass commits accepted "
                 "K/V through the block table with drop-mode writes"
-            )
-        if decode_kernel != "xla" or prefill_kernel != "xla":
-            raise ValueError(
-                "speculative decode segments run the gather-reference (xla) "
-                "programs; the in-place Pallas kernels take scalar or "
-                "uniform cache indices and cannot yet host the per-row "
-                "variable-advance verify — set engine.decode_kernel and "
-                "engine.prefill_kernel to 'xla' with engine.speculative"
             )
         if draft_apply is None or init_draft_cache_fn is None:
             raise ValueError(
@@ -542,14 +537,19 @@ def make_slot_refill_fns(
             the full-row scatter also zeroes any stale recycled-slot
             columns past ``P``). Both prefills use solo's ``S``-wide slot
             mask, so the refilled row's caches are bit-identical to a solo
-            run's post-prefill caches."""
+            run's post-prefill caches. ``prefill_kernel: pallas`` commits
+            the target suffix through the block table in place
+            (``ops/paged_prefill.py`` via the model's paged branch) —
+            same forward, no gather on entry, no scatter on exit."""
             t_params, d_params = params
             input_ids = input_ids.astype(jnp.int32)
             prompt_mask = prompt_mask.astype(jnp.int32)
             slot_mask_r = jnp.concatenate(
                 [prompt_mask, jnp.zeros((R, S - P), jnp.int32)], axis=1
             )
-            if hit > 0:
+            if prefill_kernel == "pallas":
+                row_cache = attach_block_table(state.cache.pool, table_rows)
+            elif hit > 0:
                 row_cache = gather_view(state.cache.pool, table_rows, S)
             else:
                 row_cache = init_cache_fn(R, S)
@@ -562,9 +562,13 @@ def make_slot_refill_fns(
                 cache_index=jnp.asarray(hit, jnp.int32),
                 logits_span=(0, 0),
             )
-            new_pool = scatter_span(
-                state.cache.pool, table_rows, t_out["cache"], hit, P - hit
-            )
+            if prefill_kernel == "pallas":
+                # the forward already committed [hit, P) through the table
+                new_pool = detach_block_table(t_out["cache"])
+            else:
+                new_pool = scatter_span(
+                    state.cache.pool, table_rows, t_out["cache"], hit, P - hit
+                )
             new_cache = PagedKV(
                 pool=new_pool,
                 block_table=state.cache.block_table.at[slot_idx].set(
@@ -879,6 +883,8 @@ def make_slot_refill_fns(
         Bit-identical to the gather path (tests/test_paged_attention.py,
         tests/test_engine.py)."""
         if G:
+            if decode_kernel == "pallas":
+                return _spec_decode_segment_paged_kernel(params, state)
             return _spec_decode_segment(params, state)
         if paged is not None and decode_kernel == "pallas":
             return _decode_segment_paged_kernel(params, state)
@@ -1002,6 +1008,110 @@ def make_slot_refill_fns(
         # same (state, live_steps, steps) contract as the plain segment,
         # in ROUND units (slot_utilization keeps its live/total meaning;
         # token-level throughput is the spec_* gauges' job)
+        return (
+            new_state,
+            final["live_rounds"] - state.live_rounds,
+            final["rounds"] - state.rounds,
+        )
+
+    def _spec_decode_segment_paged_kernel(params: Any, state: SpecState):
+        """The in-place twin of ``_spec_decode_segment``: the round body is
+        still :func:`trlx_tpu.ops.speculative.spec_round_step` — verbatim —
+        but the target cache threaded through it is the block pool with a
+        per-round done-poisoned table attached instead of a gathered dense
+        view, so each round's width-``G + 1`` verify forward reads K/V via
+        the multi-position verify kernel
+        (``ops/paged_attention.py::paged_verify_attention``, per-row probe
+        windows ``[c − 1, c + G)`` through ``models/transformer.py``'s
+        vector-``cache_index`` paged branch) and commits those columns with
+        drop-mode writes as it goes. No gather on entry, no
+        ``scatter_steps`` on exit.
+
+        Commit discipline vs the gather reference: the re-feed column
+        ``c − 1`` is re-written with identical bits (same token, same
+        position, same visible columns — the recompute the gather path's
+        scatter also re-commits); accepted/bonus columns carry the verify's
+        K/V; REJECTED probe columns are written in place where
+        ``scatter_steps`` would have dropped them, but they sit strictly
+        above every row's committed length, so slot-causal masking keeps
+        them invisible to every later read — the same stale-value
+        invariant recycled blocks already rely on. Rows that are done at a
+        round's START get their table rows poisoned out of range (their
+        blocks may already be recycled after harvest), exactly mirroring
+        ``_decode_segment_paged_kernel``'s per-step freeze masking. The
+        draft cache stays dense per slot — the draft never touches the
+        pool."""
+        t_params, d_params = params
+        table = state.cache.block_table
+        carry = {
+            "rng": state.rng,
+            "n_out": state.step,
+            "done": state.done,
+            "t_last": state.t_last,
+            # the carry holds the BARE pool (stable pytree across rounds);
+            # each round attaches a freshly poisoned table before the
+            # shared round body and strips it after
+            "t_cache": state.cache.pool,
+            "d_cache": state.d_cache,
+            "tokens": state.tokens,
+            "logprobs": state.logprobs,
+            "values": state.values,
+            "mask": state.mask,
+            "rounds": state.rounds,
+            "accepted": state.accepted,
+            "live_rounds": state.live_rounds,
+            "committed": state.committed,
+        }
+
+        def body(c):
+            cr, k = c
+            eff_table = jnp.where(
+                cr["done"][:, None], paged.max_blocks, table
+            )
+            cr = {
+                **cr,
+                "t_cache": attach_block_table(cr["t_cache"], eff_table),
+            }
+            cr = spec_round_step(
+                cr,
+                prompt_mask=state.prompt_mask,
+                target_apply=apply_fn,
+                target_params=t_params,
+                draft_apply=draft_apply,
+                draft_params=d_params,
+                config=config,
+                G=G,
+                transition_mask=transition_mask,
+                adjust_logits=adjust_logits,
+            )
+            cr = {**cr, "t_cache": detach_block_table(cr["t_cache"])}
+            return cr, k + 1
+
+        def cond(c):
+            cr, k = c
+            return (k < segment_len) & ~jnp.all(cr["done"])
+
+        final, _ = jax.lax.while_loop(
+            cond, body, (carry, jnp.asarray(0, jnp.int32))
+        )
+        new_state = SpecState(
+            tokens=final["tokens"],
+            logprobs=final["logprobs"],
+            values=final["values"],
+            mask=final["mask"],
+            prompt_mask=state.prompt_mask,
+            cache=PagedKV(final["t_cache"], table),
+            d_cache=final["d_cache"],
+            t_last=final["t_last"],
+            prompt_len=state.prompt_len,
+            done=final["done"],
+            step=final["n_out"],
+            rng=final["rng"],
+            rounds=final["rounds"],
+            accepted=final["accepted"],
+            live_rounds=final["live_rounds"],
+            committed=final["committed"],
+        )
         return (
             new_state,
             final["live_rounds"] - state.live_rounds,

@@ -70,6 +70,19 @@ PAGED_KERNEL_PROGRAMS = ("paged_refill", "paged_decode_kernel")
 # compiled programs per bucket beyond (spec refill, spec segment)".
 PAGED_SPEC_PROGRAMS = ("paged_spec_refill", "paged_spec_segment")
 
+# Speculative with the Pallas kernels (engine.decode_kernel /
+# prefill_kernel: pallas): the spec refill commits the target prompt
+# through the block table in place (ops/paged_prefill.py) and the spec
+# segment's verify forward runs the multi-position paged kernel
+# (ops/paged_attention.py::paged_verify_attention) — no per-round
+# gather/scatter of the pool exists in either program, and the budget
+# pair pins that the same way gpt2_test_paged_kernel does for plain
+# decode.
+PAGED_SPEC_KERNEL_PROGRAMS = (
+    "paged_spec_prefill_kernel",
+    "paged_spec_segment_kernel",
+)
+
 
 def _engine_programs(config: TRLConfig) -> Tuple[str, ...]:
     """The rollout programs ``train.continuous_batching`` adds, resolved
@@ -85,12 +98,25 @@ def _engine_programs(config: TRLConfig) -> Tuple[str, ...]:
     if not bool(getattr(config.train, "continuous_batching", False)):
         return ()
     if int(getattr(config.engine, "speculative", 0)):
-        # spec forces the xla kernels (the segment is the gather-reference
-        # shape), so the names never compose with the pallas variants
-        progs = ("paged_spec_refill",)
+        # spec composes with both kernel knobs: the refill prefills the
+        # target cache through the chosen prefill path (in place under
+        # prefill_kernel: pallas), and the segment's verify forward runs
+        # the multi-position paged kernel under decode_kernel: pallas
+        # (ops/paged_attention.py::paged_verify_attention)
+        refill = (
+            "paged_spec_prefill_kernel"
+            if config.engine.prefill_kernel == "pallas"
+            else "paged_spec_refill"
+        )
+        progs = (refill,)
         if int(getattr(config.engine, "prefill_chunk", 0)):
             progs = progs + ("paged_prefill_chunk",)
-        return progs + ("paged_spec_segment",)
+        segment = (
+            "paged_spec_segment_kernel"
+            if config.engine.decode_kernel == "pallas"
+            else "paged_spec_segment"
+        )
+        return progs + (segment,)
     if config.engine.backend == "paged":
         refill = (
             "paged_prefill_kernel"
@@ -317,6 +343,7 @@ def hot_program_costs(
             + PAGED_ENGINE_PROGRAMS
             + PAGED_KERNEL_PROGRAMS
             + PAGED_SPEC_PROGRAMS
+            + PAGED_SPEC_KERNEL_PROGRAMS
             + ("paged_prefill_kernel", "paged_prefill_chunk")
         )
         if any(p in programs for p in cb_all):
@@ -346,7 +373,7 @@ def hot_program_costs(
             eng_params = trainer._engine_params(params)
             refill_names = (
                 "cb_refill", "paged_refill", "paged_prefill_kernel",
-                "paged_spec_refill",
+                "paged_spec_refill", "paged_spec_prefill_kernel",
             )
             if any(p in programs for p in refill_names):
                 # the full-bucket (R = B) cold refill program: worst-case
@@ -361,9 +388,14 @@ def hot_program_costs(
                 ]
                 name = "cb_refill"
                 if fns.paged is not None:
+                    pk = getattr(fns, "prefill_kernel", "xla") == "pallas"
                     if getattr(fns, "speculative", 0):
-                        name = "paged_spec_refill"
-                    elif getattr(fns, "prefill_kernel", "xla") == "pallas":
+                        name = (
+                            "paged_spec_prefill_kernel"
+                            if pk
+                            else "paged_spec_refill"
+                        )
+                    elif pk:
                         name = "paged_prefill_kernel"
                     else:
                         name = "paged_refill"
@@ -395,11 +427,16 @@ def hot_program_costs(
                 or "paged_decode" in programs
                 or "paged_decode_kernel" in programs
                 or "paged_spec_segment" in programs
+                or "paged_spec_segment_kernel" in programs
             ):
                 if fns.paged is None:
                     name = "cb_segment"
                 elif getattr(fns, "speculative", 0):
-                    name = "paged_spec_segment"
+                    name = (
+                        "paged_spec_segment_kernel"
+                        if getattr(fns, "decode_kernel", "xla") == "pallas"
+                        else "paged_spec_segment"
+                    )
                 elif getattr(fns, "decode_kernel", "xla") == "pallas":
                     name = "paged_decode_kernel"
                 else:
@@ -613,6 +650,46 @@ def budget_configs() -> Dict[str, Tuple[TRLConfig, Dict[str, int]]]:
                     backend="paged", kv_block_size=8, prefix_cache=True,
                     speculative=4,
                 ),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "gpt2_test_spec_kernel": (
+            # speculative over the Pallas kernels (decode_kernel +
+            # prefill_kernel: pallas): the spec refill commits prompt K/V
+            # through the block table in place and the spec segment's
+            # verify forward is the multi-position paged kernel
+            # (paged_spec_prefill_kernel + paged_spec_segment_kernel).
+            # Paired with gpt2_test_spec, this is the standing
+            # program-level record that composing speculation with the
+            # in-place kernels deletes the per-round pool gather/scatter
+            # without adding programs per bucket.
+            base.evolve(
+                train=dict(continuous_batching=True),
+                model=dict(
+                    model_path="builtin:gpt2-test", num_layers_unfrozen=1,
+                    draft_model_path="builtin:gpt2-test", draft_gamma=4,
+                ),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+                engine=dict(
+                    backend="paged", kv_block_size=8, prefix_cache=True,
+                    speculative=4, decode_kernel="pallas",
+                    prefill_kernel="pallas",
+                ),
+            ),
+            dict(batch_size=8, prompt_len=32, gen_len=16),
+        ),
+        "gpt2_test_loss_kernel": (
+            # the fused learner-step kernel (method.loss_kernel: pallas):
+            # train_step compiles with GAE + whitening + the clipped
+            # losses as ONE fused program (ops/fused_loss.py) instead of
+            # the staged chain. Paired with gpt2_test, this budget is the
+            # standing record of the fused program's compiled cost — a
+            # regression that splits the fusion back into staged [B, R]
+            # HBM round-trips shows up as a bytes/temp jump here.
+            base.evolve(
+                model=dict(model_path="builtin:gpt2-test", num_layers_unfrozen=1),
+                tokenizer=dict(tokenizer_path="builtin:bytes"),
+                method=dict(loss_kernel="pallas"),
             ),
             dict(batch_size=8, prompt_len=32, gen_len=16),
         ),

@@ -226,17 +226,23 @@ class TPUBaseTrainer(BaseRLTrainer):
                     "the verify pass commits accepted K/V through the "
                     "block table with drop-mode writes"
                 )
-            if (
-                config.engine.decode_kernel != "xla"
-                or config.engine.prefill_kernel != "xla"
-            ):
-                raise ValueError(
-                    "engine.speculative requires engine.decode_kernel: xla "
-                    "and engine.prefill_kernel: xla — the spec segment is "
-                    "the gather → shared round (ops/speculative.py) → "
-                    "scatter program; the in-place Pallas kernels have no "
-                    "multi-token verify path yet"
-                )
+            # NOTE: no decode_kernel restriction — the spec segment's verify
+            # pass runs the multi-position paged kernel in place
+            # (ops/paged_attention.py::paged_verify_attention), so
+            # engine.speculative composes with decode_kernel: pallas
+        lk = str(getattr(config.method, "loss_kernel", "xla"))
+        if lk not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown method.loss_kernel '{lk}' (xla | pallas)"
+            )
+        hostable = getattr(type(config.method), "LOSS_KERNELS", ("xla",))
+        if lk == "pallas" and "pallas" not in hostable:
+            raise ValueError(
+                f"method.loss_kernel: pallas is the fused GAE + whitening + "
+                f"clipped-loss learner kernel (ops/fused_loss.py) — "
+                f"{type(config.method).__name__} has no GAE/value-head loss "
+                f"to fuse (hostable kernels: {list(hostable)})"
+            )
         self.mesh = make_mesh(config.parallel)
         set_global_mesh(self.mesh)  # model code reads this for sequence-parallel ops
         # NOTE: the global mesh is process-wide; entry points re-assert it so

@@ -40,6 +40,7 @@ from trlx_tpu.observability.dynamics import (
     sketch_np,
 )
 from trlx_tpu.models.transformer import CausalTransformer
+from trlx_tpu.ops.pallas_utils import has_pallas_tpu
 from trlx_tpu.ops.sampling import GenerationOutput
 from trlx_tpu.parallel import shard_batch
 from trlx_tpu.pipeline import BasePipeline
@@ -1309,9 +1310,46 @@ class PPOTrainer(TPUBaseTrainer):
         old_values = batch["values"]
         rewards = batch["rewards"]
 
-        advantages, returns = method.get_advantages_and_returns(
-            old_values, rewards, response_mask
-        )
+        # method.loss_kernel: pallas routes through the fused learner kernel
+        # (ops/fused_loss.py): GAE + whitening + clipped loss in ONE program,
+        # so get_advantages_and_returns moves inside the kernel and the
+        # trainer hands it raw rewards instead of precomputed targets. The
+        # XLA path below stays the bit-parity reference.
+        use_fused = getattr(method, "loss_kernel", "xla") == "pallas"
+        if not use_fused:
+            advantages, returns = method.get_advantages_and_returns(
+                old_values, rewards, response_mask
+            )
+
+        def method_loss(logprobs, values_pred):
+            if use_fused:
+                loss, stats = method.loss_fused(
+                    logprobs=logprobs,
+                    values=values_pred,
+                    old_logprobs=old_logprobs,
+                    old_values=old_values,
+                    rewards=rewards,
+                    mask=response_mask,
+                    behavior_logprobs=batch.get("behavior_logprobs"),
+                )
+                # observability: 1.0 only when the Mosaic (pallas TPU)
+                # backend is importable — a Mosaic-less build's staged
+                # fallback reports 0, so an artifact can't claim a kernel
+                # it never ran
+                stats["train/loss_kernel_pallas"] = jnp.asarray(
+                    float(has_pallas_tpu()), jnp.float32
+                )
+                return loss, stats
+            return method.loss(
+                logprobs=logprobs,
+                values=values_pred,
+                old_logprobs=old_logprobs,
+                old_values=old_values,
+                advantages=advantages,
+                returns=returns,
+                mask=response_mask,
+                behavior_logprobs=batch.get("behavior_logprobs"),
+            )
 
         if self.is_seq2seq:
             B = queries.shape[0]
@@ -1331,16 +1369,7 @@ class PPOTrainer(TPUBaseTrainer):
             )
             logprobs = logprobs_of_labels(out["logits"], responses)
             values_pred = out["value"]
-            loss, stats = method.loss(
-                logprobs=logprobs,
-                values=values_pred,
-                old_logprobs=old_logprobs,
-                old_values=old_values,
-                advantages=advantages,
-                returns=returns,
-                mask=response_mask,
-                behavior_logprobs=batch.get("behavior_logprobs"),
-            )
+            loss, stats = method_loss(logprobs, values_pred)
             if method.dist_sketches:
                 # entropy needs the full logits the method's loss never
                 # sees — sketch it here while [B, R, V] is still live
@@ -1362,16 +1391,7 @@ class PPOTrainer(TPUBaseTrainer):
         logprobs = logprobs_of_labels(out["logits"], responses)
         values_pred = out["value"][:, Q - 1 : Q + R - 1]
 
-        loss, stats = method.loss(
-            logprobs=logprobs,
-            values=values_pred,
-            old_logprobs=old_logprobs,
-            old_values=old_values,
-            advantages=advantages,
-            returns=returns,
-            mask=response_mask,
-            behavior_logprobs=batch.get("behavior_logprobs"),
-        )
+        loss, stats = method_loss(logprobs, values_pred)
         if method.dist_sketches:
             # entropy needs the full logits the method's loss never sees —
             # sketch it here while the [B, R, V] span is still live
