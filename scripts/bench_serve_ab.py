@@ -1,0 +1,270 @@
+"""A/B: serving-frontend value claims on a CPU-scale engine workload
+(docs/SERVING.md) — writes ``benchmarks/SERVE_cpu.json``.
+
+Two arms, both on the paged ContinuousEngine with the prefix cache:
+
+1. **Host-RAM KV tiering** — a repeat-prompt workload whose working set
+   does not fit the device prefix cache (two prompts alternating through a
+   one-prompt cache). With the tier, evicted chains spill host-side and
+   re-land on resubmission instead of re-prefilling; without it every
+   round pays the full prefill. Measures per-request latency (the
+   engine-level TTFT for sequential single requests) and prompt tokens
+   actually prefilled.
+
+2. **Priority scheduling** — a saturating batch ("actor"-class) flood with
+   interleaved foreground requests. With priority scheduling the
+   foreground rides the interactive class (best-class-first admission +
+   preemption of still-prefilling batch slots + a reserved slot); without
+   it the same requests queue FIFO behind the flood. Measures foreground
+   TTFT p50/p95 through the real ServeServer pump.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/bench_serve_ab.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+_EOS = 3
+_PAD = 258
+
+# arm 1 geometry: long prompts make the prefill the dominant cost so the
+# re-land vs re-prefill delta is measurable on CPU
+T_P, T_N, T_BS = 64, 8, 8
+T_ROUNDS = int(os.environ.get("BENCH_SERVE_ROUNDS", 6))
+
+# arm 2 geometry: chunked prefill keeps batch slots preemptable
+P_P, P_N, P_CHUNK = 32, 16, 8
+P_BACKGROUND = 10
+P_FOREGROUND = 5
+
+
+def _gen_config(max_new):
+    from trlx_tpu.ops.sampling import GenerationConfig
+
+    return GenerationConfig(
+        max_new_tokens=max_new, eos_token_id=_EOS, pad_token_id=_PAD,
+        min_new_tokens=max_new, per_row_rng=True,
+    )
+
+
+def _build_fns(tiny_lm, B, P, max_new, segment_len):
+    from trlx_tpu.models.transformer import make_kv_cache
+    from trlx_tpu.ops.paged_kv import PagedSpec, num_table_blocks
+    from trlx_tpu.ops.slot_refill import make_slot_refill_fns
+
+    apply_fn, params, tcfg = tiny_lm
+    paged = PagedSpec(
+        block_size=T_BS,
+        max_blocks=1 + 2 * B * num_table_blocks(P + max_new, T_BS) + 8,
+    )
+    return make_slot_refill_fns(
+        apply_fn, lambda b, s: make_kv_cache(tcfg, b, s), B, P,
+        _gen_config(max_new), segment_len=segment_len,
+        params_example=params, paged=paged,
+    ), params
+
+
+def _tiny_lm():
+    from trlx_tpu.data.configs import ModelConfig
+    from trlx_tpu.models.builder import build_causal_lm
+
+    module, params, tcfg = build_causal_lm(
+        ModelConfig(model_path="builtin:gpt2-test"), head="value"
+    )
+
+    def apply_fn(p, ids, **kw):
+        return module.apply({"params": p}, ids, **kw)
+
+    return apply_fn, params, tcfg
+
+
+def _keys(seed):
+    import jax
+
+    from trlx_tpu.ops.sampling import per_row_keys
+
+    return np.asarray(per_row_keys(jax.random.PRNGKey(seed), 1))
+
+
+def _prompt(seed, P):
+    rs = np.random.RandomState(seed)
+    ids = rs.randint(0, 200, (P,)).astype(np.int32)
+    return ids, np.ones_like(ids)
+
+
+def run_tiering_arm(tiny_lm, fns, params, tiered):
+    """Sequential single-request sweep alternating two prompts through a
+    one-prompt device prefix cache; returns latency + prefill accounting."""
+    from trlx_tpu.engine.core import ContinuousEngine
+    from trlx_tpu.serve.tiering import HostTier
+
+    n_full = (T_P - 1) // T_BS
+    engine = ContinuousEngine(
+        fns, params, _PAD, prefix_cache=True, prefix_capacity_blocks=n_full
+    )
+    if tiered:
+        engine.attach_host_tier(HostTier(max_blocks=256))
+    prompts = [_prompt(s, T_P) for s in (1, 2)]
+    latencies = []
+    # two warmup rounds: round 0 compiles prefill/decode, round 1 is the
+    # first to re-land from the tier (compiles the scatter); steady state
+    # starts at round 2
+    for r in range(T_ROUNDS + 2):
+        for i, (ids, mask) in enumerate(prompts):
+            t0 = time.perf_counter()
+            engine.enqueue_prompts(ids[None], mask[None], _keys(10 + i))
+            while engine.busy:
+                engine.step()
+            if r > 1:
+                latencies.append(time.perf_counter() - t0)
+    lat = np.asarray(latencies)
+    return {
+        "request_latency_mean_s": round(float(lat.mean()), 4),
+        "request_latency_p95_s": round(float(np.percentile(lat, 95)), 4),
+        "prefill_tokens": int(engine.stats.prefill_tokens),
+        "host_tier_tokens_saved": int(engine.stats.host_tier_tokens_saved),
+        "host_tier_relanded_blocks": int(engine.stats.host_tier_hit_blocks),
+    }
+
+
+def run_priority_arm(tiny_lm, fns, params, priority):
+    """Foreground requests against a saturating batch flood through the
+    real ServeServer pump; returns foreground TTFT percentiles."""
+    from trlx_tpu.engine.core import ContinuousEngine
+    from trlx_tpu.serve.server import ServeServer
+
+    engine = ContinuousEngine(
+        fns, params, _PAD, prefix_cache=False, prefill_chunk=P_CHUNK
+    )
+    if priority:
+        engine.reserve_slots = 1
+    srv = ServeServer(engine, max_queue=256)
+    srv.start()
+    try:
+        ids, mask = _prompt(3, P_P)
+        # warmup: compile prefill/decode before any timing
+        req, _ = srv.submit(ids, mask, seed=0, klass="interactive")
+        assert req.wait_done(300) == "DONE"
+        background = []
+        for i in range(P_BACKGROUND):
+            bids, bmask = _prompt(20 + i, P_P)
+            r, rej = srv.submit(bids, bmask, seed=30 + i, klass="actor")
+            assert rej is None
+            background.append(r)
+        fg_klass = "interactive" if priority else "actor"
+        ttfts = []
+        for i in range(P_FOREGROUND):
+            fids, fmask = _prompt(50 + i, P_P)
+            r, rej = srv.submit(fids, fmask, seed=60 + i, klass=fg_klass)
+            assert rej is None
+            assert r.wait_done(300) == "DONE"
+            ttfts.append(r.snapshot()["ttft_s"])
+        for r in background:
+            assert r.wait_done(300) == "DONE"
+        t = np.asarray(ttfts)
+        return {
+            "foreground_ttft_p50_s": round(float(np.percentile(t, 50)), 4),
+            "foreground_ttft_p95_s": round(float(np.percentile(t, 95)), 4),
+            "preempted_rows": int(engine.stats.preempted_rows),
+            "foreground_class": fg_klass,
+        }
+    finally:
+        srv.close()
+
+
+def main():
+    t0 = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    tiny_lm = _tiny_lm()
+
+    tier_fns, params = _build_fns(tiny_lm, B=2, P=T_P, max_new=T_N, segment_len=4)
+    untiered = run_tiering_arm(tiny_lm, tier_fns, params, tiered=False)
+    tiered = run_tiering_arm(tiny_lm, tier_fns, params, tiered=True)
+
+    prio_fns, params = _build_fns(tiny_lm, B=2, P=P_P, max_new=P_N, segment_len=4)
+    fifo = run_priority_arm(tiny_lm, prio_fns, params, priority=False)
+    prioritized = run_priority_arm(tiny_lm, prio_fns, params, priority=True)
+
+    from trlx_tpu.benchmark import provenance
+
+    artifact = {
+        "benchmark": "serving frontend A/B (paged engine, gpt2-test, CPU)",
+        "timestamp": t0,
+        "provenance": provenance(),
+        "tiering": {
+            "workload": {
+                "prompt_len": T_P, "max_new_tokens": T_N, "block_size": T_BS,
+                "distinct_prompts": 2, "device_prefix_capacity_blocks":
+                (T_P - 1) // T_BS, "timed_rounds": T_ROUNDS,
+            },
+            "re_prefill": untiered,
+            "host_tier_reland": tiered,
+            "latency_speedup": round(
+                untiered["request_latency_mean_s"]
+                / tiered["request_latency_mean_s"], 3,
+            ),
+        },
+        "priority": {
+            "workload": {
+                "prompt_len": P_P, "max_new_tokens": P_N,
+                "prefill_chunk": P_CHUNK, "slots": 2,
+                "background_requests": P_BACKGROUND,
+                "foreground_requests": P_FOREGROUND,
+            },
+            "fifo": fifo,
+            "priority_scheduling": prioritized,
+            "ttft_p95_speedup": round(
+                fifo["foreground_ttft_p95_s"]
+                / prioritized["foreground_ttft_p95_s"], 3,
+            ),
+        },
+        "definitions": {
+            "request_latency": "enqueue → harvest for sequential "
+            "single-request submissions (engine-level TTFT proxy: the full "
+            "response IS the first deliverable unit here)",
+            "foreground_ttft": "submit → first token (serve-request "
+            "snapshot ttft_s) for the foreground requests, measured "
+            "through the ServeServer pump thread",
+            "host_tier_tokens_saved": "prompt columns re-landed from host "
+            "RAM instead of re-prefilled",
+        },
+        "caveats": [
+            "CPU-scale (builtin:gpt2-test): the micro-model's prefill is "
+            "dispatch-bound, not compute-bound, so the tiering arm's "
+            "latency claim is bounded at parity here — the geometry-true "
+            "claim is the prefill-token accounting (the columns a real "
+            "model would NOT recompute). The priority arm's TTFT ratio is "
+            "scheduling-structural and transfers directly.",
+            "The tiering arm's device prefix cache is deliberately sized "
+            "to one prompt's chain so a two-prompt working set always "
+            "evicts — the adversarial case for re-prefill, the designed "
+            "case for the host tier.",
+            "The FIFO arm submits the same foreground prompts as class "
+            "'actor' (admission + engine FIFO within one class); the "
+            "priority arm submits them as 'interactive' with one reserved "
+            "slot and preemption of still-prefilling batch slots.",
+        ],
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "SERVE_cpu.json",
+    )
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(json.dumps(artifact, indent=2))
+    print(f"\nwrote {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

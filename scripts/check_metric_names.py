@@ -27,6 +27,7 @@ from trlx_tpu.analysis.conventions import (  # noqa: E402,F401
     LEGACY_KEYS,
     OBS_KEYS,
     RESILIENCE_KEYS,
+    SERVE_KEYS,
     _CONVENTION_RE,
     _KEY_RE,
     find_violations as _find_violations,

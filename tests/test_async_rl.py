@@ -333,6 +333,7 @@ def test_engine_version_counter_memoization():
     engine.prefix = None
     engine.spec = None
     engine.allocator = None
+    engine.host_tier = None
     params_a, params_b = {"w": 1}, {"w": 2}
     engine.params = params_a
     engine._kv_params = params_a

@@ -253,6 +253,10 @@ class TestEngineStateMachine:
             "rollout/refilled_rows",
             "rollout/segments",
             "engine/queue_wait_s",
+            # per-request queue-wait percentiles (docs/SERVING.md): the
+            # admission-control view of the same samples
+            "engine/queue_wait_p50",
+            "engine/queue_wait_p95",
             # the dense engine now reports its KV allocation too
             # (docs/PERFORMANCE.md; engine/* gauges are paged-only)
             "memory/kv_cache_bytes",

@@ -132,6 +132,33 @@ def test_engine_keys_registered_and_namespaced():
     assert missing == set(), f"engine keys not seen by the scanner: {missing}"
 
 
+def test_serve_keys_registered_and_namespaced():
+    """Every canonical serve/* key (docs/SERVING.md) is registered in the
+    checker, follows the namespace/name convention, and is visible to the
+    static scanner — they are all literal sites in serve/metrics.py (the
+    per-tenant/per-class breakdowns are deliberately off-registry: they
+    live under ``detail_metrics()``, not the flat step stats)."""
+    checker = _load_checker()
+    assert checker.SERVE_KEYS, "serve key registry is empty"
+    for key in checker.SERVE_KEYS:
+        assert checker._CONVENTION_RE.match(key), key
+    keys = checker.scanned_keys()
+    missing = {k for k in checker.SERVE_KEYS if k not in keys}
+    assert missing == set(), f"serve keys not seen by the scanner: {missing}"
+    # the SLO headline gauges and the serving-specific engine extensions
+    assert {
+        "serve/ttft_p95",
+        "serve/tpot_p95",
+        "serve/queue_wait_p95",
+        "serve/rejected",
+        "serve/host_tier_relanded",
+        "engine/queue_wait_p95",
+        "engine/preempted_rows",
+        "engine/host_tier_hit_blocks",
+        "engine/host_tier_tokens_saved",
+    } <= set(keys)
+
+
 def test_resilience_keys_registered_and_namespaced():
     """Every canonical resilience/* key (docs/RESILIENCE.md) is registered
     in the checker and follows the namespace/name convention — including

@@ -141,6 +141,46 @@ ENGINE_KEYS = frozenset({
     # 0, so an artifact can't claim kernel=1 it never ran
     # (docs/PERFORMANCE.md "Fused learner kernels")
     "train/loss_kernel_pallas",
+    # serving extensions on the engine (docs/SERVING.md): per-request
+    # queue-wait percentiles from the enqueue→prefill spans, priority-
+    # preemption count, and the host-tier re-land accounting (blocks
+    # written back device-side instead of re-prefilled, and the prefill
+    # tokens that saved)
+    "engine/queue_wait_p50",
+    "engine/queue_wait_p95",
+    "engine/preempted_rows",
+    "engine/host_tier_hit_blocks",
+    "engine/host_tier_tokens_saved",
+})
+
+# Canonical serving-frontend keys (trlx_tpu/serve/, docs/SERVING.md): the
+# FLAT aggregate gauges ServeMetrics.metrics() emits into the training
+# metric stream — TTFT/TPOT/queue-wait percentiles over all serve traffic,
+# admission counters (SLO 429s, drain 503s, flood-drill sheds), terminal
+# counts, and the host-tier occupancy counters. Per-tenant/per-class
+# breakdowns deliberately stay OFF this registry (unbounded cardinality)
+# and live on the HTTP /metrics endpoint instead. All literal stats[...]
+# sites in serve/metrics.py.
+SERVE_KEYS = frozenset({
+    "serve/ttft_p50",
+    "serve/ttft_p95",
+    "serve/tpot_p50",
+    "serve/tpot_p95",
+    "serve/queue_wait_p50",
+    "serve/queue_wait_p95",
+    "serve/admitted",
+    "serve/rejected",
+    "serve/drain_rejected",
+    "serve/flood_rejected",
+    "serve/completed",
+    "serve/failed",
+    "serve/dropped",
+    "serve/active",
+    "serve/streamed_tokens",
+    "serve/host_tier_blocks",
+    "serve/host_tier_spilled",
+    "serve/host_tier_relanded",
+    "serve/params_version",
 })
 
 # Canonical cross-rank telemetry gauges (observability/distributed.py,

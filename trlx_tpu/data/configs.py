@@ -447,6 +447,77 @@ class AsyncRLConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Serving-frontend knobs (``trlx_tpu/serve/``, docs/SERVING.md).
+
+    Puts an HTTP streaming frontend with SLO-aware admission, priority
+    scheduling, multi-tenant prefix isolation, and host-RAM KV tiering in
+    front of a :class:`~trlx_tpu.engine.core.ContinuousEngine` — including
+    serve-while-training: PPO's ``learn()`` serves interactive requests
+    between optimizer steps off the freshly published params.
+
+    :param enabled: stand up the serving frontend inside ``learn()``.
+        Requires ``engine.backend: paged`` + ``train.continuous_batching``
+        (streaming snapshots and segment-boundary preemption are
+        block-table operations).
+    :param host: HTTP bind interface; default loopback.
+    :param port: HTTP port (0 = ephemeral; read it back from
+        ``ServeServer.port``).
+    :param slots: serving-engine slot batch (its compiled width is
+        independent of the collection engines').
+    :param max_new_tokens: serving-engine decode budget per request.
+    :param default_tenant: prefix-cache namespace + quota identity for
+        requests that don't name one.
+    :param default_class: priority class for requests that don't name one
+        (``interactive`` | ``eval`` | ``actor``; engine ``SERVE_CLASSES``).
+    :param slo_interactive_s / slo_eval_s / slo_actor_s: per-class
+        queue-wait SLOs in seconds (0 = no admission gate for that class).
+        Admission rejects with 429 + Retry-After only when the EWMA
+        service-time model *proves* the SLO blown for a new arrival.
+    :param max_queue: hard admitted-but-unfinished depth cap (memory
+        bound; rejections past it are 429s regardless of SLO evidence).
+    :param reserve_slots: engine slots only interactive traffic may take
+        when the batch classes have the rest saturated.
+    :param stream_buffer: per-request undelivered-delta bound — a consumer
+        stalled past it is dropped (the engine slot keeps decoding;
+        ``slow_client@request:N``, docs/RESILIENCE.md).
+    :param drain_timeout_s: graceful-drain window on shutdown/SIGTERM —
+        new admissions 503 immediately, in-flight requests get this long
+        to finish before being failed.
+    :param host_tier_blocks: host-RAM KV tier capacity in blocks (0 =
+        tiering off): evicted prefix-cache blocks spill host-side and
+        re-land device-side instead of re-prefilling (bit-identical by
+        construction; ``serve/tiering.py``).
+    :param tenant_quota_blocks: per-tenant KV block budgets
+        (``{"team-a": 64}``); an allocation past the quota evicts only
+        that tenant's prefix entries, then fails only that request.
+    :param retain_param_versions: keep the newest N published param trees
+        for ``ServeServer.params_for_version`` — the serve-while-training
+        bit-equality probe's reference (0 = keep none).
+    """
+
+    enabled: bool = False
+    host: str = "127.0.0.1"
+    port: int = 0
+    slots: int = 2
+    max_new_tokens: int = 16
+    default_tenant: str = "default"
+    default_class: str = "interactive"
+    slo_interactive_s: float = 0.0
+    slo_eval_s: float = 0.0
+    slo_actor_s: float = 0.0
+    max_queue: int = 64
+    reserve_slots: int = 0
+    stream_buffer: int = 64
+    drain_timeout_s: float = 5.0
+    host_tier_blocks: int = 0
+    tenant_quota_blocks: Dict[str, int] = field(default_factory=dict)
+    retain_param_versions: int = 0
+
+    from_dict = classmethod(_strict_from_dict)
+
+
+@dataclass
 class TrainConfig:
     """Run-level knobs for the shared learn loop
     (reference: ``trlx/data/configs.py:142-230``)."""
@@ -554,6 +625,7 @@ class TRLConfig:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
     async_rl: AsyncRLConfig = field(default_factory=AsyncRLConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     @classmethod
     def load_yaml(cls, yml_fp: str) -> "TRLConfig":
@@ -581,6 +653,7 @@ class TRLConfig:
             "resilience": asdict(self.resilience),
             "engine": asdict(self.engine),
             "async_rl": asdict(self.async_rl),
+            "serve": asdict(self.serve),
         })
 
     @classmethod
@@ -596,6 +669,7 @@ class TRLConfig:
             resilience=ResilienceConfig.from_dict(config.get("resilience", {})),
             engine=EngineConfig.from_dict(config.get("engine", {})),
             async_rl=AsyncRLConfig.from_dict(config.get("async_rl", {})),
+            serve=ServeConfig.from_dict(config.get("serve", {})),
         )
 
     def evolve(self, **kwargs) -> "TRLConfig":

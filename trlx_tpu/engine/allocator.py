@@ -22,17 +22,25 @@ it on every exit — including exception paths — or transfer ownership
 """
 
 from collections import deque
-from typing import Deque, Dict, Iterable, List
+from typing import Deque, Dict, Iterable, List, Optional
 
 from trlx_tpu.ops.paged_kv import ZERO_BLOCK
 
-__all__ = ["BlockPoolExhausted", "BlockAllocator"]
+__all__ = ["BlockPoolExhausted", "TenantQuotaExceeded", "BlockAllocator"]
 
 
 class BlockPoolExhausted(RuntimeError):
     """Raised when an allocation cannot be satisfied even after the caller
     evicted everything evictable — ``engine.max_kv_blocks`` is too small
     for the live working set."""
+
+
+class TenantQuotaExceeded(RuntimeError):
+    """Raised when an allocation would push a quota'd tenant past its
+    per-tenant block budget (``serve.tenant_quota_blocks``). Deliberately
+    NOT a :class:`BlockPoolExhausted`: the pool may have plenty of free
+    blocks — the remedy is evicting THIS tenant's prefix entries (or
+    failing the request), never global eviction."""
 
 
 class BlockAllocator:
@@ -53,6 +61,15 @@ class BlockAllocator:
         )
         self._refcount: Dict[int, int] = {}
         self.high_water = 0  # max blocks simultaneously in use
+        # multi-tenant accounting (serve frontend, docs/SERVING.md): a
+        # block allocated on behalf of a named tenant counts against that
+        # tenant's budget until it is actually FREED (ownership is fixed
+        # for the block's lifetime — cross-tenant sharing never happens,
+        # the prefix cache namespaces per tenant). ``tenant=None`` is the
+        # trainer's unquoted default: unowned, uncounted, unchanged.
+        self._quota: Dict[str, int] = {}
+        self._owner: Dict[int, str] = {}
+        self._tenant_used: Dict[str, int] = {}
 
     # -- queries ---------------------------------------------------------
 
@@ -67,13 +84,43 @@ class BlockAllocator:
     def refcount(self, block: int) -> int:
         return self._refcount.get(block, 0)
 
+    def tenant_blocks_in_use(self, tenant: str) -> int:
+        return self._tenant_used.get(tenant, 0)
+
+    def tenant_quota(self, tenant: str) -> Optional[int]:
+        return self._quota.get(tenant)
+
+    # -- tenancy ---------------------------------------------------------
+
+    def set_tenant_quota(self, tenant: str, blocks: int) -> None:
+        """Cap ``tenant``'s simultaneously-owned blocks. Applies to future
+        allocations only; an already-over tenant simply cannot allocate
+        until its usage drains below the new cap."""
+        if blocks < 1:
+            raise ValueError(
+                f"tenant quota for {tenant!r} must be >= 1, got {blocks}"
+            )
+        self._quota[tenant] = int(blocks)
+
     # -- transitions -----------------------------------------------------
 
-    def alloc(self, n: int) -> List[int]:  # acquires: kv-block-ref
+    def alloc(self, n: int, tenant: Optional[str] = None) -> List[int]:  # acquires: kv-block-ref
         """Take ``n`` fresh blocks (refcount 1 each). Raises
         :class:`BlockPoolExhausted` when the free list is short — the
         engine catches this once, evicts prefix-cache entries, and retries
-        before giving up."""
+        before giving up. With ``tenant`` set, the blocks are charged to
+        that tenant; exceeding its quota raises
+        :class:`TenantQuotaExceeded` (the engine then evicts that tenant's
+        own prefix entries and retries)."""
+        if tenant is not None:
+            quota = self._quota.get(tenant)
+            used = self._tenant_used.get(tenant, 0)
+            if quota is not None and used + n > quota:
+                raise TenantQuotaExceeded(
+                    f"tenant {tenant!r} needs {n} KV blocks with {used}/"
+                    f"{quota} quota blocks already owned — raise "
+                    "serve.tenant_quota_blocks or shed this tenant's load"
+                )
         if n > len(self._free):
             raise BlockPoolExhausted(
                 f"need {n} KV blocks, {len(self._free)} free "
@@ -83,6 +130,10 @@ class BlockAllocator:
         out = [self._free.popleft() for _ in range(n)]
         for b in out:
             self._refcount[b] = 1
+        if tenant is not None:
+            for b in out:
+                self._owner[b] = tenant
+            self._tenant_used[tenant] = self._tenant_used.get(tenant, 0) + n
         self.high_water = max(self.high_water, self.blocks_in_use)
         return out
 
@@ -104,6 +155,9 @@ class BlockAllocator:
                 del self._refcount[b]
                 self._free.append(b)
                 freed.append(b)
+                owner = self._owner.pop(b, None)
+                if owner is not None:
+                    self._tenant_used[owner] -= 1
             else:
                 self._refcount[b] = count - 1
         return freed

@@ -36,12 +36,25 @@ its complement. The paged backend adds block-pool and prefix-cache gauges
 (``engine/*``, ``memory/kv_cache_bytes``) — registered in
 ``tests/test_metric_names.py``.
 
-Thread affinity: engines are single-threaded by design — only the
-trainer's main thread calls ``enqueue_prompts``/``step``; the rollout
-pipeline worker sees nothing but the harvested numpy copies. If shared
+Thread affinity: engines are single-threaded by design — exactly ONE
+thread of control calls ``enqueue_prompts``/``step`` over an engine's
+lifetime (the trainer's main thread, or the serve pump thread that owns a
+serving engine exclusively — ``trlx_tpu/serve/server.py``); the rollout
+pipeline worker and the HTTP handler threads see nothing but harvested
+numpy copies handed over through locked serve-side buffers. If shared
 mutable state is ever introduced here, annotate it ``# guarded-by:
 <lock>`` so graftlint's lock-discipline pass (docs/STATIC_ANALYSIS.md)
 enforces the locking, as in ``rollout_pipeline.py``.
+
+Serving extensions (docs/SERVING.md): requests carry an optional tenant
+(prefix-cache namespace + allocator quota) and a priority class —
+``interactive`` outranks ``eval`` outranks ``actor`` at admission, and
+queued higher-class traffic preempts still-prefilling lower-class slots
+at step boundaries (the chunked-prefill scheduler is the seam: committed
+prompt chunks are inserted into the tenant's radix chain before the slot
+is vacated, so preempted work re-lands as prefix hits). An attached
+:class:`~trlx_tpu.serve.tiering.HostTier` re-lands evicted prefix blocks
+from host RAM instead of re-prefilling them.
 """
 
 import time
@@ -51,7 +64,11 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from trlx_tpu.engine.allocator import BlockAllocator, BlockPoolExhausted
+from trlx_tpu.engine.allocator import (
+    BlockAllocator,
+    BlockPoolExhausted,
+    TenantQuotaExceeded,
+)
 from trlx_tpu.engine.prefix_cache import PrefixCache
 from trlx_tpu.ops.paged_kv import block_bytes, kv_bytes, num_table_blocks
 
@@ -61,7 +78,16 @@ __all__ = [
     "Engine",
     "SerialEngine",
     "ContinuousEngine",
+    "SERVE_CLASSES",
 ]
+
+# Priority classes, best-first (docs/SERVING.md): interactive user traffic
+# outranks eval sweeps outranks the trainer's own actor batches. Admission
+# pops the best-ranked queued request (FIFO within a class by submission
+# index), so the rank table IS the scheduling policy.
+SERVE_CLASSES = ("interactive", "eval", "actor")
+_CLASS_RANK = {k: i for i, k in enumerate(SERVE_CLASSES)}
+_DEFAULT_RANK = _CLASS_RANK["actor"]
 
 
 @dataclass
@@ -76,6 +102,14 @@ class CompletedSequence:
     values: np.ndarray  # [N] value-head outputs (0 if no head)
     mask: np.ndarray  # [N] 1 on real response tokens (incl. eos)
     meta: Any = None  # caller payload (e.g. GRPO group id)
+    # request lifecycle timestamps (perf_counter; 0.0 = untracked): the
+    # per-request spans the serve SLO metrics derive queue-wait/TTFT/TPOT
+    # from (trlx_tpu/serve/metrics.py) — same instants the tracer's
+    # engine/queue_wait → prefill → decode spans are built on
+    t_enqueue: float = 0.0
+    t_prefill0: float = 0.0
+    t_prefill1: float = 0.0
+    t_harvest: float = 0.0
 
 
 @dataclass
@@ -95,6 +129,10 @@ class _Request:
     # chunked prefill: next prompt column to prefill (None = prefill done
     # or not chunked); the engine advances one chunk per step
     prefill_pos: Optional[int] = None
+    # serving extensions: prefix-cache namespace + quota identity, and the
+    # priority class admission/preemption schedule on (docs/SERVING.md)
+    tenant: Optional[str] = None
+    klass: str = "actor"
 
 
 @dataclass
@@ -111,6 +149,11 @@ class EngineStats:
     decode_s: float = 0.0  # wall time inside decode segments
     refill_s: float = 0.0  # wall time inside refill prefills
     queue_wait_s: float = 0.0  # summed enqueue→refill wait over requests
+    # per-request queue waits (one sample per admitted request): the
+    # p50/p95 the trainer gauges and the serve SLO metrics share — the
+    # aggregate sum above cannot answer "how long does a request wait",
+    # which is the admission-control question (docs/SERVING.md)
+    queue_wait_samples: List[float] = field(default_factory=list)
     # KV memory (docs/PERFORMANCE.md): the persistent cache allocation, and
     # for the paged backend the live-token-scaled high-water
     kv_cache_bytes: int = 0  # dense cache / paged pool allocation
@@ -144,6 +187,14 @@ class EngineStats:
     prefix_tokens_saved: int = 0  # prompt columns NOT re-prefilled
     prefix_evicted_blocks: int = 0
     prefill_tokens: int = 0  # prompt columns actually prefilled
+    # host-RAM tiering (trlx_tpu/serve/tiering.py): evicted prefix blocks
+    # re-landed from the host pool instead of re-prefilled
+    host_tier_enabled: bool = False
+    host_tier_hit_blocks: int = 0
+    host_tier_tokens_saved: int = 0  # prompt columns re-landed, not computed
+    # priority scheduling: still-prefilling lower-class slots vacated for
+    # queued higher-class traffic (requeued, committed chunks preserved)
+    preempted_rows: int = 0
     # speculative decode segments (engine.speculative = k > 0): deltas of
     # the device-cumulative spec counters over this collection — verify
     # rounds run, live row-rounds, draft tokens accepted, tokens committed
@@ -204,6 +255,19 @@ class EngineStats:
             return 0.0
         return float(np.percentile(np.asarray(self.decode_stall_samples), q))
 
+    def _queue_wait_pct(self, q: float) -> float:
+        if not self.queue_wait_samples:
+            return 0.0
+        return float(np.percentile(np.asarray(self.queue_wait_samples), q))
+
+    @property
+    def queue_wait_p50(self) -> float:
+        return self._queue_wait_pct(50.0)
+
+    @property
+    def queue_wait_p95(self) -> float:
+        return self._queue_wait_pct(95.0)
+
     @property
     def decode_stall_p50(self) -> float:
         return self._stall_pct(50.0)
@@ -254,6 +318,10 @@ class EngineStats:
         stats["rollout/refilled_rows"] = float(self.refilled_rows)
         stats["rollout/segments"] = float(self.segments)
         stats["engine/queue_wait_s"] = float(self.queue_wait_s)
+        # per-request queue-wait percentiles: the admission-control number —
+        # the serve SLO check and the trainer share these samples
+        stats["engine/queue_wait_p50"] = self.queue_wait_p50
+        stats["engine/queue_wait_p95"] = self.queue_wait_p95
         stats["memory/kv_cache_bytes"] = float(self.kv_cache_bytes)
         # decode-stall percentiles (docs/PERFORMANCE.md "Chunked prefill"):
         # how long live decode slots waited on prefill work — the measured
@@ -293,6 +361,15 @@ class EngineStats:
         if self.prefix_enabled:
             stats["engine/prefix_hit_rate"] = self.prefix_hit_rate
             stats["engine/prefix_tokens_saved"] = float(self.prefix_tokens_saved)
+        if self.preempted_rows:
+            stats["engine/preempted_rows"] = float(self.preempted_rows)
+        if self.host_tier_enabled:
+            # host-tier effectiveness: prompt columns whose KV came back
+            # over PCIe instead of through a prefill forward
+            stats["engine/host_tier_hit_blocks"] = float(self.host_tier_hit_blocks)
+            stats["engine/host_tier_tokens_saved"] = float(
+                self.host_tier_tokens_saved
+            )
         if self.spec_gamma:
             # speculative decode segments: how much of the draft's work the
             # target kept, and the per-round throughput multiplier
@@ -500,6 +577,16 @@ class ContinuousEngine(Engine):
         self._chunk = int(prefill_chunk)
         if self._chunk < 0:
             raise ValueError(f"prefill_chunk {self._chunk} must be >= 0")
+        # serving extensions (all default-off; single-threaded like the
+        # rest of the engine — the serve pump thread owns them):
+        # host-RAM tier of evicted prefix blocks (attach_host_tier)
+        self.host_tier: Any = None
+        # slots only interactive-class requests may take, so a saturating
+        # batch workload cannot push interactive TTFT past one admission
+        self.reserve_slots = 0
+        # requests that failed admission-side (tenant quota): the owner
+        # drains these after step() — trainer traffic never lands here
+        self.failed: deque = deque()
 
         self.spec = getattr(fns, "paged", None)
         # speculative decode segments (ops/slot_refill.py speculative=k):
@@ -602,6 +689,7 @@ class ContinuousEngine(Engine):
         cheap counter: a matching version skips the flush even when the
         params object is a fresh copy of the same weights."""
         self._queue.clear()
+        self.failed.clear()
         for slot in range(self.B):
             if self._slots[slot] is None:
                 continue
@@ -625,6 +713,7 @@ class ContinuousEngine(Engine):
         self.stats = EngineStats(
             kv_cache_bytes=self.stats.kv_cache_bytes,
             prefix_enabled=self.stats.prefix_enabled,
+            host_tier_enabled=self.stats.host_tier_enabled,
             kv_blocks_total=self.stats.kv_blocks_total,
             decode_kernel_pallas=self.stats.decode_kernel_pallas,
             prefill_kernel_pallas=self.stats.prefill_kernel_pallas,
@@ -660,9 +749,35 @@ class ContinuousEngine(Engine):
         if self._params_changed(params, version):
             if self.prefix is not None:
                 self.prefix.clear(self.allocator)
+            if self.host_tier is not None:
+                # spilled KV is valid only under the params that computed
+                # it — exactly like the device-side entries just cleared
+                self.host_tier.clear()
             self._kv_params = params
         self._params_version = version
         self.params = params
+
+    def attach_host_tier(self, tier: Any) -> None:
+        """Wire a :class:`~trlx_tpu.serve.tiering.HostTier` behind the
+        prefix cache: evicted entries spill their block KV host-side, and
+        admission re-lands host-resident chunks instead of re-prefilling.
+        The tier is owned by this engine's (single) driving thread."""
+        if self.prefix is None:
+            raise ValueError(
+                "host tiering requires the prefix cache "
+                "(engine.prefix_cache: true) — only committed prefix "
+                "entries ever spill"
+            )
+        self.host_tier = tier
+        self.stats.host_tier_enabled = True
+        self.prefix.spill = self._spill_entry
+
+    def _spill_entry(self, entry: Any) -> None:
+        """Prefix-cache eviction hook: copy the victim's block rows to the
+        host pool before the cache drops its ref (committed KV is
+        immutable, so the copy is valid even while a live row shares the
+        block)."""
+        self.host_tier.spill(entry.digest, self.state.cache.pool, entry.block)
 
     def swap_params(self, params: Any, version: Optional[int] = None) -> bool:
         """In-flight weight sync (docs/ASYNC_RL.md): adopt updated params
@@ -691,11 +806,22 @@ class ContinuousEngine(Engine):
         attention_mask: np.ndarray,  # [b, p]
         keys: np.ndarray,  # [b, 2] per-row RNG chain starts
         metas: Optional[List[Any]] = None,
+        tenant: Optional[str] = None,
+        klass: str = "actor",
     ) -> None:
         """Queue a prompt batch. Rows narrower than the engine width are
         left-padded to ``P`` (bit-stream-neutral only when the caller also
         runs its reference ``generate`` at width ``P``); wider rows are an
-        error — the KV cache was sized for ``P``."""
+        error — the KV cache was sized for ``P``. ``tenant`` scopes the
+        batch's prefix-cache namespace and block quota; ``klass`` is its
+        priority class (:data:`SERVE_CLASSES`) — the trainer's default
+        ``actor`` keeps the pre-serving FIFO behavior when nothing of a
+        better class is queued."""
+        if klass not in _CLASS_RANK:
+            raise ValueError(
+                f"unknown priority class {klass!r}: expected one of "
+                f"{SERVE_CLASSES}"
+            )
         input_ids = np.asarray(input_ids, np.int32)
         attention_mask = np.asarray(attention_mask, np.int32)
         b, p = input_ids.shape
@@ -724,6 +850,8 @@ class ContinuousEngine(Engine):
                     key=keys[i],
                     meta=metas[i] if metas is not None else None,
                     t_enqueue=t_enqueue,
+                    tenant=tenant,
+                    klass=klass,
                 )
             )
             self._submitted += 1
@@ -746,20 +874,34 @@ class ContinuousEngine(Engine):
 
     # -- paged-block bookkeeping ----------------------------------------
 
-    def _alloc_blocks(self, n: int) -> List[int]:  # acquires: kv-block-ref
+    def _alloc_blocks(self, n: int, tenant: Optional[str] = None) -> List[int]:  # acquires: kv-block-ref
         """Allocate with one eviction retry: on pool pressure, drop LRU
         prefix-cache entries (their blocks free unless a live row still
-        shares them) before giving up."""
+        shares them) before giving up. A quota'd tenant's pressure evicts
+        ONLY that tenant's entries — another tenant's working set is never
+        shed to admit this one (docs/SERVING.md)."""
         if n == 0:
             return []
         try:
-            return self.allocator.alloc(n)
+            return self.allocator.alloc(n, tenant=tenant)
+        except TenantQuotaExceeded:
+            if self.prefix is None:
+                raise
+            quota = self.allocator.tenant_quota(tenant)
+            headroom = max(
+                (quota or 0) - self.allocator.tenant_blocks_in_use(tenant), 0
+            )
+            self.stats.prefix_evicted_blocks += self.prefix.evict(
+                self.allocator, blocks_needed=n - headroom, tenant=tenant
+            )
+            # still over quota → the caller fails THIS request, not the engine
+            return self.allocator.alloc(n, tenant=tenant)
         except BlockPoolExhausted:
             if self.prefix is not None:
                 self.stats.prefix_evicted_blocks += self.prefix.evict(
                     self.allocator, blocks_needed=n - self.allocator.blocks_free
                 )
-                return self.allocator.alloc(n)  # exhausted again → caller's error
+                return self.allocator.alloc(n, tenant=tenant)  # exhausted again → caller's error
             raise
 
     def _note_block_usage(self) -> None:
@@ -770,22 +912,25 @@ class ContinuousEngine(Engine):
 
     def _prepare_row(self, req: "_Request", slot: int) -> int:  # acquires: row-block-ref(object)
         """Assign blocks for one refilled row: shared prefix blocks from
-        the cache (refcount++), fresh private blocks for the rest of the
-        prompt region. Returns the row's hit length in cache columns
-        (block-aligned, capped so at least one prompt column is always
-        recomputed — the refill forward must produce last-position logits
-        to seed the sampler)."""
+        the cache (refcount++), host-tier re-lands for chunks beyond the
+        device hit (spilled KV written back verbatim — bit-identical to a
+        cold prefill by construction), fresh private blocks for the rest
+        of the prompt region. Returns the row's hit length in cache
+        columns (block-aligned, capped so at least one prompt column is
+        always recomputed — the refill forward must produce last-position
+        logits to seed the sampler)."""
         shared: List[int] = []
+        cap = (self.P - 1) // self._bs
         if self.prefix is not None:
-            shared = self.prefix.match(req.input_ids, req.attention_mask)
-            shared = shared[: (self.P - 1) // self._bs]
+            shared = self.prefix.match(
+                req.input_ids, req.attention_mask, tenant=req.tenant
+            )
+            shared = shared[:cap]
             # denominator = blocks a hit could ever cover — the cap above
             # always recomputes the last prompt block, so a fully warm
             # repeat prompt reaches hit_rate 1.0
-            self.stats.prefix_lookup_blocks += (self.P - 1) // self._bs
+            self.stats.prefix_lookup_blocks += cap
             self.stats.prefix_hit_blocks += len(shared)
-        hit = len(shared) * self._bs
-        n_prompt_blocks = (self.P - 1) // self._bs + 1
         # retain the matched chain BEFORE allocating: _alloc_blocks may
         # evict prefix-cache entries under pool pressure, and a cache-only
         # ref on a just-matched block would let eviction free it and hand
@@ -793,19 +938,64 @@ class ContinuousEngine(Engine):
         # prefix position with a write target). With the row's ref held,
         # eviction only ever drops the cache's ref — the block survives.
         self.allocator.retain(shared)  # no-op for a cold miss (empty hit)
+        relanded = self._reland_from_tier(req, len(shared), cap, shared)
+        hit_chain = shared + relanded
+        hit = len(hit_chain) * self._bs
+        n_prompt_blocks = (self.P - 1) // self._bs + 1
         try:
-            fresh = self._alloc_blocks(n_prompt_blocks - len(shared))
-        except BlockPoolExhausted:
-            self.allocator.release(shared)  # no leak on the error path
+            fresh = self._alloc_blocks(
+                n_prompt_blocks - len(hit_chain), tenant=req.tenant
+            )
+        except (BlockPoolExhausted, TenantQuotaExceeded):
+            self.allocator.release(hit_chain)  # no leak on the error path
             raise
         row = np.zeros(self._TB, np.int32)
-        row[: len(shared)] = shared
-        row[len(shared) : n_prompt_blocks] = fresh
+        row[: len(hit_chain)] = hit_chain
+        row[len(hit_chain) : n_prompt_blocks] = fresh
         self._tables[slot] = row
-        self._row_blocks[slot] = shared + fresh
+        self._row_blocks[slot] = hit_chain + fresh
         self._alloc_upto[slot] = n_prompt_blocks
         self._steps_bound[slot] = 0
         return hit
+
+    def _reland_from_tier(
+        self, req: "_Request", n_hit: int, cap: int, shared: List[int]
+    ) -> List[int]:  # acquires: kv-block-ref
+        """Probe the host tier for the consecutive chunks beyond the
+        device hit; write each host-resident chunk's spilled KV into a
+        fresh device block and commit it back into the tenant's radix
+        chain (so siblings share it and the cache owns a ref, exactly like
+        a prefilled block). Returns the re-landed blocks, row ref held."""
+        if self.host_tier is None or self.prefix is None or n_hit >= cap:
+            return []
+        digests = self.prefix.chain_digests(
+            req.input_ids, req.attention_mask, cap, tenant=req.tenant
+        )
+        run: List[bytes] = []
+        for i in range(n_hit, min(cap, len(digests))):
+            if not self.host_tier.probe(digests[i]):
+                break
+            run.append(digests[i])
+        if not run:
+            return []
+        try:
+            blocks = self._alloc_blocks(len(run), tenant=req.tenant)
+        except (BlockPoolExhausted, TenantQuotaExceeded):
+            return []  # the tier is an optimization: fall back to re-prefill
+        pool = self.host_tier.reland_many(run, self.state.cache.pool, blocks)
+        self.state = self.state._replace(
+            cache=self.state.cache._replace(pool=pool)
+        )
+        self.prefix.insert(
+            req.input_ids,
+            req.attention_mask,
+            shared + blocks,
+            self.allocator,
+            tenant=req.tenant,
+        )
+        self.stats.host_tier_hit_blocks += len(blocks)
+        self.stats.host_tier_tokens_saved += len(blocks) * self._bs
+        return blocks
 
     def _ensure_decode_blocks(self, segment_len: int) -> bool:
         """Grow each live row's table to cover the columns the next decode
@@ -881,27 +1071,129 @@ class ContinuousEngine(Engine):
         self.stats.refill_gather_bytes += int(rows * gather_cols * self._col_bytes)
         self.stats.refill_scatter_bytes += int(rows * span_cols * self._col_bytes)
 
+    def _rank(self, req: "_Request") -> int:
+        return _CLASS_RANK.get(req.klass, _DEFAULT_RANK)
+
+    def _pop_next(self, only_interactive: bool = False) -> Optional["_Request"]:
+        """Best-class-first, FIFO-within-class (by submission index) pop —
+        a requeued preemption victim's lower index restores its original
+        place in its class. With ``only_interactive`` (the reserve-slot
+        guard) only rank-0 requests are eligible."""
+        best_i = -1
+        best_key = None
+        for i, req in enumerate(self._queue):
+            rank = self._rank(req)
+            if only_interactive and rank > 0:
+                continue
+            key = (rank, req.index)
+            if best_key is None or key < best_key:
+                best_i, best_key = i, key
+        if best_key is None:
+            return None
+        req = self._queue[best_i]
+        del self._queue[best_i]
+        return req
+
+    def _preempt_slot(self, slot: int) -> None:  # releases: row-block-ref(object)
+        """Vacate one still-prefilling slot: committed prompt chunks are
+        inserted into the tenant's radix chain FIRST (insert retains the
+        blocks, so the committed work survives the row's release and
+        re-lands as a prefix hit on re-admission), then the row's block
+        refs drop and the request returns to the queue."""
+        req = self._slots[slot]
+        pos = req.prefill_pos or 0
+        if self.prefix is not None and pos >= self._bs:
+            n_committed = min(pos // self._bs, (self.P - 1) // self._bs)
+            self.prefix.insert(
+                req.input_ids,
+                req.attention_mask,
+                list(self._tables[slot, :n_committed]),
+                self.allocator,
+                tenant=req.tenant,
+            )
+        self.allocator.release(self._row_blocks[slot])
+        self._row_blocks[slot] = None
+        self._alloc_upto[slot] = 0
+        self._steps_bound[slot] = 0
+        self._slots[slot] = None
+        self._seeded[slot] = False
+        req.prefill_pos = None
+        self._queue.append(req)
+        self.stats.preempted_rows += 1
+
+    def _preempt_for_priority(self) -> None:
+        """The preemption seam (docs/SERVING.md): queued higher-class
+        requests that cannot find a free slot vacate still-prefilling
+        lower-class slots at the step boundary. Seeded (decoding) slots
+        are never preempted — their KV would be lost mid-sequence; the
+        chunked-prefill scheduler makes prefilling slots cheap to vacate
+        (at most one chunk of uncommitted work)."""
+        if self.spec is None or not self._queue:
+            return
+        free = sum(1 for s in range(self.B) if self._slots[s] is None)
+        waiting = sorted(self._rank(r) for r in self._queue)
+        # worst class first, least-progressed first: lose the least work
+        victims = sorted(
+            (
+                s
+                for s in range(self.B)
+                if self._slots[s] is not None and not self._seeded[s]
+            ),
+            key=lambda s: (
+                -self._rank(self._slots[s]),
+                self._slots[s].prefill_pos or 0,
+            ),
+        )
+        for slot in victims:
+            vrank = self._rank(self._slots[slot])
+            demand = sum(1 for r in waiting if r < vrank)
+            if demand <= free:
+                continue  # free slots already cover the outranking demand
+            waiting.append(vrank)
+            self._preempt_slot(slot)
+            free += 1
+
     def _admit(self) -> None:
-        """Move queued prompts into free slots. Dense backend: the whole
-        prompt prefills immediately (one grouped gather-prefill-scatter).
-        Paged backend: blocks are assigned (prefix hits → shared, rest
-        fresh) and the row's ``prefill_pos`` starts at its hit; the actual
-        prefill work runs in :meth:`_advance_prefill` — one span per step,
-        so with ``prefill_chunk`` set a long prompt is admitted instantly
-        but prefilled incrementally between decode segments."""
-        free = [s for s in range(self.B) if self._slots[s] is None]
+        """Move queued prompts into free slots, best priority class first
+        (FIFO within a class). Dense backend: the whole prompt prefills
+        immediately (one grouped gather-prefill-scatter). Paged backend:
+        blocks are assigned (prefix hits → shared, host-tier re-lands,
+        rest fresh) and the row's ``prefill_pos`` starts at its hit; the
+        actual prefill work runs in :meth:`_advance_prefill` — one span
+        per step, so with ``prefill_chunk`` set a long prompt is admitted
+        instantly but prefilled incrementally between decode segments.
+        ``reserve_slots`` holds the last free slots for interactive-class
+        traffic; a tenant whose quota cannot cover its prompt fails onto
+        :attr:`failed` instead of failing the engine."""
+        self._preempt_for_priority()
+        free = deque(s for s in range(self.B) if self._slots[s] is None)
         if not free or not self._queue:
             return
         rows: List[_Request] = []
         slots: List[int] = []
-        for slot in free:
-            if not self._queue:
+        while free and self._queue:
+            if self.reserve_slots > 0:
+                non_interactive = sum(
+                    1
+                    for s in range(self.B)
+                    if self._slots[s] is not None
+                    and self._rank(self._slots[s]) > 0
+                )
+                only_interactive = (
+                    non_interactive >= self.B - self.reserve_slots
+                )
+            else:
+                only_interactive = False
+            req = self._pop_next(only_interactive)
+            if req is None:
                 break
-            req = self._queue.popleft()
+            slot = free.popleft()
             self._slots[slot] = req
             self._seeded[slot] = False
             rows.append(req)
             slots.append(slot)
+        if not rows:
+            return
         if self.spec is None:
             waiting = self._decoding()
             t0 = time.perf_counter()
@@ -924,10 +1216,26 @@ class ContinuousEngine(Engine):
                 req.t_refill1 = t1
                 self._seeded[slot] = True
                 self.stats.queue_wait_s += max(t0 - req.t_enqueue, 0.0)
+                self.stats.queue_wait_samples.append(
+                    max(t0 - req.t_enqueue, 0.0)
+                )
             self.stats.refilled_rows += len(rows)
             return
+        admitted = 0
         for req, slot in zip(rows, slots):
-            hit = self._prepare_row(req, slot)
+            try:
+                hit = self._prepare_row(req, slot)
+            except TenantQuotaExceeded as e:
+                # the tenant's budget cannot cover this prompt even after
+                # shedding its own prefix entries: fail THE REQUEST (the
+                # serve frontend turns this into an error response), never
+                # the engine — trainer traffic is unquoted and cannot land
+                # here
+                self._slots[slot] = None
+                self._seeded[slot] = False
+                self.failed.append((req, str(e)))
+                continue
+            admitted += 1
             pos0 = hit
             if self._chunk:
                 # skip all-masked leading pad columns: they are never
@@ -946,7 +1254,7 @@ class ContinuousEngine(Engine):
                 )
             req.prefill_pos = pos0
             self.stats.prefix_tokens_saved += hit
-        self.stats.refilled_rows += len(rows)
+        self.stats.refilled_rows += admitted
         self._note_block_usage()
 
     def _next_span(self, pos: int) -> int:
@@ -1028,6 +1336,9 @@ class ContinuousEngine(Engine):
                 if req.t_refill0 == 0.0:
                     req.t_refill0 = t0
                     self.stats.queue_wait_s += max(t0 - req.t_enqueue, 0.0)
+                    self.stats.queue_wait_samples.append(
+                        max(t0 - req.t_enqueue, 0.0)
+                    )
                 if self._tracer is not None and end < self.P:
                     self._tracer.add_complete_event(
                         "engine/prefill_chunk", t0, t1,
@@ -1053,6 +1364,7 @@ class ContinuousEngine(Engine):
                     req.attention_mask,
                     list(self._tables[slot, :n_full]),
                     self.allocator,
+                    tenant=req.tenant,
                 )
         self._note_block_usage()
 
@@ -1112,10 +1424,41 @@ class ContinuousEngine(Engine):
                     values=host["values"][j],
                     mask=host["mask"][j],
                     meta=req.meta,
+                    t_enqueue=req.t_enqueue,
+                    t_prefill0=req.t_refill0,
+                    t_prefill1=req.t_refill1,
+                    t_harvest=t_harvest,
                 )
             )
         self.stats.harvested += len(completed)
         return completed
+
+    def progress_snapshot(self) -> List[tuple]:
+        """Per-slot decode progress for token streaming (paged backend):
+        ``(index, meta, tokens)`` for every seeded live slot, where
+        ``tokens`` is the host copy of the row's committed response so far
+        (``_steps_bound`` is exact for live rows — non-spec rows advance
+        in lockstep, spec rows read the device step counter; a row that
+        finished mid-segment was harvested by the same :meth:`step`, so it
+        never appears here with trailing post-eos positions). The serve
+        pump diffs consecutive snapshots into stream deltas; their
+        concatenation plus the harvest tail is exactly the masked response
+        (pinned by ``tests/test_serve.py`` streaming parity)."""
+        if self.spec is None:
+            return []
+        out: List[tuple] = []
+        toks = None
+        for slot in range(self.B):
+            req = self._slots[slot]
+            if req is None or not self._seeded[slot]:
+                continue
+            n = min(self._steps_bound[slot], self.N)
+            if n <= 0:
+                continue
+            if toks is None:
+                toks = np.asarray(self.state.tokens)  # one device fetch
+            out.append((req.index, req.meta, toks[slot, :n].copy()))
+        return out
 
     def _trace_request(
         self, req: "_Request", slot: int, t_harvest: float, gen_len: float = 0.0

@@ -35,10 +35,25 @@ cache's own entry table, ``evict``/``clear`` drop them — declared to
 graftlint's ownership pass with the ``(object)`` handle spec (GL80x,
 docs/STATIC_ANALYSIS.md), which documents the protocol without per-caller
 handle tracking.
+
+Multi-tenant isolation (docs/SERVING.md): every chain is rooted at a
+per-tenant root uid, so two tenants submitting byte-identical prompts
+build DISJOINT radix chains — tenant B can never match (hence never read)
+tenant A's committed blocks. The trainer's own traffic is the ``None``
+tenant, sharing one default root, byte-for-byte the pre-tenancy behavior.
+
+Host-RAM tiering hook (``trlx_tpu/serve/tiering.py``): when ``spill`` is
+set, evicted entries are offered to it BEFORE their allocator ref drops —
+the engine's callback copies the block's pool rows to a bounded host pool,
+keyed by the entry's content-chained digest (tenant tag + chunk bytes
+hashed along the chain, stable across evict/re-insert cycles, unlike
+uids). A later identical prompt re-lands those bytes device-side instead
+of re-prefilling them.
 """
 
+import hashlib
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -55,6 +70,8 @@ class _Entry:
     children: int = 0
     last_used: int = 0
     parent: Optional["_Entry"] = None
+    tenant: Optional[str] = None
+    digest: bytes = b""  # content-chained id (set when a spill hook exists)
 
 
 _ROOT_UID = -1
@@ -67,6 +84,43 @@ class PrefixCache:
         self._entries: Dict[Tuple[int, bytes], _Entry] = {}
         self._next_uid = 0
         self._clock = 0
+        # per-tenant radix roots: chains chain on parent uid, so distinct
+        # roots make tenant trees disjoint by construction
+        self._tenant_roots: Dict[Optional[str], int] = {None: _ROOT_UID}
+        self._next_root = _ROOT_UID - 1
+        # host-tiering spill hook: called with each evicted entry before
+        # its allocator ref is dropped (never on clear — clear means the
+        # params changed and the KV bytes are invalid everywhere)
+        self.spill: Optional[Callable[[_Entry], None]] = None
+
+    def _root_uid(self, tenant: Optional[str]) -> int:
+        uid = self._tenant_roots.get(tenant)
+        if uid is None:
+            uid = self._next_root
+            self._next_root -= 1
+            self._tenant_roots[tenant] = uid
+        return uid
+
+    def _root_digest(self, tenant: Optional[str]) -> bytes:
+        return hashlib.sha1(repr(tenant).encode()).digest()
+
+    def chain_digests(
+        self,
+        tokens: np.ndarray,
+        mask: np.ndarray,
+        n: int,
+        tenant: Optional[str] = None,
+    ) -> List[bytes]:
+        """Content-chained digests of the first ``n`` full prompt blocks —
+        digest ``i`` identifies the padded prompt's columns ``[0, (i+1) *
+        block_size)`` under this tenant, independent of entry uids (which
+        do not survive evict/re-insert). The host tier is keyed by these."""
+        out: List[bytes] = []
+        d = self._root_digest(tenant)
+        for i in range(min(n, self._full_blocks(tokens.shape[0]))):
+            d = hashlib.sha1(d + self._chunk_key(tokens, mask, i)).digest()
+            out.append(d)
+        return out
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -84,12 +138,18 @@ class PrefixCache:
         prompt/response boundary is written during decode."""
         return prompt_len // self.block_size
 
-    def match(self, tokens: np.ndarray, mask: np.ndarray) -> List[int]:
+    def match(
+        self,
+        tokens: np.ndarray,
+        mask: np.ndarray,
+        tenant: Optional[str] = None,
+    ) -> List[int]:
         """Longest committed chain of full prompt blocks for this padded
-        row; returns their physical block ids (the caller retains them)."""
+        row under ``tenant``'s root; returns their physical block ids (the
+        caller retains them)."""
         n_full = self._full_blocks(tokens.shape[0])
         blocks: List[int] = []
-        parent_uid = _ROOT_UID
+        parent_uid = self._root_uid(tenant)
         for i in range(n_full):
             entry = self._entries.get((parent_uid, self._chunk_key(tokens, mask, i)))
             if entry is None:
@@ -106,15 +166,22 @@ class PrefixCache:
         mask: np.ndarray,
         blocks: List[int],  # the row's table prefix: one id per full block
         allocator: BlockAllocator,
+        tenant: Optional[str] = None,
     ) -> int:
-        """Commit a freshly prefilled row's full prompt blocks. Chunks
-        already present are left alone (a concurrent duplicate keeps its
-        private copy until harvest frees it); new entries retain their
-        block so it outlives the row. Returns entries inserted."""
+        """Commit a freshly prefilled row's full prompt blocks under
+        ``tenant``'s root. Chunks already present are left alone (a
+        concurrent duplicate keeps its private copy until harvest frees
+        it); new entries retain their block so it outlives the row.
+        Returns entries inserted."""
         n = min(self._full_blocks(tokens.shape[0]), len(blocks))
         inserted = 0
         parent: Optional[_Entry] = None
-        parent_uid = _ROOT_UID
+        parent_uid = self._root_uid(tenant)
+        digests: List[bytes] = (
+            self.chain_digests(tokens, mask, n, tenant)
+            if self.spill is not None
+            else []
+        )
         for i in range(n):
             key = (parent_uid, self._chunk_key(tokens, mask, i))
             entry = self._entries.get(key)
@@ -126,6 +193,8 @@ class PrefixCache:
                     block=blocks[i],
                     last_used=self._clock,
                     parent=parent,
+                    tenant=tenant,
+                    digest=digests[i] if digests else b"",
                 )
                 self._next_uid += 1
                 allocator.retain([entry.block])
@@ -146,11 +215,18 @@ class PrefixCache:
         allocator: BlockAllocator,
         blocks_needed: int = 0,
         entries: int = 0,
+        tenant: Optional[str] = ...,
     ) -> int:
         """Drop LRU leaf entries until ``blocks_needed`` blocks came FREE
         (refs shared with live rows free later, at the rows' release) or
         ``entries`` entries are gone, whichever target was given; returns
-        blocks actually freed."""
+        blocks actually freed. ``tenant`` (when given, including ``None``
+        for the default namespace) restricts victims to that tenant's
+        entries — the quota-pressure eviction path, which must never shed
+        another tenant's working set. Each victim is offered to the
+        ``spill`` hook (host tiering) before its ref drops: committed
+        block KV is immutable, so the copy is valid even while a live row
+        still shares the block."""
         freed = 0
         dropped = 0
         while self._entries:
@@ -160,13 +236,19 @@ class PrefixCache:
                 break
             if blocks_needed <= 0 and entries <= 0:
                 break
-            leaves = [e for e in self._entries.values() if e.children == 0]
+            leaves = [
+                e
+                for e in self._entries.values()
+                if e.children == 0 and (tenant is ... or e.tenant == tenant)
+            ]
             if not leaves:
                 break
             victim = min(leaves, key=lambda e: e.last_used)
             del self._entries[victim.key]
             if victim.parent is not None:
                 victim.parent.children -= 1
+            if self.spill is not None and victim.digest:
+                self.spill(victim)
             freed += len(allocator.release([victim.block]))
             dropped += 1
         return freed

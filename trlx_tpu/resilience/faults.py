@@ -15,7 +15,7 @@ Plan syntax (``;``-separated entries, whitespace ignored)::
     kind     one of: reward_raise | publish_raise | sigterm | sigint |
              sigterm_one_proc | nan_loss | crash_save | topology_shrink |
              sleep_one_proc | flightrec_dump | actor_crash |
-             weight_sync_drop | health_trip
+             weight_sync_drop | health_trip | slow_client | request_flood
     trigger  call  — the Nth invocation of the consulting site (1-based;
                      for reward_raise/publish_raise every *attempt* counts,
                      so retries advance the counter)
@@ -26,6 +26,8 @@ Plan syntax (``;``-separated entries, whitespace ignored)::
                      == N (1-based; docs/ASYNC_RL.md)
              version — fires when the weight channel publishes params
                      version N
+             request — fires when the serve frontend's request id == N
+                     (1-based; docs/SERVING.md)
     count    consecutive firings (default 1)
 
 Examples::
@@ -60,6 +62,16 @@ Examples::
                                  # the detector → flightrec-dump → bad-batch
                                  # triage path (observability/health.py)
                                  # without needing an organically sick run
+    slow_client@request:2        # serve request 2's streaming consumer
+                                 # stalls forever — the engine-side producer
+                                 # must keep harvesting (bounded stream
+                                 # buffer, connection dropped), never wedge
+                                 # the slot (docs/RESILIENCE.md, SERVING.md)
+    request_flood@step:3         # admission-control drill at the boundary
+                                 # before update 4: a synthetic burst is
+                                 # pushed through the serve admission path,
+                                 # which must shed it with 429s instead of
+                                 # letting the queue-wait SLO blow
 
 Plans come from ``config.resilience.fault_plan`` or the
 ``TRLX_TPU_FAULT_PLAN`` env var (env wins — a relaunched run can drop the
@@ -78,12 +90,15 @@ _KINDS = frozenset({
     "reward_raise", "publish_raise", "sigterm", "sigint", "sigterm_one_proc",
     "nan_loss", "crash_save", "topology_shrink", "sleep_one_proc",
     "flightrec_dump", "actor_crash", "weight_sync_drop", "health_trip",
+    "slow_client", "request_flood",
 })
 
 # how long a ``sleep_one_proc`` fault stalls the afflicted rank's train step
 # (env-overridable so tests can size the stall above the real step time)
 SLEEP_FAULT_S = float(os.environ.get("TRLX_TPU_FAULT_SLEEP_S", "0.5"))
-_TRIGGERS = frozenset({"call", "step", "save", "resume", "collection", "version"})
+_TRIGGERS = frozenset(
+    {"call", "step", "save", "resume", "collection", "version", "request"}
+)
 
 
 class InjectedFault(RuntimeError):
@@ -168,15 +183,16 @@ class FaultPlan:
         step: Optional[int] = None,
         collection: Optional[int] = None,
         version: Optional[int] = None,
+        request: Optional[int] = None,
     ) -> bool:
         """Should the consulting site fault now?
 
         With no caller counter this is an *invocation* poll: the per-kind
         call counter advances by one and call/save/resume-triggered entries
         match against it. With ``step=s`` / ``collection=c`` / ``version=v``
-        only the matching trigger's entries are checked against the
-        caller's own counter (idempotent — the caller polls once per
-        update / collection / publish)."""
+        / ``request=r`` only the matching trigger's entries are checked
+        against the caller's own counter (idempotent — the caller polls
+        once per update / collection / publish / serve request)."""
         if not self.specs:
             return False
         with self._lock:
@@ -186,6 +202,8 @@ class FaultPlan:
                 value, triggers = collection, ("collection",)
             elif version is not None:
                 value, triggers = version, ("version",)
+            elif request is not None:
+                value, triggers = request, ("request",)
             else:
                 value = self._counters.get(kind, 0) + 1
                 self._counters[kind] = value
@@ -217,7 +235,9 @@ def get_active_plan() -> Optional[FaultPlan]:
     return _ACTIVE_PLAN
 
 
-def poll_fault(kind: str, step: Optional[int] = None) -> bool:
+def poll_fault(
+    kind: str, step: Optional[int] = None, request: Optional[int] = None
+) -> bool:
     """Convenience for sites without a plan handle; False when no plan."""
     plan = _ACTIVE_PLAN
-    return bool(plan) and plan.poll(kind, step=step)
+    return bool(plan) and plan.poll(kind, step=step, request=request)

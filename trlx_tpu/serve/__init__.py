@@ -1,0 +1,44 @@
+"""Serving frontend on the continuous-batching engine (docs/SERVING.md).
+
+``request``   — per-request handler↔pump shared state (bounded streaming)
+``scheduler`` — SLO-aware admission control (429/503 at the door)
+``metrics``   — per-tenant/per-class TTFT/TPOT/queue-wait SLO metrics
+``frontend``  — stdlib threaded HTTP server (SSE token streaming)
+``server``    — the pump thread owning the serving engine
+``tiering``   — host-RAM second tier for evicted prefix-cache KV
+
+Imports are deliberately lazy at the package level: the serving stack
+pulls in jax only when an engine is actually driven.
+"""
+
+__all__ = [
+    "AdmissionController",
+    "HostTier",
+    "ServeMetrics",
+    "ServeRequest",
+    "ServeServer",
+]
+
+
+def __getattr__(name: str):
+    if name == "ServeServer":
+        from trlx_tpu.serve.server import ServeServer
+
+        return ServeServer
+    if name == "ServeRequest":
+        from trlx_tpu.serve.request import ServeRequest
+
+        return ServeRequest
+    if name == "ServeMetrics":
+        from trlx_tpu.serve.metrics import ServeMetrics
+
+        return ServeMetrics
+    if name == "AdmissionController":
+        from trlx_tpu.serve.scheduler import AdmissionController
+
+        return AdmissionController
+    if name == "HostTier":
+        from trlx_tpu.serve.tiering import HostTier
+
+        return HostTier
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

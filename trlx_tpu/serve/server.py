@@ -1,0 +1,410 @@
+"""ServeServer: the pump thread that owns the serving engine, plus the
+request/param plumbing between it, the HTTP frontend, and the trainer.
+
+Threading model (docs/SERVING.md; the engine's single-threaded contract):
+
+- the **pump thread** (``trlx-serve-pump``) EXCLUSIVELY drives the serving
+  :class:`~trlx_tpu.engine.core.ContinuousEngine` — every ``step()``,
+  ``enqueue_prompts``, ``swap_params``, allocator/prefix/host-tier touch
+  happens here and only here;
+- **HTTP handler threads** (``frontend.py``) talk to the pump through
+  ``queue.Queue`` handoffs and per-request :class:`ServeRequest` condition
+  variables — they never touch the engine;
+- the **trainer thread** publishes fresh params through a latest-wins
+  queue (``publish``), runs admission drills (``flood_drill``), and owns
+  start/drain/close.
+
+Single-version responses: published params are adopted only when the
+engine has NO live serve work, so every response is generated end-to-end
+under one params version (stamped on the request as ``params_version``).
+The serve-while-training e2e pins a mid-training streamed response
+bit-identical to a solo ``generate`` under that version's retained params.
+
+Graceful drain (``serve.drain_timeout_s``): new admissions 503
+immediately, in-flight requests get a bounded window to finish, then the
+pump exits — failing whatever remains so no handler thread is left blocked
+— and the HTTP listener (``trlx-serve-http``) shuts down. Both threads are
+joined; the leaked-thread sentinel (tests/conftest.py) holds us to that.
+
+Lock discipline (graftlint GL401/403, docs/STATIC_ANALYSIS.md): the
+RolloutPipeline idiom — ``queue.Queue``/``Event`` for handoffs, one lock
+for the few genuinely shared fields, all ``# guarded-by:``-annotated;
+pump-local state (slot bookkeeping, streamed counts) lives in loop locals.
+"""
+
+import queue
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from trlx_tpu.serve.metrics import ServeMetrics
+from trlx_tpu.serve.request import ServeRequest
+from trlx_tpu.serve.scheduler import AdmissionController
+
+__all__ = ["ServeServer"]
+
+
+class ServeServer:
+    """Serving frontend over one exclusively-owned ContinuousEngine."""
+
+    def __init__(
+        self,
+        engine: Any,  # ContinuousEngine (paged backend), pump-owned
+        default_tenant: str = "default",
+        default_class: str = "interactive",
+        slo_s: Optional[Dict[str, float]] = None,
+        max_queue: int = 64,
+        stream_buffer: int = 64,
+        drain_timeout_s: float = 5.0,
+        retain_param_versions: int = 0,
+        default_max_new_tokens: int = 0,
+    ):
+        if getattr(engine, "spec", None) is None:
+            raise ValueError(
+                "serving requires the paged engine backend "
+                "(engine.backend: paged) — streaming snapshots and "
+                "preemption are block-table operations"
+            )
+        self.engine = engine
+        self.default_tenant = default_tenant
+        self.default_class = default_class
+        self.stream_buffer = int(stream_buffer)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.admission = AdmissionController(
+            slots=engine.B, slo_s=slo_s, max_queue=max_queue
+        )
+        self.metrics = ServeMetrics()
+        self._ingress: "queue.Queue[ServeRequest]" = queue.Queue()
+        self._params_q: "queue.Queue[Tuple[Any, Optional[int]]]" = queue.Queue()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._lock = threading.Lock()
+        # published-params history for the bit-equality e2e: version →
+        # params, newest retain_param_versions kept (0 = keep none)
+        self._retain = int(retain_param_versions)
+        self._history: "OrderedDict[int, Any]" = OrderedDict()  # guarded-by: _lock
+        self._pump: Optional[threading.Thread] = None
+        self._httpd: Any = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._rid_iter = iter(range(1, 1 << 62))
+        self._started = False
+        self._closed = False
+
+    # -- lifecycle (trainer thread) --------------------------------------
+
+    def start(self, host: Optional[str] = None, port: int = 0) -> None:
+        """Start the pump (and, when ``host`` is given, the HTTP listener)."""
+        if self._started:
+            return
+        self._started = True
+        self._pump = threading.Thread(
+            target=self._pump_loop, name="trlx-serve-pump", daemon=True
+        )
+        self._pump.start()
+        if host is not None:
+            from trlx_tpu.serve.frontend import make_http_server
+
+            self._httpd = make_http_server(self, host, port)
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="trlx-serve-http",
+                daemon=True,
+            )
+            self._http_thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd is not None else 0
+
+    def publish(self, params: Any, version: Optional[int] = None) -> None:
+        """Latest-wins params handoff; the pump adopts at the next point
+        with no live serve work (single-version responses). Also retains
+        the newest ``retain_param_versions`` published trees for
+        :meth:`params_for_version` (the e2e parity probe)."""
+        self._params_q.put((params, version))
+        if self._retain > 0 and version is not None:
+            with self._lock:
+                self._history[int(version)] = params
+                self._history.move_to_end(int(version))
+                while len(self._history) > self._retain:
+                    self._history.popitem(last=False)
+        self._wake.set()
+
+    def params_for_version(self, version: int) -> Any:
+        with self._lock:
+            return self._history.get(int(version))
+
+    def flood_drill(self, n: int = 0) -> int:
+        """Admission-control drill (``request_flood@step:N``,
+        docs/RESILIENCE.md): push a synthetic admission burst through the
+        real gate — accepted probes are released immediately (no engine
+        work), rejections prove the 429 path sheds load. Returns the
+        rejection count."""
+        n = int(n) or 2 * self.admission.max_queue
+        accepted: List[str] = []
+        rejected = 0
+        for _ in range(n):
+            d = self.admission.try_admit(self.default_class)
+            if d.admitted:
+                accepted.append(self.default_class)
+            else:
+                rejected += 1
+        for k in accepted:
+            self.admission.release(k)
+        self.metrics.note_flood_rejected(rejected)
+        return rejected
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop admitting (503), give in-flight requests
+        ``timeout_s`` (default ``drain_timeout_s``) to finish, then stop
+        the pump (which fails any survivors) and the HTTP listener.
+        Returns True when everything in flight finished in time."""
+        timeout_s = self.drain_timeout_s if timeout_s is None else timeout_s
+        self.admission.set_draining()
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        clean = True
+        while time.monotonic() < deadline:
+            if self.metrics.metrics()["serve/active"] <= 0:
+                break
+            time.sleep(0.02)
+        else:
+            clean = self.metrics.metrics()["serve/active"] <= 0
+        self.close()
+        return clean
+
+    def close(self) -> None:
+        """Stop and join both serve threads. Idempotent; never raises."""
+        if self._closed:
+            return
+        self._closed = True
+        self.admission.set_draining()
+        self._stop.set()
+        self._wake.set()
+        if self._pump is not None:
+            self._pump.join(timeout=30)
+        if self._httpd is not None:
+            try:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+            except Exception:  # pragma: no cover - defensive teardown
+                pass
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=30)
+
+    # -- submission (handler threads / tests) ----------------------------
+
+    def submit(
+        self,
+        prompt_ids: np.ndarray,
+        prompt_mask: Optional[np.ndarray] = None,
+        tenant: Optional[str] = None,
+        klass: Optional[str] = None,
+        seed: int = 0,
+        stream: bool = False,
+        max_new_tokens: int = 0,
+    ) -> Tuple[Optional[ServeRequest], Optional[Tuple[int, str, float]]]:
+        """Admission-checked request entry. Returns ``(request, None)`` on
+        acceptance or ``(None, (status, reason, retry_after_s))`` on
+        rejection — the frontend maps the triple straight onto
+        429/503/400."""
+        tenant = tenant or self.default_tenant
+        klass = klass or self.default_class
+        prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt_mask is None:
+            prompt_mask = np.ones_like(prompt_ids)
+        prompt_mask = np.asarray(prompt_mask, np.int32).reshape(-1)
+        if prompt_ids.size == 0 or prompt_ids.shape != prompt_mask.shape:
+            return None, (400, "empty prompt or mask/ids shape mismatch", 0.0)
+        if prompt_ids.shape[0] > self.engine.P:
+            return None, (
+                400,
+                f"prompt length {prompt_ids.shape[0]} exceeds the engine's "
+                f"padded width {self.engine.P}",
+                0.0,
+            )
+        decision = self.admission.try_admit(klass)
+        if not decision.admitted:
+            return None, (
+                decision.status,
+                decision.reason,
+                decision.retry_after_s,
+            )
+        req = ServeRequest(
+            rid=next(self._rid_iter),
+            prompt_ids=prompt_ids,
+            prompt_mask=prompt_mask,
+            tenant=tenant,
+            klass=klass,
+            seed=seed,
+            stream=stream,
+            max_new_tokens=int(max_new_tokens) or self.default_max_new_tokens,
+            max_buffered=self.stream_buffer,
+        )
+        self.metrics.adjust_active(+1)
+        self._ingress.put(req)
+        self._wake.set()
+        return req, None
+
+    # -- pump thread -----------------------------------------------------
+
+    def _request_keys(self, req: ServeRequest) -> np.ndarray:
+        """Per-row RNG chain start for a B=1 solo reference: the exact
+        chain ``per_row_keys(PRNGKey(seed), 1)`` a plain ``generate`` call
+        with the same seed derives — the streaming-parity anchor."""
+        import jax
+
+        from trlx_tpu.ops.sampling import per_row_keys
+
+        return np.asarray(per_row_keys(jax.random.PRNGKey(req.seed), 1))
+
+    def _pump_loop(self) -> None:
+        engine = self.engine
+        # pump-local bookkeeping (single-threaded by construction):
+        # engine submission index → (request, tokens streamed so far)
+        tracked: Dict[int, List[Any]] = {}
+        pending_pub: Optional[Tuple[Any, Optional[int]]] = None
+        version: Optional[int] = None
+        try:
+            while not self._stop.is_set():
+                # latest-wins params adoption, only with no serve work in
+                # flight — every response is single-version
+                while True:
+                    try:
+                        pending_pub = self._params_q.get_nowait()
+                    except queue.Empty:
+                        break
+                if pending_pub is not None and not engine.busy and not tracked:
+                    params, version = pending_pub
+                    engine.swap_params(params, version)
+                    self.metrics.set_params_version(version)
+                    pending_pub = None
+                # ingress → engine
+                moved = False
+                while True:
+                    try:
+                        req = self._ingress.get_nowait()
+                    except queue.Empty:
+                        break
+                    engine.enqueue_prompts(
+                        req.prompt_ids[None],
+                        req.prompt_mask[None],
+                        self._request_keys(req),
+                        metas=[req],
+                        tenant=req.tenant,
+                        klass=req.klass,
+                    )
+                    idx = engine._submitted - 1
+                    tracked[idx] = [req, 0]
+                    req.mark_generating(version)
+                    moved = True
+                if not engine.busy:
+                    if not moved:
+                        self._wake.wait(0.02)
+                        self._wake.clear()
+                    continue
+                completed = engine.step()
+                # stream deltas for still-live rows (streamed == -1 marks
+                # a dropped consumer: decode continues, streaming stops)
+                for idx, meta, toks in engine.progress_snapshot():
+                    entry = tracked.get(idx)
+                    if entry is None or entry[1] < 0 or not entry[0].stream:
+                        continue
+                    req, streamed = entry
+                    if toks.shape[0] > streamed:
+                        if req.push_tokens(toks[streamed:]):
+                            entry[1] = int(toks.shape[0])
+                        else:
+                            # slow client: stop streaming, keep decoding
+                            entry[1] = -1
+                            self._terminal(req, "dropped")
+                for c in completed:
+                    entry = tracked.pop(c.index, None)
+                    if entry is None:
+                        continue
+                    self._finish(entry[0], entry[1], c)
+                while engine.failed:
+                    req_obj, err = engine.failed.popleft()
+                    sr = req_obj.meta
+                    tracked.pop(req_obj.index, None)
+                    if isinstance(sr, ServeRequest):
+                        sr.fail(err)
+                        self._terminal(sr, "failed")
+                self._publish_gauges()
+        finally:
+            # pump exit (drain timeout / close): nothing will ever finish
+            # the remaining requests — fail them so no handler blocks
+            for req, _streamed in tracked.values():
+                req.fail("server draining: request abandoned")
+                self._terminal(req, "failed")
+            while True:
+                try:
+                    req = self._ingress.get_nowait()
+                except queue.Empty:
+                    break
+                req.fail("server draining: request abandoned")
+                self._terminal(req, "failed")
+            self._publish_gauges()
+
+    def _finish(self, req: ServeRequest, streamed: int, c: Any) -> None:
+        masked = np.asarray(c.tokens)[np.asarray(c.mask) == 1]
+        if req.stream and streamed >= 0 and masked.shape[0] > streamed:
+            req.push_tokens(masked[streamed:])
+        queue_wait = max(0.0, c.t_prefill0 - c.t_enqueue)
+        req.finish(masked, queue_wait, t_first_token=c.t_harvest)
+        snap = req.snapshot()
+        if snap["state"] == "DONE":
+            req._accounted = True
+            ttft = snap["ttft_s"]
+            n = snap["n_tokens"]
+            tpot = (
+                max(0.0, req.t_done - req.t_first_token) / max(1, n - 1)
+                if n > 1
+                else 0.0
+            )
+            self.metrics.observe_request(
+                req.tenant, req.klass, ttft, tpot, queue_wait, n
+            )
+            self.admission.release(req.klass)
+            self.admission.note_service(
+                max(0.0, req.t_done - req.t_submit)
+            )
+            self.metrics.adjust_active(-1)
+        else:
+            # the consumer dropped mid-flight; terminal accounting already
+            # ran (or runs) through _terminal
+            self._terminal(req, "dropped")
+
+    def _terminal(self, req: ServeRequest, how: str) -> None:
+        """Terminal accounting, exactly once per request (``_accounted``
+        is pump-thread-only, like every call site here)."""
+        if req._accounted:
+            return
+        req._accounted = True
+        if how == "failed":
+            self.metrics.note_failed()
+        else:
+            self.metrics.note_dropped()
+        self.admission.release(req.klass)
+        self.metrics.adjust_active(-1)
+
+    def _publish_gauges(self) -> None:
+        self.metrics.set_admission(self.admission.snapshot())
+        if self.engine.host_tier is not None:
+            self.metrics.set_tier(self.engine.host_tier.snapshot())
+
+    # -- observation (any thread) ----------------------------------------
+
+    def flat_metrics(self) -> Dict[str, float]:
+        """The ``SERVE_KEYS`` gauges (merged into trainer step stats)."""
+        return self.metrics.metrics()
+
+    def detail_metrics(self) -> Dict[str, Any]:
+        return {
+            "serve": self.metrics.metrics(),
+            "tenants": self.metrics.detail(),
+            "admission": self.admission.snapshot(),
+        }

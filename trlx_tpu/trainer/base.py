@@ -243,6 +243,52 @@ class TPUBaseTrainer(BaseRLTrainer):
                 f"{type(config.method).__name__} has no GAE/value-head loss "
                 f"to fuse (hostable kernels: {list(hostable)})"
             )
+        if config.serve.enabled:
+            # each precondition its own error (docs/SERVING.md): the
+            # serving frontend is built on block-table operations
+            if config.engine.backend != "paged":
+                raise ValueError(
+                    "serve.enabled requires engine.backend: paged — token "
+                    "streaming snapshots and priority preemption are "
+                    "block-table operations"
+                )
+            if not getattr(config.train, "continuous_batching", False):
+                raise ValueError(
+                    "serve.enabled requires train.continuous_batching: "
+                    "true — the serving engine is a ContinuousEngine built "
+                    "through the slot-refill program cache"
+                )
+            if int(config.serve.slots) < 1:
+                raise ValueError(
+                    f"serve.slots {config.serve.slots} must be >= 1"
+                )
+            if not 0 <= int(config.serve.reserve_slots) < int(config.serve.slots):
+                raise ValueError(
+                    f"serve.reserve_slots {config.serve.reserve_slots} must "
+                    f"leave at least one unreserved slot of serve.slots "
+                    f"{config.serve.slots}"
+                )
+            if float(config.serve.drain_timeout_s) <= 0:
+                raise ValueError(
+                    f"serve.drain_timeout_s {config.serve.drain_timeout_s} "
+                    "must be > 0 (the graceful-drain window)"
+                )
+            if int(config.serve.host_tier_blocks) and not config.engine.prefix_cache:
+                raise ValueError(
+                    "serve.host_tier_blocks requires engine.prefix_cache: "
+                    "true — only committed prefix entries ever spill to the "
+                    "host tier"
+                )
+            from trlx_tpu.engine.core import SERVE_CLASSES as _SC
+
+            if config.serve.default_class not in _SC:
+                raise ValueError(
+                    f"unknown serve.default_class "
+                    f"{config.serve.default_class!r} (expected one of {_SC})"
+                )
+        # the serving frontend (trlx_tpu/serve/, docs/SERVING.md); built in
+        # learn() when serve.enabled, drained in _shutdown_collectors
+        self._serve = None
         self.mesh = make_mesh(config.parallel)
         set_global_mesh(self.mesh)  # model code reads this for sequence-parallel ops
         # NOTE: the global mesh is process-wide; entry points re-assert it so
@@ -1399,6 +1445,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         logger.info("Starting training")
         self.prepare_learning()
         self.maybe_resume()
+        self._maybe_start_serving()
         try:
             with self.resilience.preemption:
                 return self._learn_loop()
@@ -1416,6 +1463,89 @@ class TPUBaseTrainer(BaseRLTrainer):
             # AND on every crash/preemption path (docs/ASYNC_RL.md)
             self._shutdown_collectors()
 
+    def _maybe_start_serving(self) -> None:
+        """Stand up the serving frontend (``serve.enabled``,
+        docs/SERVING.md): a dedicated ContinuousEngine built through the
+        SAME slot-refill program cache as the collection engines, owned by
+        the serve pump thread for the whole ``learn()`` run, receiving
+        every published params version at step boundaries."""
+        cfg = self.config.serve
+        if not cfg.enabled or self._serve is not None:
+            return
+        if not hasattr(self, "_cb_make_engine"):
+            raise ValueError(
+                f"serve.enabled: {type(self).__name__} has no continuous-"
+                "batching engine path to serve from (PPO-family trainers "
+                "only)"
+            )
+        gen_kwargs: Dict[str, Any] = {}
+        if int(cfg.max_new_tokens) > 0:
+            gen_kwargs["max_new_tokens"] = int(cfg.max_new_tokens)
+        gen_config, extra_kwargs = self._resolve_gen_config(
+            eval_mode=True, **gen_kwargs
+        )
+        engine = self._cb_make_engine(
+            gen_config,
+            extra_kwargs,
+            int(cfg.slots),
+            1,
+            tag="serve",
+            version=self.iter_count,
+        )
+        engine.reserve_slots = int(cfg.reserve_slots)
+        for tenant, blocks in (cfg.tenant_quota_blocks or {}).items():
+            engine.allocator.set_tenant_quota(str(tenant), int(blocks))
+        if int(cfg.host_tier_blocks) > 0:
+            from trlx_tpu.ops.paged_kv import block_bytes
+            from trlx_tpu.serve.tiering import HostTier
+
+            engine.attach_host_tier(
+                HostTier(
+                    int(cfg.host_tier_blocks),
+                    block_bytes=block_bytes(engine.state.cache),
+                )
+            )
+        from trlx_tpu.serve.server import ServeServer
+
+        slo_s = {
+            k: float(v)
+            for k, v in (
+                ("interactive", cfg.slo_interactive_s),
+                ("eval", cfg.slo_eval_s),
+                ("actor", cfg.slo_actor_s),
+            )
+            if float(v) > 0
+        }
+        self._serve = ServeServer(
+            engine,
+            default_tenant=cfg.default_tenant,
+            default_class=cfg.default_class,
+            slo_s=slo_s,
+            max_queue=int(cfg.max_queue),
+            stream_buffer=int(cfg.stream_buffer),
+            drain_timeout_s=float(cfg.drain_timeout_s),
+            retain_param_versions=int(cfg.retain_param_versions),
+            default_max_new_tokens=int(cfg.max_new_tokens),
+        )
+        # publish BEFORE exposing the HTTP port: the pump drains params
+        # ahead of ingress, so every request admitted once the listener is
+        # up is stamped with a real version (never a pre-publish None)
+        self._serve.publish(self._serve_params_copy(), version=self.iter_count)
+        self._serve.start(host=cfg.host, port=int(cfg.port))
+        logger.info(
+            f"serving frontend up on {cfg.host}:{self._serve.port} "
+            f"({cfg.slots} slots, classes {list(slo_s) or 'un-SLO-gated'})"
+        )
+
+    def _serve_params_copy(self) -> Any:
+        """Buffer-owning copy of the engine-params tree for the serve pump
+        (the weight-channel idiom, ``async_rl/channel.py``): the train step
+        donates its input state, so a published alias of ``state.params``
+        would be invalidated under the pump mid-decode — and under
+        ``serve.retain_param_versions`` the history must stay readable
+        after arbitrarily many later updates."""
+        return jax.tree_util.tree_map(jnp.copy, self._engine_params())
+
     def _shutdown_collectors(self) -> None:
         """Stop any background experience collectors (PPO's async
         actor/learner split overrides and chains back here). Never raises.
@@ -1425,7 +1555,21 @@ class TPUBaseTrainer(BaseRLTrainer):
         ``trlx-prefetch`` worker: a consumer that stopped mid-epoch
         otherwise leaves the worker parked on a full queue until the
         trainer is garbage-collected (caught by the leaked-thread sentinel
-        in tests/conftest.py, the dynamic complement of graftlint GL403)."""
+        in tests/conftest.py, the dynamic complement of graftlint GL403).
+
+        The serving frontend drains FIRST (new admissions 503, in-flight
+        requests get ``serve.drain_timeout_s`` to finish, both serve
+        threads joined) — on the clean path AND on every crash/preemption
+        path, composing with the emergency-checkpoint exit: a SIGTERM'd
+        run writes its checkpoint at the step boundary, then drains serving
+        on the way out (docs/SERVING.md "Graceful drain")."""
+        serve = self._serve
+        if serve is not None:
+            self._serve = None
+            try:
+                serve.drain()
+            except Exception:  # pragma: no cover - defensive teardown
+                logger.warning("serve drain failed", exc_info=True)
         self._close_prompt_iterator()
 
     def _close_prompt_iterator(self) -> None:
@@ -1564,6 +1708,21 @@ class TPUBaseTrainer(BaseRLTrainer):
                 # arm an injected detector trip; this step's health update
                 # consumes it and runs the organic flightrec+triage path
                 self.obs.health.force_trip("fault_plan", step=self.iter_count)
+            if self._serve is not None and plan.poll(
+                "request_flood", step=self.iter_count
+            ):
+                # admission-control drill (docs/RESILIENCE.md): a synthetic
+                # burst through the real gate must shed load with 429s
+                rejected = self._serve.flood_drill()
+                logger.warning(
+                    f"request_flood drill at step {self.iter_count}: "
+                    f"{rejected} synthetic requests shed by admission"
+                )
+        if self._serve is not None:
+            # serve-while-training: every step boundary publishes the fresh
+            # params; the pump adopts them at its next serve-idle point, so
+            # every response is generated under ONE params version
+            self._serve.publish(self._serve_params_copy(), version=self.iter_count)
         preemption = self.resilience.preemption
         requested = preemption.requested
         coordinate = self.resilience.config.coordinate_preemption
@@ -1744,6 +1903,11 @@ class TPUBaseTrainer(BaseRLTrainer):
                         self.obs.cluster.note_fleet(collector.fleet_size())
                     self.obs.note_dropped_spans()
                     stats.update(self.obs.metrics.snapshot())
+                    if self._serve is not None:
+                        # per-tenant/per-class SLO percentiles live on the
+                        # HTTP /metrics endpoint; the flat SERVE_KEYS
+                        # gauges ride the training metric stream
+                        stats.update(self._serve.flat_metrics())
                     # windowed health detectors over this step's metric
                     # stream; a trip transition dumps the flight record and
                     # triages the batch that produced it
