@@ -1333,6 +1333,459 @@ def test_determinism_root_set_on_real_tree():
     assert any(f.endswith("save_state.<locals>.commit") for f in reach)
     assert any("_checkpoint_step_dirs" in f for f in reach)
     assert len(reach) >= 40
+    # the serve KV re-land paths (PR 19): host-tier re-land and both
+    # preemption seams promise "re-landed prefix == cold prefill", so
+    # their closures must stay free of iteration-order / wall-clock /
+    # unsorted-scan hazards
+    assert "HostTier.reland_many" in quals
+    assert "ContinuousEngine._reland_from_tier" in quals
+    assert "ContinuousEngine._preempt_slot" in quals
+    assert "ContinuousEngine._preempt_for_priority" in quals
+
+
+# ---------------------------------------------------------------------------
+# kernel discipline (GL1001–GL1004)
+# ---------------------------------------------------------------------------
+
+# Fixture packages route their gate through a module NAMED pallas_utils —
+# the pass matches the trailing `pallas_utils.<gate>` of the resolved
+# name, so a mini-tree earns a clean bill the same way ops/ does. Fixtures
+# that want GL1004 quiet register under the real `flash-fwd`/`flash-bwd`
+# rows: entry `flash_attention`(+`_bwd_chunk`), reference
+# `attention_reference`, and a `tests/test_flash_attention.py` created
+# next to the package root (ctx.base).
+
+_PALLAS_UTILS_FIXTURE = """
+def has_pallas_tpu():
+    return False
+"""
+
+
+def _touch_parity_test(tmp_path):
+    tests = tmp_path / "tests"
+    tests.mkdir(exist_ok=True)
+    (tests / "test_flash_attention.py").write_text("")
+
+
+def test_kernel_gate_ungated_entry(tmp_path):
+    """GL1001 positive: a pallas_call whose upward caller closure never
+    crosses the pallas_utils gate names each ungated entry."""
+    _touch_parity_test(tmp_path)
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "kern.py": """
+            from jax.experimental import pallas as pl
+
+            def _kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def attention_reference(x):
+                return x
+
+            def flash_attention(x):
+                return pl.pallas_call(_kernel, out_shape=x)(x)
+
+            def flash_attention_bwd_chunk(x):
+                return pl.pallas_call(_kernel, out_shape=x)(x)
+            """
+        },
+        passes=["kernel-discipline"],
+    )
+    assert codes(findings) == ["GL1001", "GL1001"]
+    assert {(f.symbol, f.detail) for f in findings} == {
+        ("flash_attention", "flash_attention"),
+        ("flash_attention_bwd_chunk", "flash_attention_bwd_chunk"),
+    }
+    assert "Mosaic-less build" in findings[0].message
+
+
+_GATED_KERNEL_PKG = {
+    "pallas_utils.py": _PALLAS_UTILS_FIXTURE,
+    "kern.py": """
+    from jax.experimental import pallas as pl
+    from pkg.pallas_utils import has_pallas_tpu
+
+    def _kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def attention_reference(x):
+        return x
+
+    def flash_attention(x):
+        if not has_pallas_tpu():
+            return attention_reference(x)
+        return pl.pallas_call(_kernel, out_shape=x)(x)
+
+    def flash_attention_bwd_chunk(x):
+        if not has_pallas_tpu():
+            return attention_reference(x)
+        return pl.pallas_call(_kernel, out_shape=x)(x)
+    """,
+}
+
+
+def test_kernel_gate_negative_gated_entry(tmp_path):
+    """GL1001/GL1003/GL1004 negative: gate-bearing entries, a pure kernel,
+    and registered flavors with a live reference and parity test file."""
+    _touch_parity_test(tmp_path)
+    findings = lint_pkg(tmp_path, _GATED_KERNEL_PKG, passes=["kernel-discipline"])
+    assert findings == []
+
+
+def test_kernel_gate_stitches_custom_vjp_rules(tmp_path):
+    """The defvjp stitch: fwd/bwd rules have no syntactic caller, but a
+    module-level `primal.defvjp(fwd, bwd)` makes the primal their caller,
+    so rules inherit the primal's gate instead of surfacing as ungated
+    roots. This is the fix for the six false positives the real tree's
+    custom_vjp pairs (flash fwd/bwd, fused-loss iw/noiw) would otherwise
+    produce."""
+    _touch_parity_test(tmp_path)
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "pallas_utils.py": _PALLAS_UTILS_FIXTURE,
+            "kern.py": """
+            import jax
+            from jax.experimental import pallas as pl
+            from pkg.pallas_utils import has_pallas_tpu
+
+            def _kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def attention_reference(x):
+                return x
+
+            @jax.custom_vjp
+            def _flash(x):
+                return pl.pallas_call(_kernel, out_shape=x)(x)
+
+            def _fwd(x):
+                return pl.pallas_call(_kernel, out_shape=x)(x), x
+
+            def _bwd(res, g):
+                return (pl.pallas_call(_kernel, out_shape=g)(g),)
+
+            _flash.defvjp(_fwd, _bwd)
+
+            def flash_attention(x):
+                if not has_pallas_tpu():
+                    return attention_reference(x)
+                return _flash(x)
+
+            def flash_attention_bwd_chunk(x):
+                return flash_attention(x)
+            """,
+        },
+        passes=["kernel-discipline"],
+    )
+    assert findings == []
+
+
+_LITERAL_STAMP_PKG = {
+    "stamp.py": """
+    import jax.numpy as jnp
+
+    def publish(gauges, metrics):
+        gauges["decode_pallas"] = 1.0
+        metrics.gauges["prefill_pallas"] = float(True)
+        metrics.record(loss_kernel_pallas=jnp.asarray(1))
+        return {"sample_pallas": 1}
+    """
+}
+
+
+def test_kernel_gauge_literal_stamps(tmp_path):
+    """GL1002 positive: every *_pallas store shape (subscript, attribute
+    chain, keyword, dict literal) stamped from a truthy literal — wrapper
+    calls like float(True)/jnp.asarray(1) don't launder it."""
+    findings = lint_pkg(tmp_path, _LITERAL_STAMP_PKG, passes=["kernel-discipline"])
+    assert codes(findings) == ["GL1002"] * 4
+    assert sorted(f.detail for f in findings) == [
+        "decode_pallas", "loss_kernel_pallas", "prefill_pallas",
+        "sample_pallas",
+    ]
+    assert all("twice-shipped" in f.message for f in findings)
+
+
+def test_kernel_gauge_stamp_negatives(tmp_path):
+    """GL1002 negative: values derived from has_pallas_tpu(), falsy
+    literal defaults (the pre-gate placeholder), and AnnAssign field
+    declarations are all fine."""
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "pallas_utils.py": _PALLAS_UTILS_FIXTURE,
+            "stamp.py": """
+            from pkg.pallas_utils import has_pallas_tpu
+
+            class Stats:
+                decode_pallas: float = 0.0
+
+            def publish(gauges):
+                use = has_pallas_tpu()
+                gauges["decode_pallas"] = float(use)
+                gauges["prefill_pallas"] = 0.0
+                return {"sample_pallas": 1.0 if use else 0.0}
+            """,
+        },
+        passes=["kernel-discipline"],
+    )
+    assert findings == []
+
+
+_IMPURE_KERNEL_PKG = {
+    "pallas_utils.py": _PALLAS_UTILS_FIXTURE,
+    "kern.py": """
+    import time
+    import numpy as np
+    from jax.experimental import pallas as pl
+    from pkg.pallas_utils import has_pallas_tpu
+
+    TABLE = np.arange(128)
+    OFFS = np.zeros(4)
+
+    def _kernel(x_ref, o_ref):
+        t = time.time()
+        o_ref[...] = x_ref[...] * TABLE + t
+
+    def attention_reference(x):
+        return x
+
+    def flash_attention(x):
+        if not has_pallas_tpu():
+            return attention_reference(x)
+        spec = pl.BlockSpec((8, 128), lambda i: (OFFS, 0))
+        return pl.pallas_call(_kernel, out_shape=x, in_specs=[spec])(x)
+
+    def flash_attention_bwd_chunk(x):
+        return flash_attention(x)
+    """,
+}
+
+
+def test_kernel_purity_positive(tmp_path):
+    """GL1003 positive: a wall-clock read and an ndarray closure in the
+    kernel body, and an ndarray closure in a BlockSpec index map."""
+    _touch_parity_test(tmp_path)
+    findings = lint_pkg(tmp_path, _IMPURE_KERNEL_PKG, passes=["kernel-discipline"])
+    assert codes(findings) == ["GL1003"] * 3
+    by_detail = {f.detail: f for f in findings}
+    assert set(by_detail) == {"time.time", "TABLE", "OFFS"}
+    assert by_detail["TABLE"].symbol == "_kernel"
+    assert "lambda" in by_detail["OFFS"].symbol  # the index map
+    assert "constant fold" in by_detail["TABLE"].message
+
+
+def test_kernel_purity_negatives(tmp_path):
+    """GL1003 negative: scalar closures (block sizes, NEG_INF-style
+    imported constants), package helper calls, and index maps that are
+    pure over grid indices + captured ints are all fine."""
+    _touch_parity_test(tmp_path)
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "pallas_utils.py": """
+            NEG_INF = -1e30
+
+            def has_pallas_tpu():
+                return False
+            """,
+            "kern.py": """
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+            from pkg.pallas_utils import has_pallas_tpu, NEG_INF
+
+            BLOCK = 128
+
+            def _mask(x):
+                return jnp.where(x > 0, x, NEG_INF)
+
+            def _kernel(x_ref, o_ref):
+                o_ref[...] = _mask(x_ref[...]) * BLOCK
+
+            def attention_reference(x):
+                return x
+
+            def flash_attention(x, group=4):
+                if not has_pallas_tpu():
+                    return attention_reference(x)
+                spec = pl.BlockSpec((BLOCK, BLOCK), lambda i, j: (i * group, j))
+                return pl.pallas_call(_kernel, out_shape=x, in_specs=[spec])(x)
+
+            def flash_attention_bwd_chunk(x):
+                return flash_attention(x)
+            """,
+        },
+        passes=["kernel-discipline"],
+    )
+    assert findings == []
+
+
+def test_kernel_registry_unregistered_site(tmp_path):
+    """GL1004 positive (a): a pallas_call whose upward closure contains
+    no KERNEL_PARITY entry — a new kernel flavor with no parity story.
+    (It is also an ungated entry, so GL1001 rides along.)"""
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "kern.py": """
+            from jax.experimental import pallas as pl
+
+            def _kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def mystery_kernel(x):
+                return pl.pallas_call(_kernel, out_shape=x)(x)
+            """
+        },
+        passes=["kernel-discipline"],
+    )
+    assert codes(findings) == ["GL1001", "GL1004"]
+    gl1004 = [f for f in findings if f.code == "GL1004"][0]
+    assert gl1004.symbol == "mystery_kernel"
+    assert "KERNEL_PARITY" in gl1004.message
+
+
+def test_kernel_registry_lost_reference_and_test(tmp_path):
+    """GL1004 positive (b): a registered flavor present in the tree whose
+    XLA reference no longer resolves and whose parity test file is gone
+    surfaces one finding per lost leg."""
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "pallas_utils.py": _PALLAS_UTILS_FIXTURE,
+            "kern.py": """
+            from jax.experimental import pallas as pl
+            from pkg.pallas_utils import has_pallas_tpu
+
+            def _kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def fused_ppo_loss(x):
+                if not has_pallas_tpu():
+                    return x
+                return pl.pallas_call(_kernel, out_shape=x)(x)
+            """,
+        },
+        passes=["kernel-discipline"],
+    )
+    assert codes(findings) == ["GL1004", "GL1004"]
+    assert sorted(f.detail for f in findings) == [
+        "fused-loss:reference:fused_ppo_loss_reference",
+        "fused-loss:test:tests/test_fused_loss.py",
+    ]
+
+
+def test_kernel_parity_registry_on_real_tree():
+    """The registry-vs-real-tree guard: every committed pallas_call site
+    is covered by a registered flavor, every registered entry AND its XLA
+    reference resolve in ops/, and every parity test file exists (guards
+    against the pass going vacuous, the RANK_UNIFORM_FIELDS pattern)."""
+    from trlx_tpu.analysis.kernels import KERNEL_PARITY, KernelDisciplinePass
+
+    ctx = AnalysisContext(TREE)
+    g = ctx.callgraph
+    kp = KernelDisciplinePass()
+    sites = kp._collect_sites(g)
+    # the current kernel surface: flash fwd + fused bwd, fused-loss fwd +
+    # bwd, paged decode, fused sampling, paged prefill
+    assert len(sites) == 7, sorted(
+        (s.mod.relpath, s.fn.qualname if s.fn else "<module>") for s in sites
+    )
+    assert {s.mod.relpath for s in sites} == {
+        "trlx_tpu/ops/flash_attention.py",
+        "trlx_tpu/ops/fused_loss.py",
+        "trlx_tpu/ops/paged_attention.py",
+        "trlx_tpu/ops/paged_prefill.py",
+    }
+    flavors = {flavor for flavor, _, _, _ in KERNEL_PARITY}
+    assert flavors == {
+        "paged-decode", "paged-prefill", "paged-verify", "fused-sample",
+        "fused-loss", "flash-fwd", "flash-bwd",
+    }
+    for flavor, entry, reference, test_path in KERNEL_PARITY:
+        assert g.resolve_root_names([entry]), f"{flavor}: entry `{entry}`"
+        assert g.resolve_root_names([reference]), (
+            f"{flavor}: reference `{reference}`"
+        )
+        assert os.path.exists(os.path.join(REPO_ROOT, test_path)), (
+            f"{flavor}: parity test `{test_path}`"
+        )
+    # and the pass itself is silent on the committed tree
+    findings, _ = run_analysis(TREE, passes=["kernel-discipline"])
+    assert findings == []
+
+
+def test_http_handler_thread_roots_discovered(tmp_path):
+    """GL403 satellite positive: do_* methods of a BaseHTTPRequestHandler
+    subclass are thread roots (ThreadingHTTPServer runs one thread per
+    request) — and only do_* methods of handler subclasses."""
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "__init__.py").write_text("")
+    (root / "srv.py").write_text(textwrap.dedent("""
+        import http.server
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.wfile.write(self.compute())
+
+            def do_POST(self):
+                self.wfile.write(b"ok")
+
+            def compute(self):
+                return b"x"
+
+            def log_message(self, fmt, *args):
+                pass
+
+        class NotAHandler:
+            def do_GET(self):
+                return 1
+        """))
+    ctx = AnalysisContext(str(root))
+    roots = {(r.fn.qualname, r.via) for r in ctx.callgraph.thread_roots}
+    assert roots == {
+        ("Handler.do_GET", "http-handler"),
+        ("Handler.do_POST", "http-handler"),
+    }
+
+
+def test_http_handler_cross_request_escape(tmp_path):
+    """GL403 satellite: two handler threads sharing an attr written
+    outside __init__ is exactly the cross-thread escape shape — the serve
+    pump-owns-engine contract is now checked, not just documented."""
+    findings = lint_pkg(
+        tmp_path,
+        {
+            "srv.py": """
+            import http.server
+
+            class Handler(http.server.BaseHTTPRequestHandler):
+                def do_GET(self):
+                    self.cache = self.compute()
+
+                def do_POST(self):
+                    self.wfile.write(self.cache)
+
+                def compute(self):
+                    return b"x"
+            """
+        },
+        passes=["thread-escape"],
+    )
+    assert codes(findings) == ["GL403"]
+    assert (findings[0].symbol, findings[0].detail) == ("Handler", "cache")
+
+
+def test_http_handler_roots_on_real_tree():
+    """The serve frontend's request handlers stay discovered as thread
+    roots (the real-tree coverage guard for the GL403 extension)."""
+    ctx = AnalysisContext(TREE)
+    roots = {(r.fn.qualname, r.via) for r in ctx.callgraph.thread_roots}
+    assert ("_Handler.do_GET", "http-handler") in roots
+    assert ("_Handler.do_POST", "http-handler") in roots
 
 
 # ---------------------------------------------------------------------------
@@ -1659,7 +2112,8 @@ def test_analysis_imports_without_jax():
             "-c",
             "import sys; from trlx_tpu.analysis import all_passes; "
             "names = set(all_passes()); "
-            "assert {'ownership', 'determinism'} <= names, names; "
+            "assert {'ownership', 'determinism', 'kernel-discipline'} "
+            "<= names, names; "
             "assert 'jax' not in sys.modules, 'loading the passes pulled in jax'",
         ],
         capture_output=True,
@@ -1865,6 +2319,143 @@ def test_self_run_detects_injected_concurrency_violations(tree_findings, tmp_pat
     assert sorted(f.code for f in new) == ["GL403", "GL701"]
 
 
+def test_self_run_detects_injected_kernel_violations(tree_findings, tmp_path):
+    """The acceptance shapes for the GL10xx family: an ungated
+    pallas_call entry, a literal-stamped *_pallas gauge, an
+    ndarray-closure kernel body, and an unregistered kernel flavor each
+    surface EXACTLY their finding through the committed baseline."""
+    _touch_parity_test(tmp_path)
+    ungated = lint_pkg(
+        tmp_path,
+        {
+            "pallas_utils.py": _PALLAS_UTILS_FIXTURE,
+            "kern.py": """
+            from jax.experimental import pallas as pl
+            from pkg_gate.pallas_utils import has_pallas_tpu
+
+            def _kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def attention_reference(x):
+                return x
+
+            def flash_attention(x):
+                return pl.pallas_call(_kernel, out_shape=x)(x)
+
+            def flash_attention_bwd_chunk(x):
+                if not has_pallas_tpu():
+                    return attention_reference(x)
+                return pl.pallas_call(_kernel, out_shape=x)(x)
+            """,
+        },
+        passes=["kernel-discipline"],
+        name="pkg_gate",
+    )
+    stamp = lint_pkg(
+        tmp_path,
+        {"stamp.py": 'def f(g):\n    g["decode_pallas"] = 1.0\n'},
+        passes=["kernel-discipline"],
+        name="pkg_stamp",
+    )
+    impure = lint_pkg(
+        tmp_path,
+        {
+            "pallas_utils.py": _PALLAS_UTILS_FIXTURE,
+            "kern.py": """
+            import numpy as np
+            from jax.experimental import pallas as pl
+            from pkg_pure.pallas_utils import has_pallas_tpu
+
+            TABLE = np.arange(8)
+
+            def _kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...] * TABLE
+
+            def attention_reference(x):
+                return x
+
+            def flash_attention(x):
+                if not has_pallas_tpu():
+                    return attention_reference(x)
+                return pl.pallas_call(_kernel, out_shape=x)(x)
+
+            def flash_attention_bwd_chunk(x):
+                return flash_attention(x)
+            """,
+        },
+        passes=["kernel-discipline"],
+        name="pkg_pure",
+    )
+    unregistered = lint_pkg(
+        tmp_path,
+        {
+            "pallas_utils.py": _PALLAS_UTILS_FIXTURE,
+            "kern.py": """
+            from jax.experimental import pallas as pl
+            from pkg_reg.pallas_utils import has_pallas_tpu
+
+            def _kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            def mystery_kernel(x):
+                if not has_pallas_tpu():
+                    return x
+                return pl.pallas_call(_kernel, out_shape=x)(x)
+            """,
+        },
+        passes=["kernel-discipline"],
+        name="pkg_reg",
+    )
+    assert codes(ungated) == ["GL1001"]
+    assert codes(stamp) == ["GL1002"]
+    assert codes(impure) == ["GL1003"]
+    assert codes(unregistered) == ["GL1004"]
+    baseline = Baseline.load(BASELINE)
+    new, _ = baseline.apply(
+        list(tree_findings) + ungated + stamp + impure + unregistered
+    )
+    assert sorted(f.code for f in new) == [
+        "GL1001", "GL1002", "GL1003", "GL1004",
+    ]
+
+
+def test_sarif_fingerprints_on_kernel_findings(tmp_path):
+    """GL10xx results carry the same line-drift-stable graftlintKey/v1
+    partialFingerprints as every other pass: padding lines above a
+    literal-stamped gauge moves region.startLine, never the key."""
+    import json
+
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "__init__.py").write_text("")
+    src = 'def f(g):\n    g["decode_pallas"] = 1.0\n'
+    (root / "stamp.py").write_text(src)
+
+    def sarif_results():
+        out = tmp_path / "out.sarif"
+        main([
+            str(root), "--no-baseline", "--select", "kernel-discipline",
+            "--format", "sarif", "--output", str(out),
+        ])
+        return json.loads(out.read_text())["runs"][0]["results"]
+
+    first = sarif_results()
+    assert [r["ruleId"] for r in first] == ["GL1002"]
+    fp = first[0]["partialFingerprints"]["graftlintKey/v1"]
+    line = first[0]["locations"][0]["physicalLocation"]["region"]["startLine"]
+    findings, _ = run_analysis(str(root), passes=["kernel-discipline"])
+    assert fp == findings[0].key
+    assert fp == "GL1002 pkg/stamp.py:f:decode_pallas"
+
+    (root / "stamp.py").write_text("# pad\n# pad\n" + src)
+    second = sarif_results()
+    assert second[0]["partialFingerprints"]["graftlintKey/v1"] == fp
+    assert (
+        second[0]["locations"][0]["physicalLocation"]["region"]["startLine"]
+        != line
+    )
+
+
 def test_lint_py_ci_entry():
     """scripts/lint.py (the CI entry point) exits 0 on the committed tree."""
     proc = subprocess.run(
@@ -1879,12 +2470,39 @@ def test_lint_py_ci_entry():
     assert "graftlint: OK" in proc.stdout
 
 
+def test_lint_py_sarif_entry(tmp_path):
+    """`scripts/lint.py --sarif PATH` — the exact invocation lint.yml and
+    `make lint-sarif` run — exits 0 on the committed tree and writes a
+    well-formed SARIF doc with zero non-baselined results (all passes,
+    GL10xx included, run in this entry point: scripts/lint.py selects
+    nothing, so all_passes() is the active set)."""
+    import json
+
+    out = tmp_path / "graftlint.sarif"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "lint.py"),
+            "--sarif",
+            str(out),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    run = json.loads(out.read_text())["runs"][0]
+    assert run["results"] == []  # clean tree: nothing to annotate
+
+
 def test_pass_registry_and_codes():
     passes = all_passes()
     assert set(passes) == {
         "host-sync", "recompile-hazard", "donation-safety",
         "lock-discipline", "thread-escape", "collective-discipline",
-        "ownership", "determinism",
+        "ownership", "determinism", "kernel-discipline",
         "metric-names", "span-names", "config-keys",
     }
     seen = set()

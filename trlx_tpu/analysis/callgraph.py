@@ -63,6 +63,19 @@ THREAD_CONSTRUCTORS = {
     "multiprocessing.Process",
 }
 
+# stdlib HTTP handler base classes: a ``ThreadingHTTPServer`` runs every
+# ``do_*`` method of its handler class on a per-connection thread, so
+# handler methods are thread roots with no visible Thread(...) spawn —
+# the serve frontend's "handlers only touch the submit surface" contract
+# is exactly what the escape analysis must see them as (docs/SERVING.md)
+HTTP_HANDLER_BASES = {
+    "http.server.BaseHTTPRequestHandler",
+    "http.server.SimpleHTTPRequestHandler",
+    "http.server.CGIHTTPRequestHandler",
+    "socketserver.BaseRequestHandler",
+    "socketserver.StreamRequestHandler",
+}
+
 
 def attr_chain(node: ast.AST) -> Optional[List[str]]:
     """``a.b.c`` → ["a","b","c"]; None if any link isn't a plain Name/attr."""
@@ -139,10 +152,13 @@ class ThreadRoot:
     ``RolloutPipeline.submit`` — both run the callable on a worker
     thread). Resolution reuses the jit-root machinery: closures, bound
     ``self.m`` methods, ``partial(f, x)`` wrapping, factory returns, and
-    lambdas all resolve (``resolve_callable_deep``)."""
+    lambdas all resolve (``resolve_callable_deep``). ``do_*`` methods of
+    ``BaseHTTPRequestHandler`` subclasses are roots too (via
+    "http-handler"): a ``ThreadingHTTPServer`` dispatches each request
+    on a per-connection thread the stdlib spawns internally."""
 
     fn: FunctionInfo
-    via: str  # "Thread" | "Process" | "submit"
+    via: str  # "Thread" | "Process" | "submit" | "http-handler"
     module: SourceModule
     line: int
 
@@ -809,6 +825,47 @@ class CallGraph:
                     self.thread_roots.append(
                         ThreadRoot(fn=fn, via=via, module=mod, line=node.lineno)
                     )
+        # HTTP handler classes: each request's do_* dispatch runs on a
+        # ThreadingHTTPServer per-connection thread — no Thread(...) call
+        # exists to discover, the spawn is inside the stdlib
+        for full in sorted(self.classes):
+            if not self._is_http_handler(full):
+                continue
+            info = self.classes[full]
+            for mname in sorted(info.methods):
+                if not mname.startswith("do_"):
+                    continue
+                fn = info.methods[mname]
+                if (fn.full, "http-handler") in seen:
+                    continue
+                seen.add((fn.full, "http-handler"))
+                self.thread_roots.append(
+                    ThreadRoot(
+                        fn=fn,
+                        via="http-handler",
+                        module=info.module,
+                        line=fn.node.lineno,
+                    )
+                )
+
+    def _is_http_handler(self, class_full: str) -> bool:
+        """Does ``class_full`` (or any package superclass of it) extend a
+        stdlib HTTP/socketserver request-handler base?"""
+        for full in self._closure(class_full, self._supers):
+            info = self.classes.get(full)
+            if info is None:
+                continue
+            for base in info.base_names:
+                head, _, rest = base.partition(".")
+                target = self.imports.get(info.module.modname, {}).get(head)
+                canonical = (
+                    (f"{target}.{rest}" if rest else target)
+                    if target
+                    else base
+                )
+                if canonical in HTTP_HANDLER_BASES:
+                    return True
+        return False
 
     def thread_membership(self) -> Dict[str, FrozenSet[str]]:
         """``FunctionInfo.full`` → the set of thread-root labels (root

@@ -197,6 +197,7 @@ def _ensure_builtin_passes() -> None:
         conventions,
         determinism,
         jax_passes,
+        kernels,
         locks,
         ownership,
     )

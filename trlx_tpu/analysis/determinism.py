@@ -95,6 +95,15 @@ BIT_EQUIVALENCE_ROOTS = (
     # different faults — divergence by construction
     "FaultPlan.parse",
     "FaultPlan.from_config",
+    # serve KV re-land paths: a host-tier re-land writes spilled bytes
+    # back VERBATIM (bit-equality by construction, docs/SERVING.md), and
+    # a preemption re-lands the committed prompt prefix through the radix
+    # chain — any nondeterminism here silently breaks the "re-landed
+    # prefix == cold prefill" pin the serve tests rely on
+    "HostTier.reland_many",
+    "ContinuousEngine._reland_from_tier",
+    "ContinuousEngine._preempt_slot",
+    "ContinuousEngine._preempt_for_priority",
 )
 
 # Modules whose wall-clock reads are telemetry, not content: the

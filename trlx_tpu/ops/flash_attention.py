@@ -23,6 +23,10 @@ Design notes:
   combine associatively, which is exactly what the ring-attention accumulator
   needs.
 - f32 accumulation throughout; inputs may be bf16.
+- Registered in ``analysis/kernels.py::KERNEL_PARITY`` as ``flash-fwd`` /
+  ``flash-bwd``: graftlint's kernel-discipline pass (GL1001–GL1004) keeps
+  both entries gated through ``pallas_utils``, the kernel bodies pure, and
+  the ``attention_reference`` parity pin alive (docs/STATIC_ANALYSIS.md).
 """
 
 import functools

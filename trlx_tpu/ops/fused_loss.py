@@ -74,6 +74,13 @@ next-TPU-window A/B (``docs/PERFORMANCE.md`` "Fused learner kernels").
 ``block_rows`` sets the batch-axis pad granularity (keep it a multiple
 of 8, the f32 sublane, on chip); the response width pads to the
 128-lane multiple.
+
+Registered in ``analysis/kernels.py::KERNEL_PARITY`` as ``fused-loss``:
+graftlint's kernel-discipline pass keeps ``fused_ppo_loss`` gated through
+``pallas_utils``, forbids literal ``train/loss_kernel_pallas`` stamps
+(GL1002 — the twice-shipped fallback-gauge bug), and fails the tree if
+the staged reference or ``tests/test_fused_loss.py`` disappears
+(docs/STATIC_ANALYSIS.md).
 """
 
 import functools

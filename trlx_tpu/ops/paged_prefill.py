@@ -49,6 +49,11 @@ Hardware notes (``/opt/skills/guides/pallas_guide.md``): block fetches are
 multiple of 128 on real TPUs. VMEM holds the assembled row
 (``TB·block_size × KV × D``) plus the ``[T, S]`` f32 score block — bound
 ``T`` with ``engine.prefill_chunk`` for long prompts on chip.
+
+Registered in ``analysis/kernels.py::KERNEL_PARITY`` as ``paged-prefill``
+(the verify seam rides the same body as ``paged-verify``): graftlint's
+kernel-discipline pass enforces the gate/purity/parity conventions
+statically (docs/STATIC_ANALYSIS.md).
 """
 
 import functools
